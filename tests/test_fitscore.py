@@ -1,0 +1,78 @@
+"""FITS core round-trip tests."""
+
+import numpy as np
+
+from tpulsar.io import fitscore
+
+
+def test_header_roundtrip(tmp_path):
+    hdr = fitscore.primary_header()
+    hdr.set("TELESCOP", "Arecibo", "telescope name")
+    hdr.set("OBSFREQ", 1375.5, "center frequency")
+    hdr.set("STT_IMJD", 55555)
+    hdr.set("TRACK", True)
+    hdr.set("SRC_NAME", "J1855+0307")
+    path = tmp_path / "hdr.fits"
+    fitscore.write_fits(str(path), [fitscore.HDU(hdr, None)])
+    hdus = fitscore.read_fits(str(path))
+    h = hdus[0].header
+    assert h["TELESCOP"] == "Arecibo"
+    assert abs(h["OBSFREQ"] - 1375.5) < 1e-12
+    assert h["STT_IMJD"] == 55555
+    assert h["TRACK"] is True
+    assert h["SRC_NAME"] == "J1855+0307"
+
+
+def test_quoted_string_with_slash_and_quote(tmp_path):
+    hdr = fitscore.primary_header()
+    hdr.set("WEIRD", "a/b 'c'", "comment / slash")
+    path = tmp_path / "w.fits"
+    fitscore.write_fits(str(path), [fitscore.HDU(hdr, None)])
+    h = fitscore.read_fits(str(path))[0].header
+    assert h["WEIRD"] == "a/b 'c'"
+
+
+def test_bintable_roundtrip(tmp_path):
+    rowdt = np.dtype([
+        ("TSUBINT", ">f8"), ("COUNT", ">i4"),
+        ("VEC", ">f4", (6,)), ("MAT", ">u1", (4, 3)),
+        ("NAME", "S8"),
+    ])
+    rows = np.zeros(5, dtype=rowdt)
+    rows["TSUBINT"] = np.arange(5) * 1.5
+    rows["COUNT"] = np.arange(5) * 7
+    rows["VEC"] = np.arange(30).reshape(5, 6)
+    rows["MAT"] = np.arange(60).reshape(5, 4, 3)
+    rows["NAME"] = [b"alpha", b"beta", b"gamma", b"delta", b"eps"]
+
+    hdr = fitscore.bintable_header("SUBINT", rows, tdims={"MAT": (4, 3)},
+                                   NCHAN=3, TBIN=6.4e-5)
+    path = tmp_path / "tab.fits"
+    fitscore.write_fits(str(path), [
+        fitscore.HDU(fitscore.primary_header(), None),
+        fitscore.HDU(hdr, rows)])
+
+    hdus = fitscore.read_fits(str(path))
+    tab = fitscore.get_hdu(hdus, "SUBINT")
+    assert tab.header["NCHAN"] == 3
+    assert abs(tab.header["TBIN"] - 6.4e-5) < 1e-18
+    got = np.asarray(tab.data)
+    np.testing.assert_allclose(got["TSUBINT"], rows["TSUBINT"])
+    np.testing.assert_array_equal(got["COUNT"], rows["COUNT"])
+    np.testing.assert_allclose(got["VEC"], rows["VEC"])
+    np.testing.assert_array_equal(got["MAT"], rows["MAT"])
+    assert got["NAME"][2].startswith(b"gamma")
+
+
+def test_lazy_memmap(tmp_path):
+    rowdt = np.dtype([("DATA", ">u1", (64,))])
+    rows = np.zeros(100, dtype=rowdt)
+    rows["DATA"] = np.arange(6400).reshape(100, 64) % 256
+    hdr = fitscore.bintable_header("SUBINT", rows)
+    path = tmp_path / "big.fits"
+    fitscore.write_fits(str(path), [
+        fitscore.HDU(fitscore.primary_header(), None),
+        fitscore.HDU(hdr, rows)])
+    tab = fitscore.get_hdu(fitscore.read_fits(str(path), lazy=True), "SUBINT")
+    assert isinstance(tab.data, np.memmap)
+    np.testing.assert_array_equal(tab.data["DATA"][42], rows["DATA"][42])

@@ -1,0 +1,25 @@
+"""tpulsar — a TPU-native pulsar-search framework.
+
+A brand-new framework with the capabilities of the PALFA pipeline2.0
+(reference: NihanPol/pipeline2.0): end-to-end survey pulsar search —
+data acquisition, durable job tracking, cluster fan-out, the search
+itself, and verified result upload. Unlike the reference, which shells
+out to PRESTO's C executables for all compute, tpulsar implements the
+search (RFI masking, dedispersion, FFT periodicity + acceleration
+search, single-pulse search, folding) as JAX/XLA/Pallas programs that
+run on TPU, with DM trials and beams sharded over a device mesh.
+
+Layout (mirrors SURVEY.md section 7):
+  io/          PSRFITS + data formats, synthetic beam generator
+  plan/        dedispersion planning (DDplan) + survey plans
+  kernels/     JAX/Pallas compute kernels (the PRESTO-C replacements)
+  parallel/    mesh construction, sharded search, distributed FFT
+  search/      the per-beam search executor, sifting, reports
+  orchestrate/ job tracker, job pool, queue managers, downloader, uploader
+  config/      typed validated configuration
+  obs/         logging, timing, mail notification, debug flags
+  astro/       time/coordinate/angle utilities
+  cli/         operator command-line tools
+"""
+
+__version__ = "0.1.0"

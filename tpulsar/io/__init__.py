@@ -1,0 +1,1 @@
+"""I/O layer: FITS core, PSRFITS reading, data-file domain model, synthesis."""

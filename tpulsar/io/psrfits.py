@@ -1,0 +1,320 @@
+"""PSRFITS search-mode reading with SpectraInfo semantics.
+
+Reproduces the behavioral contract of the reference's pure-Python
+header logic (reference: lib/python/formats/psrfits.py:26-320) on top
+of tpulsar's own FITS core, and additionally decodes the sample data
+itself (which the reference leaves to PRESTO's C code): 4/8/16-bit
+unpacking, per-channel scales/offsets/weights, polarization summing,
+band flipping, and inter-file padding.
+
+Key behaviors carried over from the reference (cited by file:line into
+/root/reference):
+  * beam id from primary IBEAM else SUBINT BEAM (psrfits.py:61-66)
+  * "ARECIBO 305m" telescope normalized to "Arecibo" (psrfits.py:71-73)
+  * start MJD = STT_IMJD + (STT_SMJD + STT_OFFS)/86400 (psrfits.py:124)
+  * OFFS_SUB row-loss correction: the starting subint is re-derived
+    from the first row's OFFS_SUB when it disagrees with NSUBOFFS
+    (psrfits.py:155-170)
+  * inter-file padding from start-time gaps (psrfits.py:272-280)
+  * need_scale/offset/weight flags from first-row columns
+    (psrfits.py:238-272)
+  * summed_polns iff POL_TYPE in {AA+BB, INTEN} (psrfits.py:288-292)
+  * band flip when channel freqs descend (psrfits.py:307-312)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+
+import numpy as np
+
+from tpulsar.astro import angles
+from tpulsar.constants import SECPERDAY
+from tpulsar.io import fitscore
+
+
+def is_psrfits(path: str) -> bool:
+    """True iff the file is *search-mode* PSRFITS: FITSTYPE='PSRFITS'
+    and OBS_MODE='SEARCH' (reference: formats/psrfits.py:409-421)."""
+    try:
+        with open(path, "rb") as fh:
+            hdr, _ = fitscore.read_header(fh)
+    except (OSError, fitscore.FitsError, EOFError):
+        return False
+    fitstype = str(hdr.get("FITSTYPE", "")).strip()
+    obs_mode = str(hdr.get("OBS_MODE", "")).strip()
+    return fitstype == "PSRFITS" and obs_mode == "SEARCH"
+
+
+@dataclasses.dataclass
+class _FileInfo:
+    path: str
+    hdus: list[fitscore.HDU]
+    num_subint: int
+    start_subint: int
+    start_spec: int
+    num_spec: int
+    num_pad: int = 0
+
+
+class SpectraInfo:
+    """Aggregate header/geometry info for one or more PSRFITS files
+    belonging to a single observation, in time order."""
+
+    def __init__(self, filenames: list[str]):
+        if not filenames:
+            raise ValueError("SpectraInfo needs at least one file")
+        self.filenames = list(filenames)
+        self.num_files = len(filenames)
+        self.N = 0
+        self.need_scale = False
+        self.need_offset = False
+        self.need_weight = False
+        self.need_flipband = False
+
+        self.start_MJD = np.empty(self.num_files)
+        self._files: list[_FileInfo] = []
+
+        for ii, fn in enumerate(filenames):
+            if not is_psrfits(fn):
+                raise ValueError(f"{fn} does not appear to be PSRFITS")
+            hdus = fitscore.read_fits(fn)
+            primary = hdus[0].header
+            subint_hdu = fitscore.get_hdu(hdus, "SUBINT")
+            subint = subint_hdu.header
+            row0 = subint_hdu.data[0]
+
+            if ii == 0:
+                self.beam_id = primary.get("IBEAM", subint.get("BEAM"))
+                if self.beam_id is not None:
+                    self.beam_id = int(self.beam_id)
+                telescope = str(primary.get("TELESCOP", "")).strip()
+                if telescope == "ARECIBO 305m":
+                    telescope = "Arecibo"
+                self.telescope = telescope
+                self.observer = str(primary.get("OBSERVER", "")).strip()
+                self.source = str(primary.get("SRC_NAME", "")).strip()
+                self.frontend = str(primary.get("FRONTEND", "")).strip()
+                self.backend = str(primary.get("BACKEND", "")).strip()
+                self.project_id = str(primary.get("PROJID", "")).strip()
+                self.date_obs = str(primary.get("DATE-OBS", "")).strip()
+                self.poln_type = str(primary.get("FD_POLN", "")).strip()
+                self.ra_str = str(primary.get("RA", "00:00:00")).strip()
+                self.dec_str = str(primary.get("DEC", "00:00:00")).strip()
+                self.fctr = float(primary.get("OBSFREQ", 0.0))
+                self.orig_num_chan = int(primary.get("OBSNCHAN", 0))
+                self.orig_df = float(primary.get("OBSBW", 0.0))
+                self.beam_FWHM = float(primary.get("BMIN", 0.0))
+                self.chan_dm = float(primary.get("CHAN_DM", 0.0))
+                self.tracking = str(primary.get("TRK_MODE", "")).strip() == "TRACK"
+                self.start_lst = float(primary.get("STT_LST", 0.0))
+
+                self.dt = float(subint["TBIN"])
+                self.num_channels = int(subint["NCHAN"])
+                self.num_polns = int(subint["NPOL"])
+                self.poln_order = str(subint.get("POL_TYPE", "")).strip()
+                self.spectra_per_subint = int(subint["NSBLK"])
+                self.bits_per_sample = int(subint["NBITS"])
+                self.zero_off = float(subint.get("ZERO_OFF", 0.0) or 0.0)
+                self.signed_ints = bool(subint.get("SIGNINT", 0))
+                self.time_per_subint = self.dt * self.spectra_per_subint
+                if int(subint.get("NCHNOFFS", 0)) > 0:
+                    warnings.warn(f"first freq channel is not 0 in {fn}")
+
+                freqs = np.asarray(row0["DAT_FREQ"], dtype=np.float64)
+                self.df = float(freqs[1] - freqs[0]) if len(freqs) > 1 else self.orig_df
+                self.lo_freq = float(freqs[0])
+                self.hi_freq = float(freqs[-1])
+                self.azimuth = float(row0["TEL_AZ"]) if "TEL_AZ" in (row0.dtype.names or ()) else 0.0
+                self.zenith_ang = float(row0["TEL_ZEN"]) if "TEL_ZEN" in (row0.dtype.names or ()) else 0.0
+            else:
+                freqs = np.asarray(row0["DAT_FREQ"], dtype=np.float64)
+                if abs(self.lo_freq - float(freqs[0])) > 1e-7:
+                    warnings.warn(f"low channel changes between files 0 and {ii}")
+
+            names = row0.dtype.names or ()
+            if "DAT_WTS" in names and np.any(np.asarray(row0["DAT_WTS"]) != 1.0):
+                self.need_weight = True
+            if "DAT_OFFS" in names and np.any(np.asarray(row0["DAT_OFFS"]) != 0.0):
+                self.need_offset = True
+            if "DAT_SCL" in names and np.any(np.asarray(row0["DAT_SCL"]) != 1.0):
+                self.need_scale = True
+
+            start_mjd = (primary["STT_IMJD"]
+                         + (primary["STT_SMJD"] + primary["STT_OFFS"]) / SECPERDAY)
+            num_subint = int(subint["NAXIS2"])
+            start_subint = int(subint.get("NSUBOFFS", 0))
+
+            # OFFS_SUB row-loss correction (reference psrfits.py:155-170):
+            # OFFS_SUB of the first row is the mid-time of that subint
+            # relative to the observation start; if it implies more
+            # preceding rows than NSUBOFFS claims, rows were dropped and
+            # OFFS_SUB wins.
+            if "OFFS_SUB" in names:
+                offs_sub = float(row0["OFFS_SUB"])
+                numrows = int((offs_sub - 0.5 * self.time_per_subint)
+                              / self.time_per_subint + 1e-7)
+                if numrows > start_subint:
+                    warnings.warn(
+                        f"NSUBOFFS reports {start_subint} previous rows but "
+                        f"OFFS_SUB implies {numrows}; using OFFS_SUB")
+                start_subint = numrows
+
+            start_mjd += (self.time_per_subint * start_subint) / SECPERDAY
+            self.start_MJD[ii] = start_mjd
+            mjdf = start_mjd - self.start_MJD[0]
+            if mjdf < 0.0:
+                raise ValueError(f"file {ii} seems to be from before file 0")
+            start_spec = int(mjdf * SECPERDAY / self.dt + 0.5)
+
+            num_spec = self.spectra_per_subint * num_subint
+            finfo = _FileInfo(fn, hdus, num_subint, start_subint,
+                              start_spec, num_spec)
+            if ii > 0 and start_spec > self.N:
+                self._files[ii - 1].num_pad = start_spec - self.N
+                self.N += self._files[ii - 1].num_pad
+            self._files.append(finfo)
+            self.N += num_spec
+
+        self.num_subint = np.array([f.num_subint for f in self._files])
+        self.start_subint = np.array([f.start_subint for f in self._files])
+        self.start_spec = np.array([f.start_spec for f in self._files])
+        self.num_spec = np.array([f.num_spec for f in self._files])
+        self.num_pad = np.array([f.num_pad for f in self._files])
+
+        self.ra2000 = angles.hms_str_to_deg(self.ra_str)
+        self.dec2000 = angles.dms_str_to_deg(self.dec_str)
+        self.summed_polns = self.poln_order in ("AA+BB", "INTEN")
+        self.T = self.N * self.dt
+        if self.orig_num_chan:
+            self.orig_df /= float(self.orig_num_chan)
+        self.samples_per_spectra = self.num_polns * self.num_channels
+        if self.bits_per_sample < 8:
+            self.bytes_per_spectra = self.samples_per_spectra
+        else:
+            self.bytes_per_spectra = (self.bits_per_sample
+                                      * self.samples_per_spectra) // 8
+        self.samples_per_subint = self.samples_per_spectra * self.spectra_per_subint
+        self.bytes_per_subint = self.bytes_per_spectra * self.spectra_per_subint
+
+        if self.hi_freq < self.lo_freq:
+            self.hi_freq, self.lo_freq = self.lo_freq, self.hi_freq
+            self.df *= -1.0
+            self.need_flipband = True
+        self.BW = self.num_channels * self.df
+
+    # ---------------------------------------------------------------- data
+
+    @property
+    def freqs(self) -> np.ndarray:
+        """Channel center frequencies in ascending order (MHz)."""
+        return self.lo_freq + np.arange(self.num_channels) * abs(self.df)
+
+    def read_subints(self, file_index: int, lo: int, hi: int,
+                     apply_calibration: bool = True,
+                     sum_polns: bool = True) -> np.ndarray:
+        """Decode subint rows [lo, hi) of one file.
+
+        Returns float32 array of shape (nspec, nchan) with channels in
+        ascending frequency order (band flip applied), polarizations
+        summed (or the first poln selected for non-summable orders).
+        """
+        finfo = self._files[file_index]
+        subint_hdu = fitscore.get_hdu(finfo.hdus, "SUBINT")
+        rows = subint_hdu.data[lo:hi]
+        raw = np.asarray(rows["DATA"])
+        nrows = raw.shape[0]
+        nsblk, npol, nchan = self.spectra_per_subint, self.num_polns, self.num_channels
+
+        data = unpack_samples(raw.reshape(nrows, -1), self.bits_per_sample,
+                              self.signed_ints)
+        data = data.reshape(nrows, nsblk, npol, nchan).astype(np.float32)
+
+        if apply_calibration:
+            if self.zero_off:
+                data -= self.zero_off
+            scl = np.asarray(rows["DAT_SCL"], dtype=np.float32).reshape(nrows, npol, nchan) \
+                if self.need_scale else None
+            offs = np.asarray(rows["DAT_OFFS"], dtype=np.float32).reshape(nrows, npol, nchan) \
+                if self.need_offset else None
+            if scl is not None:
+                data *= scl[:, None, :, :]
+            if offs is not None:
+                data += offs[:, None, :, :]
+            if self.need_weight:
+                wts = np.asarray(rows["DAT_WTS"], dtype=np.float32).reshape(nrows, 1, 1, nchan)
+                data *= wts
+
+        if npol > 1 and sum_polns and self.poln_order.startswith("AABB"):
+            # Total intensity = AA + BB for orthogonal-poln order.
+            data = data[:, :, 0, :] + data[:, :, 1, :]
+        else:
+            # Summed data, Stokes order (I first), or caller opted out:
+            # the first polarization is the intensity.
+            data = data[:, :, 0, :]
+
+        data = data.reshape(nrows * nsblk, nchan)
+        if self.need_flipband:
+            data = data[:, ::-1]
+        return np.ascontiguousarray(data)
+
+    def read_all(self, apply_calibration: bool = True) -> np.ndarray:
+        """Decode the entire observation into one (N, nchan) float32
+        block, inserting padding (channel medians) between files."""
+        pieces = []
+        for ii, finfo in enumerate(self._files):
+            block = self.read_subints(ii, 0, finfo.num_subint,
+                                      apply_calibration=apply_calibration)
+            pieces.append(block)
+            if finfo.num_pad:
+                med = np.median(block[-min(len(block), 1024):], axis=0)
+                pieces.append(np.broadcast_to(
+                    med.astype(np.float32), (finfo.num_pad, block.shape[1])).copy())
+        return np.concatenate(pieces, axis=0)
+
+
+def unpack_samples(raw: np.ndarray, nbits: int, signed: bool = False) -> np.ndarray:
+    """Unpack packed sample bytes to integer samples.
+
+    raw: (..., nbytes) uint8.  For nbits=4 the high nibble is the
+    earlier sample (PSRFITS convention).  Returns (..., nsamples).
+    """
+    raw = np.asarray(raw, dtype=np.uint8)
+    if nbits == 8:
+        return raw.astype(np.int16) if not signed else raw.view(np.int8).astype(np.int16)
+    if nbits == 16:
+        dt = ">i2" if signed else ">u2"
+        return raw.view(dt).astype(np.int32)
+    if nbits == 4:
+        hi = (raw >> 4) & 0x0F
+        lo = raw & 0x0F
+        out = np.empty(raw.shape[:-1] + (raw.shape[-1] * 2,), dtype=np.int16)
+        out[..., 0::2] = hi
+        out[..., 1::2] = lo
+        return out
+    if nbits == 2:
+        out = np.empty(raw.shape[:-1] + (raw.shape[-1] * 4,), dtype=np.int16)
+        for k in range(4):
+            out[..., k::4] = (raw >> (6 - 2 * k)) & 0x03
+        return out
+    if nbits == 1:
+        out = np.empty(raw.shape[:-1] + (raw.shape[-1] * 8,), dtype=np.int16)
+        for k in range(8):
+            out[..., k::8] = (raw >> (7 - k)) & 0x01
+        return out
+    raise ValueError(f"unsupported NBITS={nbits}")
+
+
+def pack_samples(samples: np.ndarray, nbits: int) -> np.ndarray:
+    """Inverse of unpack_samples (for writing synthetic files)."""
+    samples = np.asarray(samples)
+    if nbits == 8:
+        return samples.astype(np.uint8)
+    if nbits == 16:
+        return samples.astype(">u2").view(np.uint8)
+    if nbits == 4:
+        s = samples.astype(np.uint8)
+        return ((s[..., 0::2] << 4) | (s[..., 1::2] & 0x0F)).astype(np.uint8)
+    raise ValueError(f"unsupported NBITS={nbits}")
