@@ -1,0 +1,78 @@
+"""Acceleration search kernel tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpulsar.kernels import accel
+
+
+def _chirp_series(T=1 << 15, dt=1e-3, f0=40.0, fdot=0.0, amp=0.6, seed=3):
+    """Time series with a linearly drifting tone; drift in bins over
+    the observation is z = fdot * T_s^2."""
+    rng = np.random.default_rng(seed)
+    t = np.arange(T) * dt
+    phase = 2 * np.pi * (f0 * t + 0.5 * fdot * t * t)
+    x = rng.standard_normal(T).astype(np.float32) + amp * np.sin(phase)
+    return x.astype(np.float32), T * dt
+
+
+def test_z_grid():
+    zs = accel.z_grid(50.0)
+    assert zs[0] == -50.0 and zs[-1] == 50.0
+    assert 0.0 in zs
+    assert np.all(np.diff(zs) == accel.DZ)
+
+
+def test_z_response_normalization():
+    """Responses carry (nearly) unit total power."""
+    for z in (0.0, 10.0, -30.0):
+        resp = accel.gen_z_response(z, accel.template_width(50.0))
+        assert abs(np.sum(np.abs(resp) ** 2) - 1.0) < 0.05, f"z={z}"
+
+
+def test_zero_z_response_is_delta():
+    resp = accel.gen_z_response(0.0, 64)
+    assert np.argmax(np.abs(resp)) == 32
+    assert np.abs(resp[32]) > 0.99
+
+
+def test_stationary_tone_found_at_z0():
+    x, T_s = _chirp_series(fdot=0.0, amp=0.8)
+    spec = jnp.fft.rfft(jnp.asarray(x - x.mean()))
+    spec = accel.normalize_spectrum(spec)
+    bank = accel.build_template_bank(16.0, seg=1 << 11)
+    res = accel.accel_search_one(spec, bank, max_numharm=1, topk=8)
+    vals, rbins, zvals = res[1]
+    true_r = round(40.0 * T_s)
+    best = np.argmax(vals)
+    assert abs(int(rbins[best]) - true_r) <= 1
+    assert abs(zvals[best]) <= accel.DZ
+
+
+def test_drifting_tone_recovered_at_correct_z():
+    """A tone drifting z~12 bins is invisible at z=0 but recovered by
+    the matching template."""
+    T, dt = 1 << 15, 1e-3
+    T_s = T * dt
+    z_true = 12.0
+    fdot = z_true / T_s ** 2
+    x, _ = _chirp_series(T=T, dt=dt, f0=40.0, fdot=fdot, amp=0.8)
+    spec = jnp.fft.rfft(jnp.asarray(x - x.mean()))
+    spec = accel.normalize_spectrum(spec)
+    bank = accel.build_template_bank(24.0, seg=1 << 11)
+    res = accel.accel_search_one(spec, bank, max_numharm=1, topk=8)
+    vals, rbins, zvals = res[1]
+    best = np.argmax(vals)
+    # mean frequency over the obs: f0 + fdot*T/2 -> bin f0*T + z/2
+    true_r = 40.0 * T_s + z_true / 2
+    assert abs(zvals[best] - z_true) <= accel.DZ
+    assert abs(rbins[best] - true_r) <= 2
+    # the z=0 response to the same signal is much weaker
+    zi0 = list(bank.zs).index(0.0)
+    plane = accel._correlate_segments(
+        jnp.asarray(np.asarray(spec), np.complex64),
+        jnp.asarray(bank.bank_fft), bank.seg, bank.step, bank.width)
+    plane = np.asarray(plane)
+    r_idx = int(round(true_r))
+    zi_best = int(np.argmin(np.abs(np.asarray(bank.zs) - z_true)))
+    assert plane[zi_best, r_idx] > 2.0 * plane[zi0, r_idx]

@@ -1,0 +1,69 @@
+"""Dedispersion plan tests."""
+
+import numpy as np
+import pytest
+
+from tpulsar.plan import ddplan
+
+
+def test_survey_plan_mock_matches_reference_table():
+    """The hardcoded Mock plan must reproduce the reference's DM
+    coverage: 6 steps, 57 passes, DM 0 -> 1066.4."""
+    steps = ddplan.survey_plan("pdev")
+    assert len(steps) == 6
+    assert sum(s.numpasses for s in steps) == 57
+    assert steps[0].lodm == 0.0
+    assert abs(steps[-1].hidm - 1066.4) < 1e-9
+    # steps tile the DM range contiguously
+    for a, b in zip(steps[:-1], steps[1:]):
+        assert abs(a.hidm - b.lodm) < 1e-9
+    # trial count: 28*76 + 12*64 + 4*76 + 9*76 + 3*76 + 1*76
+    assert ddplan.total_dm_trials(steps) == 28 * 76 + 12 * 64 + (4 + 9 + 3 + 1) * 76
+
+
+def test_survey_plan_wapp():
+    steps = ddplan.survey_plan("wapp")
+    assert len(steps) == 3
+    assert sum(s.numpasses for s in steps) == 15
+    assert abs(steps[-1].hidm - 1725.2) < 1e-9
+
+
+def test_survey_plan_unknown_backend():
+    with pytest.raises(ValueError):
+        ddplan.survey_plan("guppi")
+
+
+def test_passes_expand_correctly():
+    step = ddplan.DedispStep(lodm=10.0, dmstep=0.5, dms_per_pass=4,
+                             numpasses=3, numsub=8, downsamp=2)
+    passes = step.passes()
+    assert len(passes) == 3
+    assert passes[0].dms == (10.0, 10.5, 11.0, 11.5)
+    assert passes[1].lodm == 12.0
+    assert abs(passes[0].subdm - 11.0) < 1e-9  # lodm + 0.5*sub_dmstep
+    assert step.hidm == 16.0
+    np.testing.assert_allclose(step.all_dms(), 10.0 + 0.5 * np.arange(12))
+
+
+def test_dm_smear_consistency():
+    """guess_dmstep inverts dm_smear at the same geometry."""
+    dt, bw, fctr = 6.5e-4, 322.0, 1375.0
+    ddm = ddplan.guess_dmstep(dt, bw, fctr)
+    assert abs(ddplan.dm_smear(ddm, bw, fctr) - dt) < 1e-12
+
+
+def test_generated_plan_covers_range_and_balances_smearing():
+    obs = ddplan.Observation(dt=65.5e-6, fctr=1375.5, bw=322.6,
+                             numchan=960, blocklen=2048)
+    steps = ddplan.generate_ddplan(obs, 0.0, 1000.0, numsub=96)
+    assert steps[0].lodm == 0.0
+    assert steps[-1].hidm >= 1000.0
+    for a, b in zip(steps[:-1], steps[1:]):
+        assert abs(a.hidm - b.lodm) < 1e-9
+        assert b.downsamp >= a.downsamp
+        assert b.dmstep >= a.dmstep
+    # downsampling factors must divide the block length
+    for s in steps:
+        assert obs.blocklen % s.downsamp == 0
+    fr = ddplan.work_fractions(steps)
+    assert abs(fr.sum() - 1.0) < 1e-12

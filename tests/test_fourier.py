@@ -1,0 +1,126 @@
+"""Fourier search kernel tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpulsar.kernels import fourier as fr
+
+
+def _tone_series(T=16384, freq_hz=37.0, dt=1e-3, amp=0.5, ndms=3, seed=5):
+    rng = np.random.default_rng(seed)
+    t = np.arange(T) * dt
+    base = rng.standard_normal((ndms, T))
+    if ndms > 1:
+        base[1] += amp * np.sin(2 * np.pi * freq_hz * t)  # signal in row 1
+    return base.astype(np.float32), t
+
+
+def test_power_spectrum_parseval_and_dc():
+    x, _ = _tone_series(amp=0.0, ndms=1)
+    p = np.asarray(fr.power_spectrum(jnp.asarray(x)))
+    assert p[0, 0] == 0.0
+    # Parseval (real FFT): sum powers ~ T * sum x^2 / 2 for non-DC bins
+    xs = x[0] - x[0].mean()
+    lhs = p[0, 1:-1].sum() + p[0, -1] / 2
+    rhs = len(xs) * (xs ** 2).sum() / 2
+    assert abs(lhs - rhs) / rhs < 0.01
+
+
+def test_whiten_flattens_red_noise():
+    rng = np.random.default_rng(0)
+    T = 1 << 15
+    # strongly red spectrum: integrate white noise
+    red = np.cumsum(rng.standard_normal(T)).astype(np.float32)[None]
+    p = fr.power_spectrum(jnp.asarray(red))
+    w = np.asarray(fr.whiten(p))[0]
+    lo = np.median(w[10:500])
+    hi = np.median(w[-5000:])
+    # whitened medians comparable across the band (raw differ by >>10x)
+    assert 0.2 < lo / hi < 5.0
+    raw = np.asarray(p)[0]
+    assert np.median(raw[10:500]) / np.median(raw[-5000:]) > 100
+
+
+def test_whitened_noise_is_unit_exponential():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 1 << 14)).astype(np.float32)
+    w = np.asarray(fr.whiten(fr.power_spectrum(jnp.asarray(x))))
+    med = np.median(w[:, 10:])
+    assert 0.55 < med < 0.85  # exponential median = ln2 ~ 0.69
+
+
+def test_tone_detected_with_correct_bin_and_sigma():
+    dt = 1e-3
+    x, t = _tone_series(T=1 << 14, freq_hz=37.0, dt=dt, amp=0.8)
+    T_s = x.shape[1] * dt
+    res, nbins = fr.periodicity_search(jnp.asarray(x), T_s, max_numharm=1,
+                                       topk=8)
+    vals, bins = res[1]
+    best_bin = bins[1, 0]
+    expect_bin = round(37.0 * T_s)
+    assert abs(int(best_bin) - expect_bin) <= 1
+    sig_signal = fr.sigma_from_power(vals[1, 0], 1)
+    sig_noise = fr.sigma_from_power(vals[0, 0], 1)
+    assert sig_signal > 8.0
+    assert sig_signal > sig_noise + 4.0
+
+
+def test_harmonic_sum_strides():
+    p = jnp.arange(100, dtype=jnp.float32)[None]
+    s2 = np.asarray(fr.harmonic_sum(p, 2))[0]
+    # S2(r) = P(r) + P(2r)
+    for r in (3, 17, 49):
+        assert s2[r] == r + 2 * r
+
+
+def test_harmonic_summing_helps_narrow_pulses():
+    """A narrow periodic pulse train spreads power over harmonics: the
+    16-harmonic stage must yield higher summed significance than the
+    fundamental alone."""
+    rng = np.random.default_rng(2)
+    T, dt = 1 << 15, 1e-3
+    t = np.arange(T) * dt
+    period = 0.25
+    phase = (t / period) % 1.0
+    sig = (np.exp(-0.5 * ((np.minimum(phase, 1 - phase)) / 0.01) ** 2)).astype(np.float32)
+    x = (rng.standard_normal(T).astype(np.float32) + 1.2 * sig)[None]
+    res, _ = fr.periodicity_search(jnp.asarray(x), T * dt, max_numharm=16,
+                                   topk=8)
+    fund_bin = round(T * dt / period)
+    # find the candidate at the fundamental in stage 1 and stage 16
+    def power_at(stage):
+        vals, bins = res[stage]
+        hit = np.abs(bins[0] - fund_bin) <= 1
+        return vals[0][hit].max() if hit.any() else 0.0
+    s1 = fr.sigma_from_power(power_at(1), 1)
+    s16 = fr.sigma_from_power(power_at(16), 16)
+    assert s16 > s1
+
+
+def test_zap_mask(tmp_path):
+    zap = np.array([[60.0, 1.0]])
+    T_s = 100.0
+    mask = fr.zap_mask(10000, T_s, zap, baryv=0.0)
+    df = 1 / T_s
+    assert not mask[int(60.0 / df)]
+    assert mask[int(50.0 / df)]
+    # barycentric shift moves the zapped window
+    mask2 = fr.zap_mask(10000, T_s, zap, baryv=1e-3)
+    assert not mask2[int(60.0 / (1 + 1e-3) / df)]
+
+    # file parsing
+    p = tmp_path / "test.zaplist"
+    p.write_text("# comment\n60.0 1.0\n120.0 2.0  # another\n")
+    parsed = fr.parse_zaplist(str(p))
+    np.testing.assert_allclose(parsed, [[60.0, 1.0], [120.0, 2.0]])
+
+
+def test_sigma_from_power_reference_values():
+    # P(S>s)=exp(-s) for 1 harmonic: s=10 -> p=4.54e-5 -> sigma~3.91
+    assert abs(fr.sigma_from_power(10.0, 1) - 3.906) < 0.01
+    # large power must not overflow to inf
+    big = fr.sigma_from_power(1000.0, 16)
+    assert np.isfinite(big) and big > 30
+    # threshold inversion round-trips
+    thr = fr.power_threshold(6.0, 8)
+    assert abs(fr.sigma_from_power(thr, 8) - 6.0) < 1e-3

@@ -1,0 +1,59 @@
+"""RFI mask kernel tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpulsar.io import synth
+from tpulsar.kernels import rfi
+
+
+def test_clean_data_mostly_unmasked():
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((8192, 16)).astype(np.float32)
+    mask = rfi.find_rfi(data, dt=1e-3, block_len=512)
+    assert mask.masked_fraction < 0.05
+    assert not mask.bad_channels.any()
+
+
+def test_tone_channel_flagged():
+    spec = synth.BeamSpec(nchan=16, nsamp=8192, nsblk=64)
+    data = synth.make_dynamic_spectrum(
+        spec, rfi=[synth.RFISpec(kind="tone", channel=5, amplitude=4.0)])
+    mask = rfi.find_rfi(data, dt=spec.tsamp_s, block_len=512)
+    assert mask.bad_channels[5]
+    assert mask.bad_channels.sum() <= 2
+
+
+def test_burst_blocks_flagged():
+    spec = synth.BeamSpec(nchan=16, nsamp=8192, nsblk=64)
+    t0 = 2000 * spec.tsamp_s
+    data = synth.make_dynamic_spectrum(
+        spec, rfi=[synth.RFISpec(kind="burst", t_start_s=t0,
+                                 t_len_s=600 * spec.tsamp_s, amplitude=3.0)])
+    mask = rfi.find_rfi(data, dt=spec.tsamp_s, block_len=512)
+    burst_blocks = range(2000 // 512, (2000 + 600) // 512 + 1)
+    assert any(mask.bad_blocks[b] for b in burst_blocks)
+
+
+def test_apply_mask_replaces_bad_cells():
+    rng = np.random.default_rng(1)
+    data = rng.standard_normal((4096, 8)).astype(np.float32)
+    data[1024:1536, 3] += 50.0
+    mask = rfi.find_rfi(data, dt=1e-3, block_len=512)
+    assert mask.cell_mask[2, 3] or mask.bad_channels[3]
+    cleaned = np.asarray(rfi.apply_mask(
+        jnp.asarray(data), jnp.asarray(mask.full_mask()), 512))
+    assert abs(cleaned[1024:1536, 3].mean()) < 1.0  # spike removed
+    # untouched cells unchanged
+    np.testing.assert_allclose(cleaned[:512, 0], data[:512, 0])
+
+
+def test_mask_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    data = rng.standard_normal((2048, 8)).astype(np.float32)
+    mask = rfi.find_rfi(data, dt=1e-3, block_len=256)
+    p = str(tmp_path / "beam_rfi.npz")
+    mask.save(p)
+    back = rfi.RFIMask.load(p)
+    np.testing.assert_array_equal(back.cell_mask, mask.cell_mask)
+    assert back.block_len == 256

@@ -1,0 +1,79 @@
+"""Sifting + accelcands format tests."""
+
+import numpy as np
+
+from tpulsar.io import accelcands
+from tpulsar.search import sifting
+
+
+def _cand(r, sigma, dm, numharm=1, z=0.0, T_s=100.0, hits=None):
+    f = r / T_s
+    c = sifting.Candidate(r=r, z=z, sigma=sigma, power=sigma ** 2,
+                          numharm=numharm, dm=dm, period_s=1 / f, freq_hz=f)
+    c.dm_hits = hits or []
+    return c
+
+
+def test_duplicate_removal_merges_dms():
+    cands = [_cand(1000.0, 8.0, 50.0), _cand(1000.4, 7.0, 52.0),
+             _cand(1000.2, 6.0, 48.0), _cand(2000.0, 9.0, 50.0)]
+    out = sifting.remove_duplicates(cands, sifting.SiftParams())
+    assert len(out) == 2
+    best = [c for c in out if abs(c.r - 1000.0) < 2][0]
+    assert best.sigma == 8.0
+    assert best.num_dm_hits == 3
+
+
+def test_dm_problems_rejected():
+    params = sifting.SiftParams(min_num_dms=2, low_dm_cutoff=2.0)
+    # only one DM hit -> rejected
+    c1 = _cand(1000.0, 8.0, 50.0, hits=[(50.0, 8.0)])
+    # peaks at DM 0 -> RFI-like -> rejected
+    c2 = _cand(1200.0, 8.0, 0.0, hits=[(0.0, 8.0), (1.0, 6.0)])
+    # good: many hits peaking at DM 50
+    c3 = _cand(1400.0, 8.0, 50.0,
+               hits=[(48.0, 6.0), (50.0, 8.0), (52.0, 6.5)])
+    out = sifting.remove_dm_problems([c1, c2, c3], params)
+    assert [c.r for c in out] == [1400.0]
+
+
+def test_harmonic_rejection():
+    strong = _cand(1000.0, 12.0, 50.0)
+    harm2 = _cand(2000.3, 6.0, 50.0)    # 2nd harmonic (within tol)
+    harm_half = _cand(500.1, 5.5, 50.0)  # 1/2 subharmonic
+    unrelated = _cand(1731.0, 7.0, 50.0)
+    out = sifting.remove_harmonics([strong, harm2, harm_half, unrelated],
+                                   sifting.SiftParams())
+    rs = {c.r for c in out}
+    assert 1000.0 in rs and 1731.0 in rs
+    assert 2000.3 not in rs and 500.1 not in rs
+
+
+def test_full_sift_and_thresholds():
+    params = sifting.SiftParams(sigma_threshold=6.0)
+    cands = [
+        _cand(1000.0, 9.0, 50.0), _cand(1000.3, 8.0, 55.0),
+        _cand(1000.1, 7.0, 45.0),
+        _cand(3000.0, 5.0, 20.0),   # below sigma threshold
+    ]
+    out = sifting.sift(cands, params)
+    assert len(out) == 1
+    assert out[0].sigma == 9.0
+    assert out[0].num_dm_hits == 3
+
+
+def test_candlist_roundtrip(tmp_path):
+    cands = [_cand(1000.0, 9.0, 50.0,
+                   hits=[(45.0, 7.0), (50.0, 9.0)]),
+             _cand(500.0, 6.5, 120.0, numharm=4, z=12.0,
+                   hits=[(120.0, 6.5)])]
+    p = str(tmp_path / "beam.accelcands")
+    accelcands.write_candlist(cands, p)
+    back = accelcands.parse_candlist(p)
+    assert len(back) == 2
+    assert abs(back[0].r - 1000.0) < 0.01
+    assert abs(back[0].sigma - 9.0) < 0.01
+    assert back[0].dm_hits == [(45.0, 7.0), (50.0, 9.0)]
+    assert back[1].numharm == 4
+    assert abs(back[1].z - 12.0) < 0.01
+    assert abs(back[1].period_s - cands[1].period_s) < 1e-9

@@ -19,3 +19,14 @@ SECPERDAY = 86400.0
 
 # Speed of light, m/s (used by barycentric velocity estimates).
 C_MS = 299792458.0
+
+
+def dispersion_delay_s(dm, freqs_mhz, ref_mhz):
+    """Cold-plasma dispersion delay (s) of each frequency relative to
+    ref_mhz; positive for freqs below the reference.  The single
+    source of truth for the delay convention — synth, kernels, and
+    planning all import this."""
+    import numpy as np
+
+    return KDM * np.asarray(dm) * (np.asarray(freqs_mhz, dtype=np.float64)
+                                   ** -2.0 - float(ref_mhz) ** -2.0)

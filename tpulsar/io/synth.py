@@ -18,7 +18,7 @@ import os
 import numpy as np
 
 from tpulsar.astro import angles, times
-from tpulsar.constants import KDM
+from tpulsar.constants import dispersion_delay_s
 from tpulsar.io import fitscore
 
 
@@ -76,7 +76,7 @@ def channel_freqs(spec: BeamSpec) -> np.ndarray:
 def dispersion_delays(dm: float, freqs_mhz: np.ndarray,
                       ref_freq_mhz: float) -> np.ndarray:
     """Dispersion delay (s) of each channel relative to ref_freq."""
-    return KDM * dm * (freqs_mhz ** -2 - ref_freq_mhz ** -2)
+    return dispersion_delay_s(dm, freqs_mhz, ref_freq_mhz)
 
 
 def make_dynamic_spectrum(spec: BeamSpec,

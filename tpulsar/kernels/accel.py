@@ -1,0 +1,191 @@
+"""Fourier-domain acceleration search on TPU.
+
+Replaces PRESTO's `accelsearch -zmax Z -numharm N` (reference
+invocations: lib/python/PALFA2_presto_search.py:561-585; config:
+lib/python/config/searching_example.py:16-27).
+
+Method (the standard correlation technique): a pulsar with constant
+frequency drift zdot smears its power over ~z Fourier bins (z = drift
+in bins over the observation).  Sensitivity is recovered by
+correlating the complex spectrum with a bank of z-response templates
+(discrete chirp responses), producing a (z, r) power plane per DM
+trial.  Harmonic summing over the plane (h*r, h*z) yields the summed
+powers the candidate sigma is computed from.
+
+TPU realization: templates are generated host-side once per (zmax,
+segment) signature as an FFT-domain bank; the correlation runs as
+overlap-save — segment FFTs of the spectrum, a broadcast complex
+multiply against all templates at once, and a batched inverse FFT.
+Everything is statically shaped and jit-compiled; the DM axis rides
+the same sharding as dedispersion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DZ = 2.0  # z-plane step in bins (PRESTO's accelsearch grid spacing)
+
+
+def z_grid(zmax: float) -> np.ndarray:
+    """Symmetric z values searched: -zmax..zmax step DZ (0 included)."""
+    n = int(round(zmax / DZ))
+    return np.arange(-n, n + 1) * DZ
+
+
+def gen_z_response(z: float, width: int) -> np.ndarray:
+    """Complex frequency-domain response of a unit-amplitude signal
+    drifting linearly by `z` bins, sampled at integer bin offsets.
+
+    Computed numerically: DFT of the discrete chirp
+    exp(2*pi*i*(c*n/N + z*n^2/(2*N^2))) for a long N, then the bins
+    around the centroid are extracted.  The result depends only on z
+    (in bins), not on N, for N >> width.
+    """
+    N = 1 << 14
+    c = N // 4
+    n = np.arange(N)
+    phase = 2 * np.pi * (c * n / N + 0.5 * z * (n / N) ** 2)
+    chirp = np.exp(1j * phase)
+    spec = np.fft.fft(chirp) / N
+    # The response is centered on the *mean* frequency c + z/2.
+    center = int(round(c + z / 2))
+    lo = center - width // 2
+    resp = spec[lo:lo + width]
+    return np.asarray(resp, dtype=np.complex64)
+
+
+def template_width(zmax: float) -> int:
+    """Template length in bins: covers the drift plus Fresnel ringing."""
+    w = int(2 * np.ceil(abs(zmax) / 2) + 32)
+    return int(2 ** np.ceil(np.log2(w)))
+
+
+@dataclasses.dataclass(frozen=True)
+class TemplateBank:
+    """FFT-domain z-response bank for overlap-save correlation."""
+    zs: tuple[float, ...]
+    width: int          # template length in bins
+    seg: int            # segment FFT length
+    step: int           # valid output bins per segment (seg - width)
+    bank_fft: np.ndarray  # (nz, seg) complex64 — conj already applied
+
+
+def build_template_bank(zmax: float, seg: int = 1 << 13) -> TemplateBank:
+    zs = z_grid(zmax)
+    width = template_width(zmax)
+    if seg <= 2 * width:
+        raise ValueError("segment too short for template width")
+    bank = np.zeros((len(zs), seg), dtype=np.complex64)
+    for i, z in enumerate(zs):
+        resp = gen_z_response(float(z), width)
+        # matched filter: correlate with conj response
+        bank[i, :width] = np.conj(resp)[::-1]
+    bank_fft = np.fft.fft(bank, axis=-1).astype(np.complex64)
+    return TemplateBank(zs=tuple(float(z) for z in zs), width=width,
+                        seg=seg, step=seg - width, bank_fft=bank_fft)
+
+
+@partial(jax.jit, static_argnames=("seg", "step", "width"))
+def _correlate_segments(spectrum: jnp.ndarray, bank_fft: jnp.ndarray,
+                        seg: int, step: int, width: int) -> jnp.ndarray:
+    """Overlap-save correlation of one complex spectrum with the bank.
+
+    spectrum: (nbins,) complex64.  Returns (nz, nvalid) float32 powers,
+    nvalid = nsegs * step, plane bin r corresponds to spectrum bin r.
+    """
+    nbins = spectrum.shape[0]
+    nsegs = max(1, -(-nbins // step))  # ceil: cover every spectrum bin
+    # Zero-pad so every segment slice is in range (top bins would
+    # otherwise be silently unsearched).
+    padded = jnp.pad(spectrum, (0, nsegs * step + seg - nbins))
+    starts = jnp.arange(nsegs) * step
+
+    def one_seg(s0):
+        seg_data = jax.lax.dynamic_slice(padded, (s0,), (seg,))
+        f = jnp.fft.fft(seg_data)
+        corr = jnp.fft.ifft(f[None, :] * bank_fft, axis=-1)
+        # Circular==linear convolution only for output n >= width-1;
+        # there, out[n] = sum_m S[s0 + (n-width+1) + m] conj(resp[m]).
+        return jnp.abs(corr[:, width - 1: width - 1 + step]) ** 2
+
+    planes = jax.lax.map(one_seg, starts)          # (nsegs, nz, step)
+    plane = jnp.transpose(planes, (1, 0, 2)).reshape(
+        bank_fft.shape[0], nsegs * step)
+    # A signal at spectrum bin b peaks at template center m=width//2,
+    # i.e. at raw plane index b - width//2.  Left-pad so that plane
+    # index == spectrum bin (required for harmonic-sum alignment),
+    # then truncate to the true spectrum length.
+    plane = jnp.pad(plane, ((0, 0), (width // 2, 0)))[:, :nbins]
+    return plane
+
+
+def _zero_z_index(bank: TemplateBank) -> int:
+    return int(np.argmin(np.abs(np.asarray(bank.zs))))
+
+
+@partial(jax.jit, static_argnames=("numharm", "nz"))
+def _harmonic_sum_plane(plane: jnp.ndarray, numharm: int, nz: int) -> jnp.ndarray:
+    """Sum (h*r, h*z) over harmonics h=1..numharm.
+
+    plane: (nz, nr) powers.  z index mapping: zi -> center + h*(zi-center)
+    clamped to the grid; r mapping via strided gather.
+    """
+    center = (nz - 1) // 2
+    nr = plane.shape[1]
+    L = nr // numharm
+    acc = plane[:, :L]
+    for h in range(2, numharm + 1):
+        zi = jnp.arange(nz)
+        zi_h = jnp.clip(center + (zi - center) * h, 0, nz - 1)
+        rows = plane[zi_h]                 # (nz, nr) rows at harmonic z
+        acc = acc + rows[:, ::h][:, :L]
+    return acc
+
+
+def accel_search_one(spectrum: np.ndarray | jnp.ndarray, bank: TemplateBank,
+                     max_numharm: int = 8, topk: int = 64):
+    """Acceleration search of one whitened complex spectrum.
+
+    Returns list of (numharm, power, r_bin, z_value) candidate arrays:
+    dict stage -> (powers[topk], rbins[topk], zvals[topk]).
+    """
+    from tpulsar.kernels.fourier import harmonic_stages
+
+    plane = _correlate_segments(jnp.asarray(spectrum, jnp.complex64),
+                                jnp.asarray(bank.bank_fft),
+                                bank.seg, bank.step, bank.width)
+    nz = len(bank.zs)
+    out = {}
+    for h in harmonic_stages(max_numharm):
+        summed = _harmonic_sum_plane(plane, h, nz)      # (nz, L)
+        # Local-max suppression along r: one blob (a strong signal's
+        # response skirt) must not flood every top-k slot.
+        left = jnp.pad(summed[:, :-1], ((0, 0), (1, 0)))
+        right = jnp.pad(summed[:, 1:], ((0, 0), (0, 1)))
+        summed = jnp.where((summed >= left) & (summed > right), summed, 0.0)
+        flat = summed.reshape(-1)
+        vals, idx = jax.lax.top_k(flat, topk)
+        vals = np.asarray(vals)
+        idx = np.asarray(idx)
+        L = summed.shape[1]
+        zi, r = np.divmod(idx, L)
+        zvals = np.asarray(bank.zs)[zi]
+        out[h] = (vals, r, zvals)
+    return out
+
+
+def normalize_spectrum(spectrum: jnp.ndarray) -> jnp.ndarray:
+    """Scale a complex spectrum so |X|^2 of noise has unit mean, using
+    the whitening level from the power spectrum (median/ln2)."""
+    from tpulsar.kernels.fourier import whiten
+
+    powers = jnp.abs(spectrum) ** 2
+    white = whiten(powers)
+    scale = jnp.sqrt(white / jnp.maximum(powers, 1e-30))
+    return spectrum * scale.astype(spectrum.dtype)

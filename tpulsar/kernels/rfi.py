@@ -1,0 +1,128 @@
+"""RFI detection and masking on TPU.
+
+Replaces PRESTO's rfifind (reference invocation:
+lib/python/PALFA2_presto_search.py:482-485): the dynamic spectrum is
+cut into (time-block, channel) cells; per-cell statistics (mean,
+standard deviation, max Fourier power) are computed in one jitted
+pass, robust z-scores flag outlier cells, and rows/columns whose bad
+fraction exceeds a threshold are zapped entirely.  The result is an
+RFIMask the dedispersion kernel consumes by replacing masked cells
+with their channel's median level.
+
+The block length mirrors rfifind's `-time` parameter (reference
+config: lib/python/config/searching_example.py rfifind_chunk_time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class RFIMask:
+    """Mask over (nblocks, nchan) cells plus fully-zapped channels and
+    time intervals. Serializable to .npz (the reference writes PRESTO's
+    binary .mask; ours is an equivalent artifact)."""
+    block_len: int
+    dt: float
+    cell_mask: np.ndarray        # (nblocks, nchan) bool — True = bad
+    bad_channels: np.ndarray     # (nchan,) bool
+    bad_blocks: np.ndarray       # (nblocks,) bool
+
+    @property
+    def masked_fraction(self) -> float:
+        full = (self.cell_mask | self.bad_channels[None, :]
+                | self.bad_blocks[:, None])
+        return float(full.mean())
+
+    def full_mask(self) -> np.ndarray:
+        return (self.cell_mask | self.bad_channels[None, :]
+                | self.bad_blocks[:, None])
+
+    def save(self, path: str) -> None:
+        np.savez_compressed(path, block_len=self.block_len, dt=self.dt,
+                            cell_mask=self.cell_mask,
+                            bad_channels=self.bad_channels,
+                            bad_blocks=self.bad_blocks)
+
+    @classmethod
+    def load(cls, path: str) -> "RFIMask":
+        z = np.load(path)
+        return cls(block_len=int(z["block_len"]), dt=float(z["dt"]),
+                   cell_mask=z["cell_mask"], bad_channels=z["bad_channels"],
+                   bad_blocks=z["bad_blocks"])
+
+
+@partial(jax.jit, static_argnames=("block_len",))
+def cell_stats(data: jnp.ndarray, block_len: int):
+    """(T, nchan) -> per-cell (mean, std, max FFT power) with cells of
+    block_len samples: each output is (nblocks, nchan)."""
+    T, nchan = data.shape
+    nblocks = T // block_len
+    cells = data[: nblocks * block_len].reshape(nblocks, block_len, nchan)
+    mean = cells.mean(axis=1)
+    std = cells.std(axis=1)
+    spec = jnp.fft.rfft(cells - mean[:, None, :], axis=1)
+    maxpow = (jnp.abs(spec[:, 1:, :]) ** 2).max(axis=1) / jnp.maximum(
+        block_len * cells.var(axis=1), 1e-9)
+    return mean, std, maxpow
+
+
+def _robust_z(x: np.ndarray, axis: int = 0) -> np.ndarray:
+    """z-scores from median/MAD along an axis (outlier-resistant)."""
+    med = np.median(x, axis=axis, keepdims=True)
+    mad = np.median(np.abs(x - med), axis=axis, keepdims=True)
+    return (x - med) / np.maximum(1.4826 * mad, 1e-9)
+
+
+def find_rfi(data: np.ndarray | jnp.ndarray, dt: float,
+             block_len: int = 2048, threshold: float = 4.0,
+             chan_frac: float = 0.3, block_frac: float = 0.3) -> RFIMask:
+    """Compute an RFIMask for a (T, nchan) dynamic spectrum.
+
+    A cell is bad if any of its robust z-scores (mean / std / max
+    Fourier power, each standardized per-channel across time) exceeds
+    `threshold`.  Channels (blocks) with more than `chan_frac`
+    (`block_frac`) bad cells are zapped entirely — the same
+    recommended-channel/interval semantics as rfifind's mask.
+    """
+    mean, std, maxpow = cell_stats(jnp.asarray(data, jnp.float32), block_len)
+    mean, std, maxpow = (np.asarray(x) for x in (mean, std, maxpow))
+
+    # Standardize each statistic both across time (catches bursts: a
+    # block that deviates from its channel's history) and across
+    # channels (catches persistent tones: a channel that deviates from
+    # the band in every block).
+    zs = np.stack([np.abs(_robust_z(s, axis=ax))
+                   for s in (mean, std, maxpow) for ax in (0, 1)])
+    cell_mask = (zs > threshold).any(axis=0)
+
+    bad_channels = cell_mask.mean(axis=0) > chan_frac
+    bad_blocks = cell_mask.mean(axis=1) > block_frac
+    return RFIMask(block_len=block_len, dt=dt, cell_mask=cell_mask,
+                   bad_channels=bad_channels, bad_blocks=bad_blocks)
+
+
+@partial(jax.jit, static_argnames=("block_len",))
+def apply_mask(data: jnp.ndarray, cell_mask: jnp.ndarray,
+               block_len: int) -> jnp.ndarray:
+    """Replace masked cells of (T, nchan) data with the per-channel
+    median of unmasked samples (computed over block means for cost)."""
+    T, nchan = data.shape
+    nblocks = cell_mask.shape[0]
+    usable = nblocks * block_len
+    cells = data[:usable].reshape(nblocks, block_len, nchan)
+    cmeans = cells.mean(axis=1)
+    good = ~cell_mask
+    denom = jnp.maximum(good.sum(axis=0), 1)
+    fill = (jnp.where(good, cmeans, 0.0).sum(axis=0) / denom)  # (nchan,)
+    filled = jnp.where(cell_mask[:, None, :], fill[None, None, :], cells)
+    out = filled.reshape(usable, nchan)
+    if usable < T:
+        out = jnp.concatenate([out, data[usable:]], axis=0)
+    return out

@@ -1,0 +1,11 @@
+"""Dedispersion planning: smearing-balanced DM steps and survey plans."""
+
+from tpulsar.plan.ddplan import (  # noqa: F401
+    DedispPass,
+    DedispStep,
+    Observation,
+    dm_smear,
+    generate_ddplan,
+    guess_dmstep,
+    survey_plan,
+)

@@ -1,0 +1,243 @@
+"""Dedispersion plan computation and survey plans.
+
+Covers both planning modes of the reference:
+  * on-demand smearing-balanced plan generation (reference:
+    lib/python/DDplan2b.py:99-324) — choose DM step sizes and
+    downsampling factors so that no single smearing source dominates;
+  * the hardcoded PALFA survey plans actually used in production
+    (reference: lib/python/PALFA2_presto_search.py:296-331).
+
+A plan is a list of DedispStep blocks; each step fixes (dm step,
+downsampling, subband count) and expands into DedispPass groups — one
+pass per subband sub-DM, each with `dms_per_pass` target DMs.  These
+static shapes are exactly what the TPU kernels compile against: one
+kernel variant per (downsamp, ndms) signature.
+
+Smearing model (all in seconds):
+  * sampling:      dt, and dt*downsamp after downsampling
+  * intra-channel: dm_smear(DM, chanwidth, fctr)
+  * BW stepping:   dm_smear(dDM/2, BW, fctr)      — DM-step roundoff
+  * subband:       dm_smear(dsubDM/2, BW/numsub, fctr)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpulsar.constants import KDM
+
+
+def dm_smear(dm: float | np.ndarray, bw_mhz: float, fctr_mhz: float):
+    """Dispersive smearing time (s) across bandwidth bw at center
+    frequency fctr for dispersion measure dm."""
+    return dm * bw_mhz * 2.0 * KDM / fctr_mhz ** 3
+
+
+def guess_dmstep(dt: float, bw_mhz: float, fctr_mhz: float) -> float:
+    """DM step that makes the smearing across `bw` equal the sampling
+    time `dt` (reference: DDplan2b.py:425-435)."""
+    return dt * fctr_mhz ** 3 / (2.0 * KDM * bw_mhz)
+
+
+from tpulsar.constants import dispersion_delay_s as delay_s  # noqa: E402
+
+
+@dataclasses.dataclass(frozen=True)
+class Observation:
+    """Static observation geometry a plan is computed for."""
+    dt: float            # sampling time (s)
+    fctr: float          # center frequency (MHz)
+    bw: float            # total bandwidth (MHz)
+    numchan: int
+    blocklen: int        # spectra per subint row (downsamp must divide it)
+
+    @property
+    def chanwidth(self) -> float:
+        return self.bw / self.numchan
+
+
+@dataclasses.dataclass(frozen=True)
+class DedispPass:
+    """One subband pass: form subbands at `subdm`, then dedisperse to
+    each DM in `dms`."""
+    subdm: float
+    lodm: float
+    dms: tuple[float, ...]
+
+    @property
+    def numdms(self) -> int:
+        return len(self.dms)
+
+
+@dataclasses.dataclass(frozen=True)
+class DedispStep:
+    """A contiguous DM block with constant step size and downsampling
+    (reference dedisp_plan: PALFA2_presto_search.py:374-410)."""
+    lodm: float
+    dmstep: float
+    dms_per_pass: int
+    numpasses: int
+    numsub: int
+    downsamp: int
+
+    @property
+    def sub_dmstep(self) -> float:
+        return self.dms_per_pass * self.dmstep
+
+    @property
+    def hidm(self) -> float:
+        return self.lodm + self.numpasses * self.sub_dmstep
+
+    @property
+    def numdms(self) -> int:
+        return self.numpasses * self.dms_per_pass
+
+    def passes(self) -> list[DedispPass]:
+        out = []
+        for ii in range(self.numpasses):
+            lodm = self.lodm + ii * self.sub_dmstep
+            subdm = self.lodm + (ii + 0.5) * self.sub_dmstep
+            dms = tuple(round(lodm + k * self.dmstep, 6)
+                        for k in range(self.dms_per_pass))
+            out.append(DedispPass(subdm=round(subdm, 6), lodm=lodm, dms=dms))
+        return out
+
+    def all_dms(self) -> np.ndarray:
+        return np.concatenate([np.asarray(p.dms) for p in self.passes()])
+
+
+# --------------------------------------------------------------- survey plans
+
+# Hardcoded production plans (reference: PALFA2_presto_search.py:319-331).
+#                 lodm  dmstep dms/pass passes nsub downsamp
+_PALFA_MOCK = [
+    (0.0, 0.1, 76, 28, 96, 1),
+    (212.8, 0.3, 64, 12, 96, 2),
+    (443.2, 0.3, 76, 4, 96, 3),
+    (534.4, 0.5, 76, 9, 96, 5),
+    (876.4, 0.5, 76, 3, 96, 6),
+    (990.4, 1.0, 76, 1, 96, 10),
+]
+_PALFA_WAPP = [
+    (0.0, 0.3, 76, 9, 96, 1),
+    (205.2, 2.0, 76, 5, 96, 5),
+    (965.2, 10.0, 76, 1, 96, 25),
+]
+
+
+def survey_plan(backend: str) -> list[DedispStep]:
+    """The hardcoded survey dedispersion plan for a backend ('pdev'
+    a.k.a. Mock, or 'wapp')."""
+    table = {"pdev": _PALFA_MOCK, "mock": _PALFA_MOCK, "wapp": _PALFA_WAPP}
+    key = backend.lower()
+    if key not in table:
+        raise ValueError(f"no dedispersion plan for unknown backend {backend!r}")
+    return [DedispStep(*row) for row in table[key]]
+
+
+# ------------------------------------------------------------ plan generation
+
+_SMEARFACT = 2.0
+_FUDGE = 0.8  # subband smearing must stay below 0.8x other sources
+
+
+def _allowed_downsamps(blocklen: int, max_downsamp: int = 64) -> list[int]:
+    """Downsampling factors that evenly divide the subint block length
+    (reference: DDplan2b.py:85-97)."""
+    return [d for d in range(1, max_downsamp + 1) if blocklen % d == 0]
+
+
+def _dms_per_pass(ddm: float, obs: Observation, numsub: int,
+                  eff_dt: float, bw_smear: float) -> int:
+    """Largest even DMs-per-pass whose subband smearing stays below the
+    fudge-limited budget (reference: DDplan2b.py:129-146)."""
+    dms = 2
+    while True:
+        next_dsub = (dms + 2) * ddm
+        next_ss = dm_smear(next_dsub * 0.5, obs.bw / numsub, obs.fctr)
+        if next_ss > _FUDGE * min(bw_smear, eff_dt):
+            return dms
+        dms += 2
+
+
+def generate_ddplan(obs: Observation, lodm: float, hidm: float,
+                    numsub: int = 96, resolution_ms: float = 0.0,
+                    max_downsamp: int = 64) -> list[DedispStep]:
+    """Compute a smearing-balanced dedispersion plan.
+
+    Walks up in DM from `lodm`: at each step the downsampling factor is
+    raised once the (doubled) effective time resolution stays below the
+    channel smearing, the DM step is the largest keeping the BW-step
+    smearing under the effective dt, and the step hands over to the
+    next one at the DM where intra-channel smearing dominates
+    everything else by _SMEARFACT (reference: DDplan2b.py:197-290).
+    """
+    if hidm <= lodm:
+        raise ValueError("hidm must exceed lodm")
+    downsamps = _allowed_downsamps(obs.blocklen, max_downsamp)
+    min_dt = max(resolution_ms * 1e-3, obs.dt)
+
+    steps: list[DedispStep] = []
+    dindex = 0
+    lo = lodm
+    while lo < hidm:
+        # Raise downsampling while the doubled sample time is still no
+        # worse than the channel smearing already incurred at this DM.
+        while dindex + 1 < len(downsamps):
+            next_dt = obs.dt * downsamps[dindex + 1]
+            chan_sm = dm_smear(max(lo, 1e-3), obs.chanwidth, obs.fctr)
+            if next_dt <= max(chan_sm, min_dt):
+                dindex += 1
+            else:
+                break
+        downsamp = downsamps[dindex]
+        eff_dt = obs.dt * downsamp
+
+        # Largest DM step keeping BW-step smearing below eff_dt.
+        ddm = _round_dmstep(guess_dmstep(eff_dt, obs.bw, obs.fctr))
+        bw_smear = dm_smear(ddm * 0.5, obs.bw, obs.fctr)
+
+        dms_pp = _dms_per_pass(ddm, obs, numsub, eff_dt, bw_smear)
+        sub_dmstep = dms_pp * ddm
+        sub_smear = dm_smear(sub_dmstep * 0.5, obs.bw / numsub, obs.fctr)
+
+        # DM at which channel smearing dominates by _SMEARFACT.
+        other = np.sqrt(obs.dt ** 2 + eff_dt ** 2
+                        + bw_smear ** 2 + sub_smear ** 2)
+        cross_dm = guess_dmstep(_SMEARFACT * other, obs.chanwidth, obs.fctr)
+        cross_dm = min(cross_dm, hidm)
+
+        numdms = int(np.ceil((cross_dm - lo) / ddm))
+        numpasses = max(1, int(np.ceil(numdms / dms_pp)))
+        steps.append(DedispStep(lodm=round(lo, 6), dmstep=ddm,
+                                dms_per_pass=dms_pp, numpasses=numpasses,
+                                numsub=numsub, downsamp=downsamp))
+        lo = steps[-1].hidm
+        if dindex + 1 < len(downsamps):
+            dindex += 1
+    return steps
+
+
+def _round_dmstep(ddm: float) -> float:
+    """Snap a DM step to a human-friendly value (0.01/0.02/0.03/0.05
+    ladder), as the classic planner does."""
+    nice = np.array([1.0, 2.0, 3.0, 5.0])
+    if ddm <= 0:
+        return 0.01
+    exp = np.floor(np.log10(ddm))
+    mant = ddm / 10 ** exp
+    snapped = nice[nice <= mant + 1e-9].max() if np.any(nice <= mant + 1e-9) else 1.0
+    return float(snapped * 10 ** exp)
+
+
+def total_dm_trials(steps: list[DedispStep]) -> int:
+    return sum(s.numdms for s in steps)
+
+
+def work_fractions(steps: list[DedispStep]) -> np.ndarray:
+    """Relative search work per step ~ numDMs / downsamp (reference:
+    DDplan2b.py:266-267)."""
+    w = np.array([s.numdms / s.downsamp for s in steps], dtype=float)
+    return w / w.sum()

@@ -1,0 +1,1 @@
+"""Search layer: per-beam executor, candidate sifting, reports."""

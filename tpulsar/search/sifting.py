@@ -1,0 +1,164 @@
+"""Candidate sifting: merge per-DM candidate lists into a final ranked
+candidate list.
+
+Host-side NumPy reimplementation of the behaviors the reference gets
+from PRESTO's sifting module (used at
+lib/python/PALFA2_presto_search.py:646-669 with thresholds from
+lib/python/config/searching_example.py:33-49):
+
+  * duplicate removal: the same Fourier bin (within r_err) found at
+    many DMs is one candidate — keep the most significant hit, record
+    the others as DM hits;
+  * DM-problem rejection: candidates detected at fewer than
+    min_num_DMs distinct DMs, or whose best DM is below
+    low_DM_cutoff, are discarded as noise/RFI;
+  * harmonic rejection: candidates whose frequency is an integer (or
+    simple fraction) multiple of a stronger candidate's are flagged
+    as harmonics and removed;
+  * sigma threshold and final sigma-descending sort.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Candidate:
+    """One periodicity candidate (fundamental)."""
+    r: float                 # Fourier bin of the fundamental
+    z: float                 # drift in bins (0 for zero-accel search)
+    sigma: float
+    power: float             # summed power
+    numharm: int
+    dm: float
+    period_s: float
+    freq_hz: float
+    dm_hits: list[tuple[float, float]] = dataclasses.field(default_factory=list)
+    # (dm, sigma) of every detection of this candidate
+
+    @property
+    def num_dm_hits(self) -> int:
+        return len(self.dm_hits)
+
+
+@dataclasses.dataclass
+class SiftParams:
+    """Thresholds (defaults = reference searching config values,
+    lib/python/config/searching_example.py:33-49)."""
+    sigma_threshold: float = 4.0
+    r_err: float = 1.1            # bins within which cands are duplicates
+    min_num_dms: int = 2
+    low_dm_cutoff: float = 2.0
+    harm_frac_tol: float = 0.001  # fractional tolerance for harmonic ratios
+    max_harm: int = 16
+    short_period_s: float = 0.0005
+    long_period_s: float = 15.0
+
+
+def make_candidates(stage_results: dict, dms: np.ndarray, T_s: float,
+                    sigma_fn) -> list[Candidate]:
+    """Flatten per-stage top-k device output into Candidate objects.
+
+    stage_results: {numharm: (powers[ndms, k], bins[ndms, k])}
+    sigma_fn(power, numharm) -> sigma.
+    """
+    cands: list[Candidate] = []
+    dms = np.atleast_1d(dms)
+    for numharm, (powers, bins) in stage_results.items():
+        sig = sigma_fn(powers, numharm)
+        ndms, k = powers.shape
+        for di in range(ndms):
+            for j in range(k):
+                r = float(bins[di, j])
+                if r < 1 or powers[di, j] <= 0:
+                    continue
+                f = r / T_s
+                cands.append(Candidate(
+                    r=r, z=0.0, sigma=float(sig[di, j]),
+                    power=float(powers[di, j]), numharm=numharm,
+                    dm=float(dms[di]), period_s=1.0 / f, freq_hz=f))
+    return cands
+
+
+def remove_duplicates(cands: list[Candidate],
+                      params: SiftParams) -> list[Candidate]:
+    """Merge detections of the same (r, z) across DMs and harmonic
+    stages; keep the best-sigma representative with its DM-hit list."""
+    cands = sorted(cands, key=lambda c: -c.sigma)
+    kept: list[Candidate] = []
+    for c in cands:
+        merged = False
+        for k in kept:
+            if abs(c.r - k.r) < params.r_err and abs(c.z - k.z) <= 2.0:
+                k.dm_hits.append((c.dm, c.sigma))
+                merged = True
+                break
+        if not merged:
+            c.dm_hits = [(c.dm, c.sigma)]
+            kept.append(c)
+    return kept
+
+
+def remove_dm_problems(cands: list[Candidate],
+                       params: SiftParams) -> list[Candidate]:
+    """Reject candidates not confirmed across DM space (reference
+    semantics: sifting.remove_DM_problems with min_num_DMs and
+    low_DM_cutoff)."""
+    out = []
+    for c in cands:
+        distinct_dms = {round(dm, 3) for dm, _ in c.dm_hits}
+        if len(distinct_dms) < params.min_num_dms:
+            continue
+        best_dm = max(c.dm_hits, key=lambda h: h[1])[0]
+        if best_dm < params.low_dm_cutoff:
+            continue
+        out.append(c)
+    return out
+
+
+def remove_harmonics(cands: list[Candidate],
+                     params: SiftParams) -> list[Candidate]:
+    """Remove candidates harmonically related to stronger ones.
+
+    Checks integer ratios a/b for a,b <= max_harm: if f_weak ~
+    (a/b)*f_strong within tolerance, the weaker is dropped."""
+    cands = sorted(cands, key=lambda c: -c.sigma)
+    kept: list[Candidate] = []
+    for c in cands:
+        is_harm = False
+        for k in kept:
+            ratio = c.freq_hz / k.freq_hz
+            for b in range(1, params.max_harm + 1):
+                a = ratio * b
+                a_round = round(a)
+                if a_round < 1 or a_round > params.max_harm:
+                    continue
+                if abs(a - a_round) / b < params.harm_frac_tol * max(1.0, ratio):
+                    is_harm = True
+                    break
+            if is_harm:
+                break
+        if not is_harm:
+            kept.append(c)
+    return kept
+
+
+def apply_thresholds(cands: list[Candidate],
+                     params: SiftParams) -> list[Candidate]:
+    return [c for c in cands
+            if c.sigma >= params.sigma_threshold
+            and params.short_period_s <= c.period_s <= params.long_period_s]
+
+
+def sift(cands: list[Candidate], params: SiftParams | None = None
+         ) -> list[Candidate]:
+    """Full sifting chain -> final candidates, sigma-descending."""
+    params = params or SiftParams()
+    cands = apply_thresholds(cands, params)
+    cands = remove_duplicates(cands, params)
+    cands = remove_dm_problems(cands, params)
+    cands = remove_harmonics(cands, params)
+    return sorted(cands, key=lambda c: -c.sigma)
