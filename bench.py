@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""tpulsar benchmark: full PALFA Mock survey-plan search of one beam.
+
+Measures the headline metric from BASELINE.json: wall-clock to search
+one Mock-spectrometer-scale beam (960 channels, ~4.3 min at 65.5 us)
+over the full hardcoded survey dedispersion plan (6 steps, 57 passes,
+1272 DM trials — reference: PALFA2_presto_search.py:319-326) including
+RFI masking, subbanding, dedispersion, single-pulse search, rfft +
+whitening + 16-harmonic summing, zmax=50 acceleration search, sifting,
+and folding of the top candidates.
+
+The reference's implicit baseline is hours per beam on one CPU core
+(walltime heuristic 50 h/GB, moab.py:14); the driver-defined target is
+60 s (BASELINE.md).  vs_baseline = target_seconds / measured_seconds
+(>1 means faster than target).
+
+Environment knobs:
+  TPULSAR_BENCH_SCALE   fraction of the full beam length (default 1.0)
+  TPULSAR_BENCH_ACCEL   "0" to skip the zmax>0 acceleration stage
+  TPULSAR_BENCH_DTYPE   device block dtype: uint8 (default) | bfloat16
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, ".jax_cache"))
+sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+
+TARGET_SECONDS = 60.0   # BASELINE.json north-star target (v5e-4)
+
+NCHAN = 960
+TSAMP = 65.476e-6
+# divisible by every plan downsamp (1,2,3,5,6,10) and a rich 2^k factor
+T_FULL = 3_932_160      # ~257 s observation
+FCTR, BW = 1375.5, 322.617
+
+P_TRUE, DM_TRUE = 0.012345, 250.0
+
+
+def make_block(nsamp: int, seed: int = 42) -> np.ndarray:
+    """(nchan, nsamp) uint8 beam: noise + one injected pulsar.
+
+    Generated channel-chunked so host memory stays ~O(chunk)."""
+    from tpulsar.constants import dispersion_delay_s
+
+    rng = np.random.default_rng(seed)
+    out = np.empty((NCHAN, nsamp), dtype=np.uint8)
+    freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
+    delays = dispersion_delay_s(DM_TRUE, freqs, freqs[-1])
+    t = np.arange(nsamp) * TSAMP
+    for c0 in range(0, NCHAN, 64):
+        c1 = min(NCHAN, c0 + 64)
+        noise = rng.normal(8.0, 2.0, size=(c1 - c0, nsamp))
+        for c in range(c0, c1):
+            phase = ((t - delays[c]) / P_TRUE) % 1.0
+            dph = np.minimum(phase, 1 - phase)
+            noise[c - c0] += 1.0 * np.exp(-0.5 * (dph / 0.02) ** 2)
+        out[c0:c1] = np.clip(np.round(noise), 0, 15).astype(np.uint8)
+    return out
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:
+        pass
+
+    from tpulsar.kernels import rfi as rfi_k
+    from tpulsar.plan import ddplan
+    from tpulsar.search import executor
+
+    scale = float(os.environ.get("TPULSAR_BENCH_SCALE", "1.0"))
+    run_accel = os.environ.get("TPULSAR_BENCH_ACCEL", "1") != "0"
+    dtype = os.environ.get("TPULSAR_BENCH_DTYPE", "uint8")
+
+    nsamp = int(T_FULL * scale)
+    nsamp -= nsamp % 30720  # keep divisibility by all downsamps
+    block = make_block(nsamp)
+    freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
+    plan = ddplan.survey_plan("pdev")
+    if scale < 0.999:
+        # shrink passes proportionally for smoke runs
+        plan = [ddplan.DedispStep(s.lodm, s.dmstep, s.dms_per_pass,
+                                  max(1, int(s.numpasses * scale)),
+                                  s.numsub, s.downsamp) for s in plan]
+    params = executor.SearchParams(run_hi_accel=run_accel,
+                                   max_cands_to_fold=20)
+
+    dev_dtype = jnp.uint8 if dtype == "uint8" else jnp.bfloat16
+    data = jnp.asarray(block).astype(dev_dtype)
+    data.block_until_ready()
+    del block
+
+    t0 = time.time()
+    mask = rfi_k.find_rfi(data.T, TSAMP, block_len=2048)
+    data = rfi_k.apply_mask(data.T, jnp.asarray(mask.full_mask()), 2048).T
+    data.block_until_ready()
+
+    cands, folded, sp_events, ntrials = executor.search_block(
+        data, freqs, TSAMP, plan, params)
+    elapsed = time.time() - t0
+
+    found = any(
+        min(abs(c.period_s / P_TRUE - r) for r in (1.0, 0.5, 2.0)) < 0.01
+        and abs(c.dm - DM_TRUE) < 10.0
+        for c in cands[:10])
+
+    result = {
+        "metric": "mock_beam_full_plan_search_wallclock",
+        "value": round(elapsed, 2),
+        "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / elapsed, 3),
+        "dm_trials": ntrials,
+        "dm_trials_per_sec": round(ntrials / elapsed, 1),
+        "candidates": len(cands),
+        "injected_pulsar_recovered": bool(found),
+        "accel_stage": run_accel,
+        "nsamp": nsamp,
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

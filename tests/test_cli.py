@@ -1,0 +1,75 @@
+"""CLI tests (hermetic, via the Python entry points)."""
+
+import os
+import warnings
+
+import pytest
+
+from tpulsar.cli.main import main
+from tpulsar.io import synth
+
+warnings.filterwarnings("ignore", message="low channel changes")
+
+
+@pytest.fixture(autouse=True)
+def _iso_config(tmp_path, monkeypatch):
+    """Isolated config so CLI commands never touch shared paths."""
+    from tpulsar.config import TpulsarConfig, set_settings
+
+    cfg = TpulsarConfig()
+    cfg.basic.log_dir = str(tmp_path / "logs")
+    cfg.background.jobtracker_db = str(tmp_path / "jt.db")
+    cfg.download.datadir = str(tmp_path / "raw")
+    cfg.processing.base_working_directory = str(tmp_path / "work")
+    cfg.processing.base_results_directory = str(tmp_path / "res")
+    cfg.resultsdb.url = str(tmp_path / "results.db")
+    cfg.check_sanity(create_dirs=True)
+    set_settings(cfg)
+    yield cfg
+    set_settings(TpulsarConfig())
+
+
+def test_init_db_and_status(tmp_path, capsys):
+    db = str(tmp_path / "t.db")
+    assert main(["--db", db, "init-db"]) == 0
+    assert os.path.exists(db)
+    assert main(["--db", db, "status"]) == 0
+    out = capsys.readouterr().out
+    assert "jobs" in out and "files" in out
+
+
+def test_add_files_and_show(tmp_path, capsys):
+    db = str(tmp_path / "t.db")
+    spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64)
+    fns = synth.synth_beam(str(tmp_path / "data"), spec, merged=False)
+    assert main(["--db", db, "add-files"] + fns) == 0
+    out = capsys.readouterr().out
+    assert "added 2 files" in out
+    # duplicates rejected
+    assert main(["--db", db, "add-files"] + fns) == 0
+    assert "added 0 files" in capsys.readouterr().out
+    # unknown type rejected
+    junk = tmp_path / "junk.dat"
+    junk.write_bytes(b"xx")
+    assert main(["--db", db, "add-files", str(junk)]) == 0
+    assert "added 0 files" in capsys.readouterr().out
+
+
+def test_beam7_rejected(tmp_path, capsys):
+    db = str(tmp_path / "t.db")
+    spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64, beam_id=7)
+    fns = synth.synth_beam(str(tmp_path / "data"), spec, merged=False)
+    main(["--db", db, "add-files"] + fns)
+    assert "beam 7" in capsys.readouterr().out
+
+
+def test_jobpool_once_with_added_files(tmp_path, capsys, _iso_config):
+    db = str(tmp_path / "t.db")
+    spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64)
+    fns = synth.synth_beam(str(tmp_path / "data"), spec, merged=False)
+    main(["--db", db, "add-files"] + fns)
+    # one rotate: creates a job and submits to the local queue manager
+    assert main(["--db", db, "jobpool", "--once"]) == 0
+    assert main(["--db", db, "show", "processing"]) == 0
+    out = capsys.readouterr().out
+    assert "job_id" in out or "nothing processing" in out

@@ -1,0 +1,87 @@
+"""Search executor integration tests (small synthetic beams)."""
+
+import glob
+import os
+import tarfile
+import warnings
+
+import numpy as np
+import pytest
+
+from tpulsar.io import accelcands, synth
+from tpulsar.plan import ddplan
+from tpulsar.search import executor
+
+warnings.filterwarnings("ignore", message="low channel changes")
+
+P_TRUE, DM_TRUE = 0.15, 60.0
+
+
+@pytest.fixture(scope="module")
+def beam_outcome(tmp_path_factory):
+    root = tmp_path_factory.mktemp("exe")
+    spec = synth.BeamSpec(nchan=96, nsamp=1 << 15, nbits=4,
+                          tsamp_s=5.24288e-4)
+    psr = synth.PulsarSpec(period_s=P_TRUE, dm=DM_TRUE,
+                           snr_per_sample=0.5, width_frac=0.05)
+    fns = synth.synth_beam(str(root / "data"), spec, pulsars=[psr])
+    plan = [ddplan.DedispStep(lodm=40.0, dmstep=2.0, dms_per_pass=12,
+                              numpasses=2, numsub=24, downsamp=1)]
+    params = executor.SearchParams(
+        nsub=24, hi_accel_zmax=8, topk_per_stage=16,
+        max_cands_to_fold=5, fold_nbin=32, fold_npart=8)
+    out = executor.search_beam(fns, str(root / "work"), str(root / "results"),
+                               params=params, plan=plan)
+    return out
+
+
+def test_finds_injected_pulsar(beam_outcome):
+    out = beam_outcome
+    assert out.num_dm_trials == 24
+    assert len(out.candidates) >= 1
+    best = out.candidates[0]
+    ratio = best.period_s / P_TRUE
+    assert min(abs(ratio - r) for r in (1.0, 0.5, 2.0, 1 / 3)) < 0.02
+    assert abs(best.dm - DM_TRUE) <= 4.0
+    assert best.sigma > 8.0
+
+
+def test_folding_confirms(beam_outcome):
+    out = beam_outcome
+    assert len(out.folded) >= 1
+    assert out.folded[0].reduced_chi2 > 2.0
+
+
+def test_artifacts_written(beam_outcome):
+    rd = beam_outcome.resultsdir
+    base = beam_outcome.basenm
+    assert os.path.exists(os.path.join(rd, f"{base}_rfifind.npz"))
+    assert os.path.exists(os.path.join(rd, f"{base}.accelcands"))
+    assert os.path.exists(os.path.join(rd, f"{base}.report"))
+    assert os.path.exists(os.path.join(rd, "search_params.txt"))
+    # candidate list parses back
+    cands = accelcands.parse_candlist(os.path.join(rd, f"{base}.accelcands"))
+    assert len(cands) == len(beam_outcome.candidates)
+    # report contains stage percentages
+    rep = open(os.path.join(rd, f"{base}.report")).read()
+    assert "dedispersing" in rep and "%" in rep
+    # search_params.txt is exec-able python (reference reads it that way)
+    ns: dict = {}
+    exec(open(os.path.join(rd, "search_params.txt")).read(), {}, ns)
+    assert ns["num_dm_trials"] == 24
+    assert ns["nsub"] == 24
+
+
+def test_tarballs(beam_outcome):
+    rd = beam_outcome.resultsdir
+    base = beam_outcome.basenm
+    inf_tar = os.path.join(rd, f"{base}_inf.tgz")
+    assert os.path.exists(inf_tar)
+    with tarfile.open(inf_tar) as tf:
+        names = tf.getnames()
+    assert len(names) == 24  # one .inf per DM trial
+    # loose .inf files removed after tarring
+    assert not glob.glob(os.path.join(rd, f"{base}_DM*.inf"))
+    if beam_outcome.folded:
+        assert os.path.exists(os.path.join(rd, f"{base}_pfd.tgz"))
+        assert os.path.exists(os.path.join(rd, f"{base}_bestprof.tgz"))

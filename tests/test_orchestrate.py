@@ -1,0 +1,241 @@
+"""Orchestration layer tests: tracker, pool, queue, downloader, uploader."""
+
+import os
+import stat
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from tpulsar.io import synth
+from tpulsar.orchestrate import downloader as dl
+from tpulsar.orchestrate.jobtracker import JobTracker
+from tpulsar.orchestrate.pool import JobPool
+from tpulsar.orchestrate.queue_managers import get_queue_manager
+from tpulsar.orchestrate.queue_managers.local import LocalProcessManager
+
+warnings.filterwarnings("ignore", message="low channel changes")
+
+
+@pytest.fixture()
+def tracker(tmp_path):
+    return JobTracker(str(tmp_path / "tracker.db"))
+
+
+def _fake_worker_script(tmp_path, body="touch $OUTDIR/done.marker\n"):
+    script = tmp_path / "worker.sh"
+    script.write_text("#!/bin/sh\n" + body)
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script)
+
+
+def _add_beam_files(tracker, tmp_path, n_beams=1):
+    """Write synthetic mock pairs and register them 'downloaded'."""
+    fns = []
+    for b in range(n_beams):
+        spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64,
+                              beam_id=b % 8, scan=100 + b)
+        pair = synth.synth_beam(str(tmp_path / "data"), spec, merged=False)
+        for fn in pair:
+            tracker.insert("files", filename=fn,
+                           remote_filename=os.path.basename(fn),
+                           size=os.path.getsize(fn), status="downloaded",
+                           details="test fixture")
+        fns.extend(pair)
+    return fns
+
+
+def test_jobtracker_basics(tracker):
+    fid = tracker.insert("files", filename="/tmp/x.fits", size=123,
+                         status="new", details="")
+    assert tracker.count("files") == 1
+    tracker.update("files", fid, status="downloaded")
+    row = tracker.query("SELECT * FROM files WHERE id=?", [fid],
+                        fetchone=True)
+    assert row["status"] == "downloaded"
+    assert tracker.count("files", "downloaded") == 1
+    # atomic multi-statement execute
+    tracker.execute(
+        ["INSERT INTO jobs (status, created_at, updated_at) "
+         "VALUES ('new', '', '')",
+         "UPDATE files SET status='added' WHERE id=?"], [[], [fid]])
+    assert tracker.count("jobs") == 1
+
+
+def test_pool_full_lifecycle(tracker, tmp_path):
+    """downloaded files -> job created -> submitted -> processed."""
+    _add_beam_files(tracker, tmp_path)
+    qm = LocalProcessManager(max_jobs_running=2,
+                             script=_fake_worker_script(tmp_path),
+                             state_dir=str(tmp_path / "localq"))
+    pool = JobPool(tracker, qm, str(tmp_path / "results"), max_attempts=2)
+
+    pool.rotate()   # creates + submits
+    assert tracker.count("jobs", "submitted") == 1
+    sub = tracker.query("SELECT * FROM job_submits", fetchone=True)
+    assert sub["status"] == "running"
+    # output dir scheme {base}/{mjd}/{obs_name}/{beam}/{date}
+    parts = os.path.relpath(sub["output_dir"],
+                            str(tmp_path / "results")).split(os.sep)
+    assert len(parts) == 4
+    assert parts[0] == "55555"  # int MJD
+
+    for _ in range(50):
+        if not qm.is_running(sub["queue_id"]):
+            break
+        time.sleep(0.1)
+    pool.rotate()   # sync from queue
+    assert tracker.count("jobs", "processed") == 1
+    assert os.path.exists(os.path.join(sub["output_dir"], "done.marker"))
+
+
+def test_pool_failure_retry_then_terminal(tracker, tmp_path):
+    _add_beam_files(tracker, tmp_path)
+    notes = []
+    qm = LocalProcessManager(
+        max_jobs_running=2,
+        script=_fake_worker_script(tmp_path,
+                                   "echo boom >&2\nexit 3\n"),
+        state_dir=str(tmp_path / "localq"))
+    pool = JobPool(tracker, qm, str(tmp_path / "results"), max_attempts=2,
+                   notify=lambda s, b: notes.append(s))
+
+    for _ in range(6):
+        pool.rotate()
+        time.sleep(0.3)
+        if tracker.count("jobs", "terminal_failure"):
+            break
+    assert tracker.count("jobs", "terminal_failure") == 1
+    assert tracker.count("job_submits", "processing_failed") == 2
+    assert notes and "terminally failed" in notes[0]
+    sub = tracker.query("SELECT details FROM job_submits", fetchone=True)
+    assert "boom" in sub["details"] or "exit code" in sub["details"]
+
+
+def test_queue_manager_registry():
+    qm = get_queue_manager("local", max_jobs_running=1)
+    assert qm.can_submit()
+    with pytest.raises(ValueError):
+        get_queue_manager("nonexistent")
+
+
+def test_downloader_end_to_end(tracker, tmp_path):
+    # build a 'remote' pool of beam files
+    remote = tmp_path / "remote"
+    pool_dir = remote / "pool"
+    pool_dir.mkdir(parents=True)
+    for i in range(3):
+        (pool_dir / f"beam{i}.fits").write_bytes(b"x" * (1000 + i))
+
+    service = dl.LocalRestoreService(str(remote))
+    transport = dl.LocalTransport(str(remote))
+    d = dl.Downloader(tracker, service, transport,
+                      datadir=str(tmp_path / "rawdata"),
+                      space_to_use=10 ** 9, min_free_space=0,
+                      numdownloads=2, numretries=2)
+
+    d.run()          # makes the first restore request
+    assert tracker.count("requests", "waiting") == 1
+    d.run()          # request ready -> files listed -> downloads start
+    for _ in range(50):
+        d.run()
+        if tracker.count("files", "downloaded") >= 3:
+            break
+        time.sleep(0.05)
+    assert tracker.count("files", "downloaded") >= 3
+    st = d.status()
+    assert st["files_downloaded"] >= 3
+    assert st["used_space_bytes"] > 0
+    # the files physically exist with verified sizes
+    row = tracker.query("SELECT * FROM files WHERE status='downloaded'",
+                        fetchone=True)
+    assert os.path.getsize(row["filename"]) == row["size"]
+
+
+def test_downloader_retry_and_terminal(tracker, tmp_path):
+    remote = tmp_path / "remote"
+    (remote / "pool").mkdir(parents=True)
+    (remote / "pool" / "beam0.fits").write_bytes(b"y" * 500)
+    service = dl.LocalRestoreService(str(remote))
+    transport = dl.LocalTransport(str(remote), fail_every=1)  # always fail
+    d = dl.Downloader(tracker, service, transport,
+                      datadir=str(tmp_path / "rawdata"),
+                      space_to_use=10 ** 9, min_free_space=0,
+                      numretries=2)
+    for _ in range(30):
+        d.run()
+        time.sleep(0.05)
+        if tracker.count("files", "terminal_failure"):
+            break
+    assert tracker.count("files", "terminal_failure") == 1
+    attempts = tracker.query(
+        "SELECT COUNT(*) c FROM download_attempts", fetchone=True)["c"]
+    assert attempts >= 2
+
+
+def test_config_validation(tmp_path):
+    from tpulsar.config import InsaneConfigsError, TpulsarConfig, load_config
+
+    cfg = TpulsarConfig()
+    cfg.basic.log_dir = str(tmp_path / "logs")
+    cfg.background.jobtracker_db = str(tmp_path / "jt.db")
+    cfg.download.datadir = str(tmp_path / "raw")
+    cfg.processing.base_working_directory = str(tmp_path / "work")
+    cfg.processing.base_results_directory = str(tmp_path / "res")
+    cfg.resultsdb.url = str(tmp_path / "results.db")
+    cfg.check_sanity(create_dirs=True)   # no raise
+
+    cfg.jobpooler.queue_manager = "bogus"
+    cfg.email.enabled = True
+    cfg.email.recipient = ""
+    with pytest.raises(InsaneConfigsError) as ei:
+        cfg.check_sanity(create_dirs=True)
+    msg = str(ei.value)
+    assert "queue_manager" in msg and "recipient" in msg
+
+    # load from python overrides file
+    cfgfile = tmp_path / "conf.py"
+    cfgfile.write_text(
+        f"download = {{'numdownloads': 7}}\n"
+        f"basic = {{'log_dir': {str(tmp_path / 'logs')!r}}}\n"
+        f"background = {{'jobtracker_db': {str(tmp_path / 'jt.db')!r}}}\n"
+        f"processing = {{'base_working_directory': "
+        f"{str(tmp_path / 'work')!r}, "
+        f"'base_results_directory': {str(tmp_path / 'res')!r}}}\n"
+        f"resultsdb = {{'url': {str(tmp_path / 'results.db')!r}}}\n")
+    loaded = load_config(str(cfgfile))
+    assert loaded.download.numdownloads == 7
+
+
+def test_mailer_sink():
+    from tpulsar.config import TpulsarConfig
+    from tpulsar.obs.mailer import ErrorMailer
+
+    cfg = TpulsarConfig()
+    cfg.email.enabled = True
+    cfg.email.recipient = "ops@example.org"
+    sent = []
+    m = ErrorMailer("it broke", subject="test failure", config=cfg.email,
+                    sink=lambda s, b: sent.append((s, b)))
+    assert m.send()
+    assert sent[0][0] == "[tpulsar] test failure"
+    assert "it broke" in sent[0][1]
+
+    cfg.email.enabled = False
+    assert not ErrorMailer("x", config=cfg.email,
+                           sink=lambda s, b: None).send()
+
+
+def test_debugflags_cli():
+    import argparse
+
+    from tpulsar.obs import debugflags
+
+    p = argparse.ArgumentParser()
+    debugflags.add_cli_flags(p)
+    args = p.parse_args(["--debug-jobtracker"])
+    debugflags.apply_cli_flags(args)
+    assert debugflags.is_on("jobtracker")
+    assert not debugflags.is_on("upload")
+    debugflags.set_allmodes_off()

@@ -1,0 +1,124 @@
+"""Verified-upload tests: results dir -> results DB, transactionally."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from tpulsar.io import synth
+from tpulsar.orchestrate.jobtracker import JobTracker
+from tpulsar.orchestrate.results_db import ResultsDB
+from tpulsar.orchestrate.uploader import JobUploader, get_version_number
+from tpulsar.plan import ddplan
+from tpulsar.search import executor
+
+warnings.filterwarnings("ignore", message="low channel changes")
+
+
+@pytest.fixture(scope="module")
+def results_dir(tmp_path_factory):
+    """A real results directory from the executor on a small beam."""
+    root = tmp_path_factory.mktemp("upl")
+    spec = synth.BeamSpec(nchan=32, nsamp=1 << 14, nbits=4,
+                          tsamp_s=5.24288e-4)
+    psr = synth.PulsarSpec(period_s=0.15, dm=60.0, snr_per_sample=0.8)
+    fns = synth.synth_beam(str(root / "data"), spec, pulsars=[psr])
+    plan = [ddplan.DedispStep(lodm=50.0, dmstep=5.0, dms_per_pass=4,
+                              numpasses=1, numsub=8, downsamp=1)]
+    params = executor.SearchParams(nsub=8, run_hi_accel=False,
+                                   max_cands_to_fold=3, fold_nbin=32,
+                                   fold_npart=8)
+    out = executor.search_beam(fns, str(root / "work"),
+                               str(root / "results"),
+                               params=params, plan=plan)
+    return out, str(root)
+
+
+def _tracked_submit(tmp_path, resultsdir):
+    t = JobTracker(str(tmp_path / "jt.db"))
+    job_id = t.insert("jobs", status="processed", details="")
+    sid = t.insert("job_submits", job_id=job_id, queue_id="q1",
+                   output_dir=resultsdir, status="processed", details="")
+    return t, job_id, sid
+
+
+def test_upload_end_to_end(results_dir, tmp_path):
+    out, root = results_dir
+    t, job_id, sid = _tracked_submit(tmp_path, out.resultsdir)
+    db_url = str(tmp_path / "results.db")
+    up = JobUploader(t, db_url=db_url)
+    up.run()
+
+    assert t.query("SELECT status FROM jobs WHERE id=?", [job_id],
+                   fetchone=True)["status"] == "uploaded"
+    db = ResultsDB(db_url)
+    hdr = db.fetchone("SELECT * FROM headers")
+    assert hdr is not None
+    assert hdr["source_name"] == "G0000+00"
+    assert hdr["beam_id"] == 3
+    assert hdr["version_number"]
+    ncands = db.fetchone("SELECT COUNT(*) c FROM pdm_candidates")["c"]
+    assert ncands == len(out.candidates)
+    ndiags = db.fetchone("SELECT COUNT(*) c FROM diagnostics")["c"]
+    assert ndiags >= 5
+    # folded candidate has plots attached
+    if out.folded:
+        nplots = db.fetchone("SELECT COUNT(*) c FROM pdm_plots")["c"]
+        assert nplots >= 1
+    db.close()
+
+
+def test_version_pinning(results_dir):
+    out, root = results_dir
+    v1 = get_version_number(out.resultsdir)
+    v2 = get_version_number(out.resultsdir)
+    assert v1 == v2
+    assert os.path.exists(os.path.join(out.resultsdir,
+                                       "version_number.txt"))
+
+
+def test_parse_failure_fails_job(tmp_path):
+    os.makedirs(tmp_path / "empty_results", exist_ok=True)
+    t, job_id, sid = _tracked_submit(tmp_path,
+                                     str(tmp_path / "empty_results"))
+    up = JobUploader(t, db_url=str(tmp_path / "results.db"))
+    up.run()
+    assert t.query("SELECT status FROM jobs WHERE id=?", [job_id],
+                   fetchone=True)["status"] == "failed"
+    assert t.query("SELECT status FROM job_submits WHERE id=?", [sid],
+                   fetchone=True)["status"] == "upload_failed"
+
+
+def test_upload_is_transactional(results_dir, tmp_path, monkeypatch):
+    """If a diagnostic upload fails, nothing is committed."""
+    out, root = results_dir
+    t, job_id, sid = _tracked_submit(tmp_path, out.resultsdir)
+    db_url = str(tmp_path / "results.db")
+
+    from tpulsar.orchestrate import diagnostics as diag_mod
+    from tpulsar.orchestrate.uploadables import UploadError
+
+    real = diag_mod.get_diagnostics
+
+    def broken(resultsdir, basenm):
+        diags = real(resultsdir, basenm)
+
+        class Bomb:
+            header_id = None
+
+            def upload(self, db):
+                raise UploadError("injected diagnostic failure")
+
+        return diags + [Bomb()]
+
+    monkeypatch.setattr(diag_mod, "get_diagnostics", broken)
+    up = JobUploader(t, db_url=db_url)
+    up.run()
+
+    assert t.query("SELECT status FROM jobs WHERE id=?", [job_id],
+                   fetchone=True)["status"] == "failed"
+    db = ResultsDB(db_url)
+    assert db.fetchone("SELECT COUNT(*) c FROM headers")["c"] == 0
+    assert db.fetchone("SELECT COUNT(*) c FROM pdm_candidates")["c"] == 0
+    db.close()

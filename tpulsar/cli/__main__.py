@@ -1,0 +1,3 @@
+from tpulsar.cli.main import main
+
+raise SystemExit(main())

@@ -1,0 +1,333 @@
+"""The tpulsar operator CLI — subsumes the reference's 17 bin/ scripts
+(SURVEY.md section 1, L9) as subcommands:
+
+  daemons:   downloader | jobpool | uploader   (StartDownloader.py,
+             StartJobPool.py, StartJobUploader.py — incl. the
+             crash-notification wrapper and exponential backoff)
+  bootstrap: init-db        (create_database.py)
+  ingest:    add-files      (add_files.py)
+  control:   kill-jobs, stop-jobs, remove-files
+             (kill_jobs.py, stop_processing_jobs.py, remove_files.py)
+  monitor:   status, show processing|downloading|uploading|failed
+             (current_status.py, show_*.py, overview_failed.py)
+  search:    search         (run one beam locally, bin/search.py)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import traceback
+
+from tpulsar.obs import debugflags
+
+
+def _tracker(args):
+    from tpulsar.orchestrate.jobtracker import JobTracker
+    return JobTracker(args.db) if args.db else JobTracker()
+
+
+def _notify(cfg):
+    from tpulsar.obs.mailer import ErrorMailer
+
+    def send(subject, body):
+        try:
+            ErrorMailer(body, subject=subject, config=cfg.email).send()
+        except Exception:
+            pass
+    return send
+
+
+def _daemon_loop(name: str, iteration, status, sleep_s: float, notify):
+    """Run a daemon with crash notification and exponential backoff on
+    repeated errors (reference bin/StartDownloader.py:14-36)."""
+    delay_mult = 1
+    while True:
+        try:
+            status()
+            iteration()
+            delay_mult = 1
+        except KeyboardInterrupt:
+            print(f"{name}: interrupted; exiting")
+            return 0
+        except Exception:
+            tb = traceback.format_exc()
+            print(tb, file=sys.stderr)
+            notify(f"{name} crashed", tb)
+            delay_mult = min(delay_mult * 2, 32)
+        time.sleep(sleep_s * delay_mult)
+
+
+# ------------------------------------------------------------- subcommands
+
+def cmd_init_db(args):
+    t = _tracker(args)
+    print(f"job-tracker DB ready at {t.db_path}")
+    return 0
+
+
+def cmd_add_files(args):
+    """Manual ingest (reference bin/add_files.py): register existing
+    files as status 'added' after type/duplicate checks."""
+    from tpulsar.io import datafile
+    t = _tracker(args)
+    added = 0
+    for fn in args.files:
+        fn = os.path.abspath(fn)
+        if not os.path.exists(fn):
+            print(f"skip {fn}: does not exist")
+            continue
+        try:
+            cls = datafile.get_datafile_type([fn])
+        except datafile.DatafileError as e:
+            print(f"skip {fn}: {e}")
+            continue
+        m = cls.fnmatch(fn)
+        if m and m.groupdict().get("beam") == "7":
+            print(f"skip {fn}: beam 7 (pointless to search - reference "
+                  f"pipeline_utils.py:114)")
+            continue
+        dup = t.query(
+            "SELECT id FROM files WHERE filename=? AND status NOT IN "
+            "('failed','terminal_failure','deleted')", [fn], fetchone=True)
+        if dup:
+            print(f"skip {fn}: already tracked")
+            continue
+        t.insert("files", filename=fn, remote_filename=os.path.basename(fn),
+                 size=os.path.getsize(fn), status="added",
+                 details="added manually")
+        added += 1
+    print(f"added {added} files")
+    return 0
+
+
+def _make_pool(args, cfg):
+    from tpulsar.orchestrate.pool import JobPool
+    from tpulsar.orchestrate.queue_managers import get_queue_manager
+    qm_kw = {}
+    if cfg.jobpooler.queue_manager == "local":
+        qm_kw = {"max_jobs_running": cfg.jobpooler.max_jobs_running,
+                 "state_dir": os.path.join(
+                     cfg.processing.base_working_directory, ".localq")}
+        if cfg.jobpooler.submit_script:
+            qm_kw["script"] = cfg.jobpooler.submit_script
+    elif cfg.jobpooler.queue_manager in ("slurm", "pbs"):
+        qm_kw = {"script": cfg.jobpooler.submit_script,
+                 "queue_name": cfg.jobpooler.queue_name,
+                 "max_jobs_running": cfg.jobpooler.max_jobs_running,
+                 "max_jobs_queued": cfg.jobpooler.max_jobs_queued}
+    qm = get_queue_manager(cfg.jobpooler.queue_manager, **qm_kw)
+    return JobPool(_tracker(args), qm,
+                   cfg.processing.base_results_directory,
+                   max_attempts=cfg.jobpooler.max_attempts,
+                   notify=_notify(cfg),
+                   delete_raw_on_terminal=cfg.basic.delete_rawdata)
+
+
+def cmd_jobpool(args):
+    from tpulsar.config import settings
+    cfg = settings()
+    pool = _make_pool(args, cfg)
+
+    def show():
+        print(f"jobpool status: {pool.status()}")
+
+    if args.once:
+        show()
+        pool.rotate()
+        return 0
+    return _daemon_loop("jobpool", pool.rotate, show,
+                        cfg.background.sleep, _notify(cfg))
+
+
+def cmd_downloader(args):
+    from tpulsar.config import settings
+    from tpulsar.orchestrate import downloader as dl
+    cfg = settings()
+    root = args.remote_root or cfg.download.api_service_url
+    if not root:
+        print("downloader: set --remote-root (local fixture) or "
+              "download.api_service_url", file=sys.stderr)
+        return 2
+    if cfg.download.transport == "http":
+        transport = dl.HTTPTransport(root)
+        service = dl.LocalRestoreService(root)   # TODO http restore svc
+    else:
+        transport = dl.LocalTransport(root)
+        service = dl.LocalRestoreService(root)
+    d = dl.Downloader(_tracker(args), service, transport,
+                      datadir=cfg.download.datadir,
+                      space_to_use=cfg.download.space_to_use,
+                      min_free_space=cfg.download.min_free_space,
+                      numdownloads=cfg.download.numdownloads,
+                      numrestores=cfg.download.numrestores,
+                      numretries=cfg.download.numretries,
+                      request_timeout_hours=cfg.download.request_timeout_hours)
+    if args.once:
+        d.run()
+        print(d.status())
+        return 0
+    return _daemon_loop("downloader", d.run,
+                        lambda: print(d.status()),
+                        cfg.background.sleep, _notify(cfg))
+
+
+def cmd_uploader(args):
+    from tpulsar.config import settings
+    from tpulsar.orchestrate.uploader import JobUploader
+    cfg = settings()
+    up = JobUploader(_tracker(args), db_url=cfg.resultsdb.url,
+                     notify=_notify(cfg),
+                     delete_raw_on_upload=cfg.basic.delete_rawdata)
+    if args.once:
+        up.run()
+        return 0
+    return _daemon_loop("uploader", up.run, lambda: None,
+                        cfg.background.sleep, _notify(cfg))
+
+
+def cmd_status(args):
+    t = _tracker(args)
+    print("=== tpulsar status ===")
+    for table in ("requests", "files", "jobs", "job_submits"):
+        rows = t.query(
+            f"SELECT status, COUNT(*) c FROM {table} GROUP BY status")
+        counts = ", ".join(f"{r['status']}={r['c']}" for r in rows) or "empty"
+        print(f"{table:>14s}: {counts}")
+    return 0
+
+
+def cmd_show(args):
+    t = _tracker(args)
+    what = args.what
+    queries = {
+        "processing": ("SELECT s.job_id, s.queue_id, s.output_dir, "
+                       "s.updated_at FROM job_submits s "
+                       "WHERE s.status='running'"),
+        "downloading": ("SELECT id, remote_filename, size, updated_at "
+                        "FROM files WHERE status IN "
+                        "('downloading','unverified')"),
+        "uploading": ("SELECT id, job_id, output_dir, updated_at FROM "
+                      "job_submits WHERE status IN "
+                      "('processed','upload_failed')"),
+        "failed": ("SELECT id, status, details, updated_at FROM jobs "
+                   "WHERE status IN ('failed','retrying',"
+                   "'terminal_failure')"),
+    }
+    rows = t.query(queries[what])
+    if not rows:
+        print(f"nothing {what}")
+        return 0
+    cols = rows[0].keys()
+    print(" | ".join(cols))
+    for r in rows:
+        print(" | ".join(str(r[c])[:60] for c in cols))
+    return 0
+
+
+def cmd_kill_jobs(args):
+    """Fail running submissions (reference bin/kill_jobs.py /
+    stop_processing_jobs.py: fail vs polite remove)."""
+    from tpulsar.config import settings
+    cfg = settings()
+    pool = _make_pool(args, cfg)
+    t = pool.t
+    ids = args.job_ids or [r["id"] for r in t.query(
+        "SELECT id FROM jobs WHERE status='submitted'")]
+    for job_id in ids:
+        sub = t.query(
+            "SELECT id sid, queue_id FROM job_submits WHERE job_id=? "
+            "AND status='running'", [job_id], fetchone=True)
+        if sub:
+            pool.qm.delete(sub["queue_id"])
+            t.update("job_submits", sub["sid"], status="stopped",
+                     details="killed by operator")
+        new_status = "failed" if args.fail else "terminal_failure"
+        t.update("jobs", job_id, status=new_status,
+                 details="stopped by operator")
+        print(f"job {job_id} -> {new_status}")
+    return 0
+
+
+def cmd_remove_files(args):
+    t = _tracker(args)
+    for fid in args.file_ids:
+        row = t.query("SELECT * FROM files WHERE id=?", [fid],
+                      fetchone=True)
+        if row is None:
+            print(f"file {fid}: not found")
+            continue
+        if row["filename"] and os.path.exists(row["filename"]):
+            os.remove(row["filename"])
+        t.update("files", fid, status="deleted",
+                 details="removed by operator")
+        print(f"file {fid} deleted")
+    return 0
+
+
+def cmd_search(args):
+    from tpulsar.cli import search_job
+    argv = list(args.files) + ["--outdir", args.outdir]
+    if args.no_accel:
+        argv.append("--no-accel")
+    return search_job.main(argv)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpulsar", description=__doc__)
+    p.add_argument("--db", default=None, help="job-tracker DB path")
+    debugflags.add_cli_flags(p)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("init-db").set_defaults(fn=cmd_init_db)
+
+    sp = sub.add_parser("add-files")
+    sp.add_argument("files", nargs="+")
+    sp.set_defaults(fn=cmd_add_files)
+
+    for name, fn in (("jobpool", cmd_jobpool),
+                     ("uploader", cmd_uploader)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--once", action="store_true")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("downloader")
+    sp.add_argument("--once", action="store_true")
+    sp.add_argument("--remote-root", default=None)
+    sp.set_defaults(fn=cmd_downloader)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("show")
+    sp.add_argument("what", choices=["processing", "downloading",
+                                     "uploading", "failed"])
+    sp.set_defaults(fn=cmd_show)
+
+    sp = sub.add_parser("kill-jobs")
+    sp.add_argument("job_ids", nargs="*", type=int)
+    sp.add_argument("--fail", action="store_true",
+                    help="mark failed (retryable) instead of terminal")
+    sp.set_defaults(fn=cmd_kill_jobs)
+
+    sp = sub.add_parser("remove-files")
+    sp.add_argument("file_ids", nargs="+", type=int)
+    sp.set_defaults(fn=cmd_remove_files)
+
+    sp = sub.add_parser("search")
+    sp.add_argument("files", nargs="+")
+    sp.add_argument("--outdir", required=True)
+    sp.add_argument("--no-accel", action="store_true")
+    sp.set_defaults(fn=cmd_search)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    debugflags.apply_cli_flags(args)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

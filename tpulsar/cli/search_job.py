@@ -1,0 +1,145 @@
+"""Per-beam worker entry point (reference: bin/search.py).
+
+Invoked by every queue backend with the DATAFILES/OUTDIR environment
+contract (schedulers pass no argv — reference bin/search.py:27-31):
+set up a scratch workspace, stage the data locally, preprocess (Mock
+subband merge), pick the zaplist, run the TPU search, copy results to
+the output directory, and clean up the workspace even on failure
+(reference bin/search.py:205-223 try/finally).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shutil
+import sys
+import tempfile
+import warnings
+
+import numpy as np
+
+from tpulsar.io import datafile
+from tpulsar.kernels.fourier import parse_zaplist
+from tpulsar.search import executor
+
+
+def get_datafns(args) -> list[str]:
+    if args.files:
+        return args.files
+    env = os.environ.get("DATAFILES", "")
+    fns = [f for f in env.split(";") if f]
+    if not fns:
+        raise SystemExit("no data files: pass paths or set DATAFILES")
+    return fns
+
+
+def get_outdir(args) -> str:
+    outdir = args.outdir or os.environ.get("OUTDIR", "")
+    if not outdir:
+        raise SystemExit("no output dir: pass --outdir or set OUTDIR")
+    return outdir
+
+
+def init_workspace(base: str | None) -> str:
+    base = base or os.environ.get("TPULSAR_WORKDIR_BASE",
+                                  tempfile.gettempdir())
+    os.makedirs(base, exist_ok=True)
+    return tempfile.mkdtemp(prefix="tpulsar_", dir=base)
+
+
+def stage_in(fns: list[str], workdir: str) -> list[str]:
+    """Copy raw data into the node-local workspace (reference uses
+    rsync -auvl, bin/search.py:123)."""
+    staged = []
+    for fn in fns:
+        dst = os.path.join(workdir, os.path.basename(fn))
+        shutil.copy2(fn, dst)
+        staged.append(dst)
+    return staged
+
+
+def choose_zaplist(fns: list[str], zapdir: str | None,
+                   default: str | None) -> np.ndarray | None:
+    """Per-file > per-beam > per-MJD custom zaplist, else the default
+    (reference fallback chain: bin/search.py:151-183)."""
+    candidates = []
+    if zapdir and os.path.isdir(zapdir):
+        base = os.path.basename(fns[0])
+        stem = os.path.splitext(base)[0]
+        m = datafile.MergedMockPsrfitsData.fnmatch(base) \
+            or datafile.MockPsrfitsData.fnmatch(base)
+        candidates.append(os.path.join(zapdir, stem + ".zaplist"))
+        if m:
+            gd = m.groupdict()
+            candidates.append(os.path.join(
+                zapdir, f"{gd['projid']}.{gd['date']}."
+                        f"b{gd['beam']}.zaplist"))
+            candidates.append(os.path.join(
+                zapdir, f"{gd['projid']}.{gd['date']}.all.zaplist"))
+    if default:
+        candidates.append(default)
+    for c in candidates:
+        if c and os.path.exists(c):
+            return parse_zaplist(c)
+    return None
+
+
+def _keep_stderr_clean() -> None:
+    """Route warnings and log chatter to stdout.
+
+    Queue backends detect job failure from a non-empty stderr file
+    (reference pbs.py:209-230, kept here), so only genuine errors may
+    reach stderr — a UserWarning or an experimental-platform log line
+    must not fail the job."""
+    warnings.showwarning = lambda msg, cat, fn, lineno, *a, **k: print(
+        warnings.formatwarning(msg, cat, fn, lineno), end="",
+        file=sys.stdout)
+    logging.basicConfig(stream=sys.stdout)
+    for name in ("", "jax", "jax._src.xla_bridge"):
+        for h in logging.getLogger(name).handlers:
+            if isinstance(h, logging.StreamHandler) \
+                    and getattr(h, "stream", None) is sys.stderr:
+                h.stream = sys.stdout
+    if logging.lastResort is not None:
+        logging.lastResort = logging.StreamHandler(sys.stdout)
+
+
+def main(argv=None) -> int:
+    _keep_stderr_clean()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("files", nargs="*", help="raw data files")
+    p.add_argument("--outdir", default=None)
+    p.add_argument("--workdir-base", default=None)
+    p.add_argument("--zaplist-dir", default=None)
+    p.add_argument("--default-zaplist", default=None)
+    p.add_argument("--no-accel", action="store_true")
+    args = p.parse_args(argv)
+
+    fns = get_datafns(args)
+    outdir = get_outdir(args)
+    workdir = init_workspace(args.workdir_base)
+    try:
+        staged = stage_in(fns, workdir)
+        ppfns = datafile.preprocess(staged)
+        zap = choose_zaplist(ppfns, args.zaplist_dir, args.default_zaplist)
+        params = executor.SearchParams()
+        if args.no_accel:
+            params.run_hi_accel = False
+        outcome = executor.search_beam(
+            ppfns, workdir, os.path.join(workdir, "results"),
+            params=params, zaplist=zap)
+        os.makedirs(outdir, exist_ok=True)
+        for name in os.listdir(outcome.resultsdir):
+            shutil.copy2(os.path.join(outcome.resultsdir, name),
+                         os.path.join(outdir, name))
+        print(f"search complete: {len(outcome.candidates)} candidates, "
+              f"{outcome.num_dm_trials} DM trials")
+        return 0
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Typed, validated configuration (reference: lib/python/config/).
+
+The reference uses per-domain example/check module pairs validated at
+import time (config_types.py:37-65).  tpulsar keeps the same domains
+and the validate-before-run property, but as dataclasses loaded from a
+single python or YAML file, with a consolidated InsaneConfigsError and
+provenance serialization into every results directory.
+"""
+
+from tpulsar.config.core import (  # noqa: F401
+    ConfigError,
+    InsaneConfigsError,
+    TpulsarConfig,
+    load_config,
+    settings,
+    set_settings,
+)

@@ -148,36 +148,75 @@ def _harmonic_sum_plane(plane: jnp.ndarray, numharm: int, nz: int) -> jnp.ndarra
     return acc
 
 
-def accel_search_one(spectrum: np.ndarray | jnp.ndarray, bank: TemplateBank,
-                     max_numharm: int = 8, topk: int = 64):
-    """Acceleration search of one whitened complex spectrum.
-
-    Returns list of (numharm, power, r_bin, z_value) candidate arrays:
-    dict stage -> (powers[topk], rbins[topk], zvals[topk]).
-    """
+@partial(jax.jit, static_argnames=("seg", "step", "width", "nz",
+                                   "max_numharm", "topk"))
+def _accel_plane_topk(spectrum, bank_fft, seg, step, width, nz,
+                      max_numharm, topk):
+    """One spectrum -> per-stage (vals, flat plane indices), fully on
+    device so lax.map over DMs never materializes more than one
+    (nz, nbins) plane."""
     from tpulsar.kernels.fourier import harmonic_stages
 
-    plane = _correlate_segments(jnp.asarray(spectrum, jnp.complex64),
-                                jnp.asarray(bank.bank_fft),
-                                bank.seg, bank.step, bank.width)
-    nz = len(bank.zs)
-    out = {}
+    plane = _correlate_segments(spectrum, bank_fft, seg, step, width)
+    vals_all, idx_all = [], []
     for h in harmonic_stages(max_numharm):
-        summed = _harmonic_sum_plane(plane, h, nz)      # (nz, L)
-        # Local-max suppression along r: one blob (a strong signal's
-        # response skirt) must not flood every top-k slot.
+        summed = _harmonic_sum_plane(plane, h, nz)
         left = jnp.pad(summed[:, :-1], ((0, 0), (1, 0)))
         right = jnp.pad(summed[:, 1:], ((0, 0), (0, 1)))
         summed = jnp.where((summed >= left) & (summed > right), summed, 0.0)
         flat = summed.reshape(-1)
-        vals, idx = jax.lax.top_k(flat, topk)
-        vals = np.asarray(vals)
-        idx = np.asarray(idx)
-        L = summed.shape[1]
-        zi, r = np.divmod(idx, L)
-        zvals = np.asarray(bank.zs)[zi]
-        out[h] = (vals, r, zvals)
+        v, i = jax.lax.top_k(flat, min(topk, flat.shape[0]))
+        # pad to a fixed width so stages stack
+        if v.shape[0] < topk:
+            v = jnp.pad(v, (0, topk - v.shape[0]))
+            i = jnp.pad(i, (0, topk - i.shape[0]))
+        vals_all.append(v)
+        idx_all.append(i)
+    return jnp.stack(vals_all), jnp.stack(idx_all)
+
+
+def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
+                       max_numharm: int = 8, topk: int = 64):
+    """Acceleration-search a batch of whitened complex spectra.
+
+    spectra: (ndms, nbins) complex64.  Maps over DMs on device with
+    one (nz, nbins) plane in flight at a time.  Returns
+    {stage: (powers[ndms, topk], rbins[ndms, topk], zvals[ndms, topk])}.
+    """
+    from tpulsar.kernels.fourier import harmonic_stages
+
+    nz = len(bank.zs)
+    bank_fft = jnp.asarray(bank.bank_fft)
+
+    def one(spec):
+        return _accel_plane_topk(spec, bank_fft, bank.seg, bank.step,
+                                 bank.width, nz, max_numharm, topk)
+
+    vals, idx = jax.lax.map(one, spectra)      # (ndms, nstages, topk)
+    vals = np.asarray(vals)
+    idx = np.asarray(idx)
+    stages = harmonic_stages(max_numharm)
+    out = {}
+    nbins = spectra.shape[-1]
+    for si_, h in enumerate(stages):
+        L = nbins // h
+        zi, r = np.divmod(idx[:, si_, :], L)
+        out[h] = (vals[:, si_, :], r, np.asarray(bank.zs)[zi])
     return out
+
+
+def accel_search_one(spectrum: np.ndarray | jnp.ndarray, bank: TemplateBank,
+                     max_numharm: int = 8, topk: int = 64):
+    """Acceleration search of one whitened complex spectrum: thin
+    wrapper over accel_search_batch.
+
+    Returns dict stage -> (powers[topk], rbins[topk], zvals[topk]).
+    """
+    batch = accel_search_batch(
+        jnp.asarray(spectrum, jnp.complex64)[None], bank,
+        max_numharm=max_numharm, topk=topk)
+    return {h: (vals[0], rbins[0], zvals[0])
+            for h, (vals, rbins, zvals) in batch.items()}
 
 
 def normalize_spectrum(spectrum: jnp.ndarray) -> jnp.ndarray:

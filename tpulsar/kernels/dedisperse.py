@@ -45,12 +45,14 @@ def _shift_gather(data: jnp.ndarray, shifts: jnp.ndarray) -> jnp.ndarray:
 
 
 def downsample(x: jnp.ndarray, factor: int, axis: int = -1) -> jnp.ndarray:
-    """Sum-downsample along an axis (factor must divide the length —
-    guaranteed because plan downsamps divide the subint block length)."""
+    """Sum-downsample along an axis.  Lengths not divisible by the
+    factor are truncated (merged Mock blocks lose leading rows, so the
+    plan's divisibility guarantee does not survive preprocessing)."""
     if factor == 1:
         return x
     axis = axis % x.ndim
-    n = x.shape[axis]
+    n = (x.shape[axis] // factor) * factor
+    x = jax.lax.slice_in_dim(x, 0, n, axis=axis)
     newshape = x.shape[:axis] + (n // factor, factor) + x.shape[axis + 1:]
     return x.reshape(newshape).sum(axis=axis + 1)
 
@@ -69,7 +71,11 @@ def form_subbands(data: jnp.ndarray, chan_shifts: jnp.ndarray,
     if nchan % nsub:
         raise ValueError(f"nchan {nchan} not divisible by nsub {nsub}")
     shifted = _shift_gather(data, chan_shifts)
-    subbands = shifted.reshape(nsub, nchan // nsub, T).sum(axis=1)
+    # Cast after the gather: lets the raw block live in HBM as uint8 /
+    # bf16 (a full Mock beam is 4x smaller that way); XLA fuses the
+    # gather + convert + reduce without materializing the f32 block.
+    subbands = shifted.astype(jnp.float32).reshape(
+        nsub, nchan // nsub, T).sum(axis=1)
     return downsample(subbands, downsamp, axis=-1)
 
 

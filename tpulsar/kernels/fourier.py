@@ -38,15 +38,21 @@ def power_spectrum(series: jnp.ndarray) -> jnp.ndarray:
 
 # ------------------------------------------------------------- rednoise
 
-def _block_edges(nbins: int, first_block: int = 6, growth: float = 1.5,
-                 max_block: int = 8192) -> np.ndarray:
-    """Logarithmically growing block edges used for local normalization
-    (low-frequency blocks are short so steep red noise is tracked)."""
+MAX_WHITEN_BLOCK = 8192
+
+
+def _block_edges(nbins: int, first_block: int = 6,
+                 growth: float = 1.5) -> np.ndarray:
+    """Logarithmically growing block edges for the low-frequency
+    section of the local-normalization estimate (short blocks track
+    steep red noise).  Stops once blocks reach MAX_WHITEN_BLOCK — the
+    remaining spectrum is handled with one reshaped equal-block median
+    (keeps the compiled graph small for multi-million-bin spectra)."""
     edges = [1]  # skip DC
     size = first_block
-    while edges[-1] < nbins:
+    while edges[-1] < nbins and size < MAX_WHITEN_BLOCK:
         edges.append(min(nbins, edges[-1] + int(size)))
-        size = min(size * growth, max_block)
+        size = size * growth
     return np.asarray(edges, dtype=np.int64)
 
 
@@ -56,25 +62,42 @@ def whiten_powers(powers: jnp.ndarray, edges: tuple[int, ...]) -> jnp.ndarray:
     block medians (median/ln2 = mean for exponential noise), linearly
     interpolated between block centers.
 
-    powers: (..., nbins).  edges: static block boundaries.
+    powers: (..., nbins).  edges: static log-section boundaries; bins
+    past edges[-1] are normalized with equal MAX_WHITEN_BLOCK blocks.
     """
-    centers = []
-    medians = []
+    nbins = powers.shape[-1]
+    centers: list[float] = []
+    med_parts: list[jnp.ndarray] = []
     for lo, hi in zip(edges[:-1], edges[1:]):
-        block = powers[..., lo:hi]
         centers.append(0.5 * (lo + hi))
-        medians.append(jnp.median(block, axis=-1))
-    centers = jnp.asarray(centers)
-    med = jnp.stack(medians, axis=-1) / jnp.log(2.0)
-    med = jnp.maximum(med, 1e-30)
+        med_parts.append(jnp.median(powers[..., lo:hi], axis=-1)[..., None])
 
-    bins = jnp.arange(powers.shape[-1], dtype=jnp.float32)
+    tail_start = int(edges[-1])
+    ntail = nbins - tail_start
+    m = ntail // MAX_WHITEN_BLOCK
+    if m > 0:
+        tail = powers[..., tail_start: tail_start + m * MAX_WHITEN_BLOCK]
+        tail = tail.reshape(powers.shape[:-1] + (m, MAX_WHITEN_BLOCK))
+        med_parts.append(jnp.median(tail, axis=-1))
+        centers.extend(tail_start + (j + 0.5) * MAX_WHITEN_BLOCK
+                       for j in range(m))
+    rem = ntail - m * MAX_WHITEN_BLOCK
+    if rem > 16:
+        lo = nbins - rem
+        centers.append(0.5 * (lo + nbins))
+        med_parts.append(jnp.median(powers[..., lo:], axis=-1)[..., None])
+
+    med = jnp.concatenate(med_parts, axis=-1) / jnp.log(2.0)
+    med = jnp.maximum(med, 1e-30)
+    centers = jnp.asarray(centers, dtype=jnp.float32)
+
+    bins = jnp.arange(nbins, dtype=jnp.float32)
     if powers.ndim == 1:
         level = jnp.interp(bins, centers, med)
     else:
-        level = jax.vmap(lambda m: jnp.interp(bins, centers, m))(
+        level = jax.vmap(lambda mrow: jnp.interp(bins, centers, mrow))(
             med.reshape(-1, med.shape[-1])).reshape(
-                powers.shape[:-1] + (powers.shape[-1],))
+                powers.shape[:-1] + (nbins,))
     return powers / level
 
 
@@ -162,7 +185,8 @@ def stage_candidates(powers: jnp.ndarray, numharm: int, topk: int):
     right = jnp.pad(summed[..., 1:], ((0, 0),) * (summed.ndim - 1) + ((0, 1),),
                     constant_values=0)
     is_peak = (summed >= left) & (summed > right)
-    vals, bins = jax.lax.top_k(jnp.where(is_peak, summed, 0.0), topk)
+    k = min(topk, summed.shape[-1])
+    vals, bins = jax.lax.top_k(jnp.where(is_peak, summed, 0.0), k)
     return vals, bins
 
 

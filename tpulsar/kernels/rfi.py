@@ -64,7 +64,8 @@ def cell_stats(data: jnp.ndarray, block_len: int):
     block_len samples: each output is (nblocks, nchan)."""
     T, nchan = data.shape
     nblocks = T // block_len
-    cells = data[: nblocks * block_len].reshape(nblocks, block_len, nchan)
+    cells = data[: nblocks * block_len].astype(jnp.float32).reshape(
+        nblocks, block_len, nchan)
     mean = cells.mean(axis=1)
     std = cells.std(axis=1)
     spec = jnp.fft.rfft(cells - mean[:, None, :], axis=1)
@@ -91,7 +92,9 @@ def find_rfi(data: np.ndarray | jnp.ndarray, dt: float,
     (`block_frac`) bad cells are zapped entirely — the same
     recommended-channel/interval semantics as rfifind's mask.
     """
-    mean, std, maxpow = cell_stats(jnp.asarray(data, jnp.float32), block_len)
+    # Pass the native dtype through; cell_stats casts per cell so a
+    # uint8 block never inflates to a full float32 copy.
+    mean, std, maxpow = cell_stats(jnp.asarray(data), block_len)
     mean, std, maxpow = (np.asarray(x) for x in (mean, std, maxpow))
 
     # Standardize each statistic both across time (catches bursts: a
@@ -112,15 +115,22 @@ def find_rfi(data: np.ndarray | jnp.ndarray, dt: float,
 def apply_mask(data: jnp.ndarray, cell_mask: jnp.ndarray,
                block_len: int) -> jnp.ndarray:
     """Replace masked cells of (T, nchan) data with the per-channel
-    median of unmasked samples (computed over block means for cost)."""
+    mean of unmasked samples (computed over block means for cost).
+
+    Output keeps the input dtype (uint8 blocks stay uint8 — the fill
+    is rounded), so a full-beam block never inflates to float32 in HBM.
+    """
     T, nchan = data.shape
     nblocks = cell_mask.shape[0]
     usable = nblocks * block_len
     cells = data[:usable].reshape(nblocks, block_len, nchan)
-    cmeans = cells.mean(axis=1)
+    cmeans = cells.astype(jnp.float32).mean(axis=1)
     good = ~cell_mask
     denom = jnp.maximum(good.sum(axis=0), 1)
     fill = (jnp.where(good, cmeans, 0.0).sum(axis=0) / denom)  # (nchan,)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        fill = jnp.round(fill)
+    fill = fill.astype(data.dtype)
     filled = jnp.where(cell_mask[:, None, :], fill[None, None, :], cells)
     out = filled.reshape(usable, nchan)
     if usable < T:

@@ -1,0 +1,1 @@
+"""Observability: structured logging, email notification, debug flags."""

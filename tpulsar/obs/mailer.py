@@ -1,0 +1,59 @@
+"""Email notification (capability parity with lib/python/mailer.py:10-50).
+
+ErrorMailer composes a message with host/program/time context and
+sends it via SMTP (plain, SSL, or STARTTLS, with optional login).  In
+hermetic environments a `sink` callable can be injected instead of a
+network send — the notification matrix (on failure / terminal failure
+/ crash) stays testable offline.
+"""
+
+from __future__ import annotations
+
+import getpass
+import smtplib
+import socket
+import sys
+import time
+from email.message import EmailMessage
+
+from tpulsar.config import settings
+
+
+class ErrorMailer:
+    def __init__(self, message: str, subject: str = "",
+                 config=None, sink=None):
+        self.config = config or settings().email
+        self.sink = sink
+        self.subject = f"[tpulsar] {subject}" if subject else "[tpulsar]"
+        self.msg_text = (
+            f"Host: {socket.gethostname()}\n"
+            f"Program: {sys.argv[0]}\n"
+            f"User: {getpass.getuser()}\n"
+            f"Time: {time.strftime('%Y-%m-%d %H:%M:%S')}\n\n"
+            f"{message}\n")
+
+    def send(self) -> bool:
+        cfg = self.config
+        if not cfg.enabled:
+            return False
+        if self.sink is not None:
+            self.sink(self.subject, self.msg_text)
+            return True
+        msg = EmailMessage()
+        msg["From"] = cfg.smtp_username or f"tpulsar@{socket.gethostname()}"
+        msg["To"] = cfg.recipient
+        msg["Subject"] = self.subject
+        msg.set_content(self.msg_text)
+        if cfg.use_ssl:
+            server = smtplib.SMTP_SSL(cfg.smtp_host, cfg.smtp_port or 465)
+        else:
+            server = smtplib.SMTP(cfg.smtp_host, cfg.smtp_port or 25)
+        try:
+            if cfg.use_tls and not cfg.use_ssl:
+                server.starttls()
+            if cfg.smtp_username:
+                server.login(cfg.smtp_username, cfg.smtp_password or "")
+            server.send_message(msg)
+        finally:
+            server.quit()
+        return True

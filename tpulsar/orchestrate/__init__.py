@@ -1,0 +1,2 @@
+"""Orchestration: durable job tracking, the job pool, queue backends,
+the downloader, and the verified results uploader."""

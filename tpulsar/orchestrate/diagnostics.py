@@ -1,0 +1,78 @@
+"""Per-beam diagnostics computed from a results directory.
+
+Capability parity with the reference's diagnostics layer
+(lib/python/diagnostics.py: FloatDiagnostic/PlotDiagnostic subclasses
+and the DIAGNOSTIC_TYPES list at :667-681): each diagnostic is derived
+from the search artifacts and uploaded with verify-after-write.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import tarfile
+
+import numpy as np
+
+from tpulsar.io import accelcands
+from tpulsar.orchestrate.uploadables import (
+    FloatDiagnosticUpload,
+    PlotDiagnosticUpload,
+    UploadError,
+)
+
+
+def get_diagnostics(resultsdir: str, basenm: str):
+    """Compute the per-beam diagnostic set (reference
+    diagnostics.py:632-681)."""
+    diags = []
+
+    # RFI masked fraction (reference RFIPercentageDiagnostic)
+    mask_file = os.path.join(resultsdir, f"{basenm}_rfifind.npz")
+    if os.path.exists(mask_file):
+        from tpulsar.kernels.rfi import RFIMask
+        mask = RFIMask.load(mask_file)
+        diags.append(FloatDiagnosticUpload(
+            "RFI mask percentage", 100.0 * mask.masked_fraction))
+        diags.append(FloatDiagnosticUpload(
+            "Num bad channels", float(mask.bad_channels.sum())))
+
+    # Candidate statistics from the sifted list
+    candfile = os.path.join(resultsdir, f"{basenm}.accelcands")
+    if os.path.exists(candfile):
+        cands = accelcands.parse_candlist(candfile)
+        diags.append(FloatDiagnosticUpload(
+            "Num candidates sifted", float(len(cands))))
+        if cands:
+            sigmas = [c.sigma for c in cands]
+            diags.append(FloatDiagnosticUpload("Max sigma", max(sigmas)))
+            diags.append(FloatDiagnosticUpload("Min sigma", min(sigmas)))
+            diags.append(FloatDiagnosticUpload(
+                "Num cands above 6 sigma",
+                float(sum(1 for s in sigmas if s >= 6.0))))
+
+    # Folded candidates
+    nfolded = len(glob.glob(os.path.join(resultsdir,
+                                         f"{basenm}_cand*.pfd.npz")))
+    diags.append(FloatDiagnosticUpload("Num cands folded", float(nfolded)))
+
+    # Single-pulse statistics
+    sp_npz = os.path.join(resultsdir, f"{basenm}_sp.npz")
+    if os.path.exists(sp_npz):
+        events = np.load(sp_npz, allow_pickle=False)["events"]
+        diags.append(FloatDiagnosticUpload(
+            "Num single-pulse events", float(len(events))))
+        if len(events):
+            diags.append(FloatDiagnosticUpload(
+                "Max single-pulse sigma", float(events["sigma"].max())))
+
+    # Timing report + params as blob diagnostics
+    for name, fn in (("Timing report", f"{basenm}.report"),
+                     ("Search parameters", "search_params.txt")):
+        path = os.path.join(resultsdir, fn)
+        if os.path.exists(path):
+            diags.append(PlotDiagnosticUpload(name, path))
+
+    if not diags:
+        raise UploadError(f"no diagnostics derivable from {resultsdir}")
+    return diags

@@ -1,0 +1,75 @@
+"""Pluggable cluster/queue backends.
+
+The reference defines a 7-method abstract interface every backend must
+implement (lib/python/queue_managers/generic_interface.py:7-99) and a
+3-level error taxonomy that drives the job pool's recovery decisions
+(lib/python/queue_managers/__init__.py:4-27).  Both are preserved
+here; backends are: an in-process LocalProcessManager (testing +
+single-node), Slurm and PBS CLI backends, and a TPUSliceManager that
+fans beam jobs out to TPU hosts.
+"""
+
+from __future__ import annotations
+
+
+class QueueManagerFatalError(Exception):
+    """The queue system itself is broken: stop the daemon."""
+
+
+class QueueManagerJobFatalError(Exception):
+    """This job cannot be submitted: mark the job failed."""
+
+
+class QueueManagerNonFatalError(Exception):
+    """Transient problem: leave the job queued and retry later."""
+
+
+class PipelineQueueManager:
+    """Abstract queue backend (reference generic_interface.py:7-99)."""
+
+    def submit(self, datafiles: list[str], outdir: str,
+               job_id: int) -> str:
+        """Submit a search job; return the queue id."""
+        raise NotImplementedError
+
+    def can_submit(self) -> bool:
+        """True if another job may be submitted now."""
+        raise NotImplementedError
+
+    def is_running(self, queue_id: str) -> bool:
+        """True if the job is queued or running."""
+        raise NotImplementedError
+
+    def delete(self, queue_id: str) -> bool:
+        """Remove/terminate the job; True on success."""
+        raise NotImplementedError
+
+    def status(self) -> tuple[int, int]:
+        """(num_queued, num_running)."""
+        raise NotImplementedError
+
+    def had_errors(self, queue_id: str) -> bool:
+        """True if the (finished) job produced errors."""
+        raise NotImplementedError
+
+    def get_errors(self, queue_id: str) -> str:
+        """The error text of a finished job ('' if none)."""
+        raise NotImplementedError
+
+
+def get_queue_manager(name: str, **kw) -> PipelineQueueManager:
+    if name == "local":
+        from tpulsar.orchestrate.queue_managers.local import (
+            LocalProcessManager)
+        return LocalProcessManager(**kw)
+    if name == "slurm":
+        from tpulsar.orchestrate.queue_managers.slurm import SlurmManager
+        return SlurmManager(**kw)
+    if name == "pbs":
+        from tpulsar.orchestrate.queue_managers.pbs import PBSManager
+        return PBSManager(**kw)
+    if name == "tpu_slice":
+        from tpulsar.orchestrate.queue_managers.tpu_slice import (
+            TPUSliceManager)
+        return TPUSliceManager(**kw)
+    raise ValueError(f"unknown queue manager {name!r}")

@@ -1,0 +1,176 @@
+"""In-process queue backend: runs search jobs as local subprocesses.
+
+This is the backend the reference never had — a hermetic queue manager
+(SURVEY.md section 4 calls this the biggest testing gap): it lets the
+whole JobPool/daemon machinery run on one machine (or one TPU host)
+with no cluster, and is the default for tests and single-host
+deployments.
+
+Jobs are launched as `python -m tpulsar.cli.search_job` with the same
+DATAFILES/OUTDIR environment contract the reference's PBS backend uses
+(pbs.py:67-69: env vars because batch schedulers pass no argv).
+
+Queue state (pid, stderr path, exit code) is persisted to a state
+directory, so a restarted JobPool daemon can keep polling jobs an
+earlier process submitted — the same restart-from-DB-state resilience
+the cluster backends get from the scheduler (SURVEY.md section 5.4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+
+class LocalProcessManager:
+    def __init__(self, max_jobs_running: int = 1, script: str | None = None,
+                 env_extra: dict | None = None,
+                 state_dir: str | None = None):
+        self.max_jobs_running = max_jobs_running
+        self.script = script
+        self.env_extra = env_extra or {}
+        self.state_dir = state_dir or os.path.join(
+            tempfile.gettempdir(), "tpulsar_localq")
+        os.makedirs(self.state_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._next = 1
+
+    # ------------------------------------------------------------ state io
+
+    def _state_path(self, qid: str) -> str:
+        return os.path.join(self.state_dir, f"{qid}.json")
+
+    def _load(self, qid: str) -> dict | None:
+        try:
+            with open(self._state_path(qid)) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def _all_states(self) -> list[dict]:
+        out = []
+        for fn in os.listdir(self.state_dir):
+            if fn.endswith(".json"):
+                st = self._load(fn[:-5])
+                if st:
+                    out.append(st)
+        return out
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+        return True
+
+    def _exit_code(self, st: dict) -> int | None:
+        """None while running; exit code once the rc file appears."""
+        rc_path = st["rc_file"]
+        if os.path.exists(rc_path):
+            try:
+                with open(rc_path) as fh:
+                    return int(fh.read().strip() or 1)
+            except ValueError:
+                return 1
+        if self._pid_alive(st["pid"]):
+            return None
+        return 1   # died without writing rc (crash/kill)
+
+    # ------------------------------------------------------------- command
+
+    def _cmd(self) -> str:
+        if self.script:
+            return shlex.quote(self.script)
+        return f"{shlex.quote(sys.executable)} -m tpulsar.cli.search_job"
+
+    def submit(self, datafiles: list[str], outdir: str, job_id: int) -> str:
+        os.makedirs(outdir, exist_ok=True)
+        env = dict(os.environ)
+        env["DATAFILES"] = ";".join(datafiles)
+        env["OUTDIR"] = outdir
+        env.update(self.env_extra)
+        with self._lock:
+            qid = f"local-{os.getpid()}-{self._next}"
+            self._next += 1
+        errpath = os.path.join(outdir, f"{qid}.stderr")
+        rc_path = os.path.join(self.state_dir, f"{qid}.rc")
+        # Shell wrapper records the exit code on disk so any process
+        # can later distinguish success from failure.
+        shell = (f"{self._cmd()}; echo $? > {shlex.quote(rc_path)}")
+        with open(errpath, "wb") as errfh:
+            proc = subprocess.Popen(["/bin/sh", "-c", shell], env=env,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=errfh,
+                                    start_new_session=True)
+        with open(self._state_path(qid), "w") as fh:
+            json.dump({"qid": qid, "pid": proc.pid, "stderr": errpath,
+                       "rc_file": rc_path, "outdir": outdir,
+                       "job_id": job_id, "submitted_at": time.time()}, fh)
+        return qid
+
+    # ------------------------------------------------------------- queries
+
+    def can_submit(self) -> bool:
+        return self.status()[1] < self.max_jobs_running
+
+    def is_running(self, queue_id: str) -> bool:
+        st = self._load(queue_id)
+        return st is not None and self._exit_code(st) is None
+
+    def delete(self, queue_id: str) -> bool:
+        st = self._load(queue_id)
+        if st is None:
+            return False
+        if self._exit_code(st) is None:
+            try:
+                os.killpg(os.getpgid(st["pid"]), 15)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(st["pid"], 15)
+                except OSError:
+                    pass
+            for _ in range(20):
+                if not self._pid_alive(st["pid"]):
+                    break
+                time.sleep(0.1)
+        return True
+
+    def status(self) -> tuple[int, int]:
+        running = sum(1 for st in self._all_states()
+                      if self._exit_code(st) is None)
+        return 0, running
+
+    def had_errors(self, queue_id: str) -> bool:
+        """Nonzero recorded exit code or non-empty stderr (reference
+        pbs.py:209-230 uses stderr size alone)."""
+        st = self._load(queue_id)
+        if st is None:
+            return True
+        rc = self._exit_code(st)
+        if rc not in (0, None):
+            return True
+        err = st["stderr"]
+        return os.path.exists(err) and os.path.getsize(err) > 0
+
+    def get_errors(self, queue_id: str) -> str:
+        st = self._load(queue_id)
+        if st is None:
+            return f"no queue state for {queue_id}"
+        parts = []
+        rc = self._exit_code(st)
+        if rc not in (0, None):
+            parts.append(f"exit code {rc}")
+        err = st["stderr"]
+        if os.path.exists(err) and os.path.getsize(err):
+            with open(err, errors="replace") as fh:
+                parts.append(fh.read())
+        return "\n".join(parts)

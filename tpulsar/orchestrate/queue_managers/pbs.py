@@ -1,0 +1,111 @@
+"""PBS/Torque queue backend (qsub/qstat/qdel via subprocess).
+
+Covers the reference's PBS backend capabilities
+(lib/python/queue_managers/pbs.py): env-var argument passing
+(DATAFILES/OUTDIR because PBS passes no argv, pbs.py:67-69), running
+state from qstat, stderr-file error detection (pbs.py:209-230), and
+submission caps.  Polling uses `qstat -f <id>` parsing instead of the
+PBSQuery library.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+
+from tpulsar.orchestrate.queue_managers import (
+    QueueManagerJobFatalError,
+    QueueManagerNonFatalError,
+)
+
+
+class PBSManager:
+    def __init__(self, script: str, queue_name: str = "",
+                 max_jobs_running: int = 50, max_jobs_queued: int = 1,
+                 job_basename: str = "tpulsar", ppn: int = 1,
+                 runner=subprocess.run):
+        self.script = script
+        self.queue_name = queue_name
+        self.max_jobs_running = max_jobs_running
+        self.max_jobs_queued = max_jobs_queued
+        self.job_basename = job_basename
+        self.ppn = ppn
+        self._run = runner
+        self._stderr: dict[str, str] = {}
+
+    def submit(self, datafiles: list[str], outdir: str, job_id: int) -> str:
+        os.makedirs(outdir, exist_ok=True)
+        errpath = os.path.join(outdir, f"job{job_id}.stderr")
+        cmd = ["qsub", "-V",
+               "-v", f"DATAFILES={';'.join(datafiles)},OUTDIR={outdir}",
+               "-N", f"{self.job_basename}{job_id}",
+               "-l", f"nodes=1:ppn={self.ppn}",
+               "-o", os.path.join(outdir, f"job{job_id}.stdout"),
+               "-e", errpath]
+        if self.queue_name:
+            cmd += ["-q", self.queue_name]
+        cmd.append(self.script)
+        r = self._run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            stderr = (r.stderr or "").strip()
+            if "Unauthorized" in stderr or "qsub: illegal" in stderr:
+                raise QueueManagerJobFatalError(f"qsub rejected: {stderr}")
+            raise QueueManagerNonFatalError(
+                f"qsub failed (rc={r.returncode}): {stderr}")
+        qid = r.stdout.strip().splitlines()[-1].strip()
+        if not qid:
+            raise QueueManagerNonFatalError("qsub returned no job id")
+        self._stderr[qid] = errpath
+        return qid
+
+    def _qstat_states(self) -> dict[str, str]:
+        r = self._run(["qstat"], capture_output=True, text=True)
+        if r.returncode != 0:
+            raise QueueManagerNonFatalError(
+                f"qstat failed: {(r.stderr or '').strip()}")
+        states = {}
+        for ln in r.stdout.splitlines():
+            m = re.match(r"^(\S+)\s+(\S+)\s+\S+\s+\S+\s+([A-Z])\s", ln)
+            if m and m.group(2).startswith(self.job_basename):
+                states[m.group(1)] = m.group(3)
+        return states
+
+    def can_submit(self) -> bool:
+        queued, running = self.status()
+        return (running < self.max_jobs_running
+                and queued < self.max_jobs_queued)
+
+    def is_running(self, queue_id: str) -> bool:
+        try:
+            states = self._qstat_states()
+        except QueueManagerNonFatalError:
+            return True
+        return any(qid.startswith(str(queue_id).split(".")[0])
+                   for qid in states)
+
+    def delete(self, queue_id: str) -> bool:
+        r = self._run(["qdel", str(queue_id)], capture_output=True,
+                      text=True)
+        return r.returncode == 0
+
+    def status(self) -> tuple[int, int]:
+        queued = running = 0
+        for state in self._qstat_states().values():
+            if state == "R":
+                running += 1
+            elif state in ("Q", "H", "W"):
+                queued += 1
+        return queued, running
+
+    def had_errors(self, queue_id: str) -> bool:
+        errpath = self._stderr.get(queue_id)
+        return bool(errpath and os.path.exists(errpath)
+                    and os.path.getsize(errpath) > 0)
+
+    def get_errors(self, queue_id: str) -> str:
+        errpath = self._stderr.get(queue_id)
+        if errpath and os.path.exists(errpath):
+            with open(errpath, errors="replace") as fh:
+                return fh.read()
+        return ""

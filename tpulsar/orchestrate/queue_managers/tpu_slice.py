@@ -1,0 +1,127 @@
+"""TPU-slice queue backend: fan beam jobs out to a pool of TPU hosts.
+
+The TPU-era replacement for the reference's cluster backends
+(SURVEY.md section 5.8): each "queue slot" is a TPU host (or slice)
+reachable by a launcher command; one beam search occupies one slot.
+Beams are independent, so no inter-beam communication is needed — DCN
+is used only for job launch and result return, while each beam's
+DM-trial parallelism rides ICI inside its slice
+(tpulsar.parallel.mesh).
+
+The launcher command is pluggable (default: ssh).  Each slot runs the
+same search-job entry as the local backend, with the DATAFILES/OUTDIR
+environment contract; results land on the shared filesystem exactly
+like the reference's rsync-based return path (bin/search.py:188-192).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import threading
+
+
+class TPUSliceManager:
+    def __init__(self, hosts: list[str],
+                 launcher: str = "ssh {host} {cmd}",
+                 remote_cmd: str = "python -m tpulsar.cli.search_job",
+                 env_extra: dict | None = None):
+        """hosts: TPU host addresses, one concurrent beam each.
+        launcher: template with {host} and {cmd} placeholders."""
+        if not hosts:
+            raise ValueError("TPUSliceManager needs at least one host")
+        self.hosts = list(hosts)
+        self.launcher = launcher
+        self.remote_cmd = remote_cmd
+        self.env_extra = env_extra or {}
+        self._lock = threading.Lock()
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._host_of: dict[str, str] = {}
+        self._stderr: dict[str, str] = {}
+        self._next = 1
+
+    def _free_host(self) -> str | None:
+        with self._lock:
+            busy = {self._host_of[qid] for qid, p in self._procs.items()
+                    if p.poll() is None}
+        for h in self.hosts:
+            if h not in busy:
+                return h
+        return None
+
+    def submit(self, datafiles: list[str], outdir: str, job_id: int) -> str:
+        host = self._free_host()
+        if host is None:
+            from tpulsar.orchestrate.queue_managers import (
+                QueueManagerNonFatalError)
+            raise QueueManagerNonFatalError("no free TPU slice")
+        os.makedirs(outdir, exist_ok=True)
+        envs = {"DATAFILES": ";".join(datafiles), "OUTDIR": outdir,
+                **self.env_extra}
+        env_prefix = " ".join(f"{k}={shlex.quote(v)}"
+                              for k, v in envs.items())
+        cmd = f"{env_prefix} {self.remote_cmd}"
+        full = self.launcher.format(host=host, cmd=shlex.quote(cmd))
+        with self._lock:
+            qid = f"tpu-{self._next}"
+            self._next += 1
+        errpath = os.path.join(outdir, f"{qid}.stderr")
+        errfh = open(errpath, "wb")
+        proc = subprocess.Popen(shlex.split(full),
+                                stdout=subprocess.DEVNULL, stderr=errfh)
+        with self._lock:
+            self._procs[qid] = proc
+            self._host_of[qid] = host
+            self._stderr[qid] = errpath
+        return qid
+
+    def can_submit(self) -> bool:
+        return self._free_host() is not None
+
+    def is_running(self, queue_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(queue_id)
+        return proc is not None and proc.poll() is None
+
+    def delete(self, queue_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(queue_id)
+        if proc is None:
+            return False
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        return True
+
+    def status(self) -> tuple[int, int]:
+        with self._lock:
+            running = sum(1 for p in self._procs.values()
+                          if p.poll() is None)
+        return 0, running
+
+    def had_errors(self, queue_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(queue_id)
+            errpath = self._stderr.get(queue_id)
+        if proc is None:
+            return True
+        if proc.poll() not in (0, None):
+            return True
+        return bool(errpath and os.path.exists(errpath)
+                    and os.path.getsize(errpath) > 0)
+
+    def get_errors(self, queue_id: str) -> str:
+        with self._lock:
+            proc = self._procs.get(queue_id)
+            errpath = self._stderr.get(queue_id)
+        parts = []
+        if proc is not None and proc.poll() not in (0, None):
+            parts.append(f"exit code {proc.poll()}")
+        if errpath and os.path.exists(errpath) and os.path.getsize(errpath):
+            with open(errpath, errors="replace") as fh:
+                parts.append(fh.read())
+        return "\n".join(parts)

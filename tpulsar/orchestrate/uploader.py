@@ -1,0 +1,212 @@
+"""Upload orchestrator: find processed submissions, parse their result
+directories, and upload everything in one verified transaction.
+
+Capability parity with the reference's JobUploader (lib/python/
+JobUploader.py): processed submits are discovered from the tracker
+(:34-37), the whole beam (header + candidates + SP + diagnostics) is
+one transaction so partial uploads are impossible (:93-134,183-185),
+the error taxonomy maps parse/verify failures to job failure
+(re-process), connection/deadlock errors to retry-later (:137-182),
+and the code version is pinned per results dir via version_number.txt
+so retried uploads use the original version (:48-70).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import time
+import traceback
+
+import numpy as np
+
+from tpulsar.io import accelcands
+from tpulsar.obs.log import get_logger
+from tpulsar.orchestrate import diagnostics as diag_mod
+from tpulsar.orchestrate.jobtracker import JobTracker
+from tpulsar.orchestrate.results_db import (
+    DatabaseConnectionError,
+    DatabaseDeadlockError,
+    ResultsDB,
+)
+from tpulsar.orchestrate.uploadables import (
+    HeaderUpload,
+    PeriodicityCandidateUpload,
+    SinglePulseUpload,
+    UploadError,
+)
+
+
+def pipeline_version() -> str:
+    """Code version: git hash of the tpulsar tree (reference
+    config/upload.py:7-21 hashes PRESTO+pipeline+psrfits_utils)."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(["git", "-C", repo, "rev-parse",
+                              "--short", "HEAD"],
+                             capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    from tpulsar import __version__
+    return f"v{__version__}"
+
+
+def get_version_number(resultsdir: str) -> str:
+    """Pin the version per results dir (reference JobUploader.py:48-70)."""
+    path = os.path.join(resultsdir, "version_number.txt")
+    if os.path.exists(path):
+        with open(path) as fh:
+            return fh.read().strip()
+    ver = pipeline_version()
+    with open(path, "w") as fh:
+        fh.write(ver + "\n")
+    return ver
+
+
+class JobUploader:
+    def __init__(self, tracker: JobTracker, db_url: str | None = None,
+                 notify=None, delete_raw_on_upload: bool = False,
+                 logger=None):
+        self.t = tracker
+        self.db_url = db_url
+        self.notify = notify or (lambda subject, body: None)
+        self.delete_raw_on_upload = delete_raw_on_upload
+        self.log = logger or get_logger("uploader")
+
+    def run(self) -> None:
+        """One daemon iteration: upload every processed submit."""
+        rows = self.t.query(
+            "SELECT s.id sid, s.job_id, s.output_dir FROM job_submits s "
+            "WHERE s.status='processed'")
+        for row in rows:
+            self.upload_results(row["sid"], row["job_id"],
+                                row["output_dir"])
+
+    # -------------------------------------------------------------- parse
+
+    def parse_results(self, resultsdir: str):
+        """Build the uploadable tree from a results directory."""
+        hdr_path = os.path.join(resultsdir, "header.json")
+        if not os.path.exists(hdr_path):
+            raise UploadError(f"no header.json in {resultsdir}")
+        with open(hdr_path) as fh:
+            hdr_fields = json.load(fh)
+        version = get_version_number(resultsdir)
+        header = HeaderUpload(version_number=version, **hdr_fields)
+        basenm = _basenm_from_dir(resultsdir)
+
+        candfile = os.path.join(resultsdir, f"{basenm}.accelcands")
+        cands = accelcands.parse_candlist(candfile) \
+            if os.path.exists(candfile) else []
+        for i, c in enumerate(cands, start=1):
+            plots = []
+            pfd = os.path.join(resultsdir, f"{basenm}_cand{i}.pfd.npz")
+            bp = os.path.join(resultsdir, f"{basenm}_cand{i}.bestprof")
+            chi2 = 0.0
+            if os.path.exists(pfd):
+                plots.append(("pfd", pfd))
+                with np.load(pfd) as z:
+                    chi2 = float(z["reduced_chi2"])
+            if os.path.exists(bp):
+                plots.append(("bestprof", bp))
+            header.add_dependent(PeriodicityCandidateUpload(
+                cand_num=i, period_s=c.period_s, freq_hz=c.freq_hz,
+                pdot=0.0, dm=c.dm, snr=float(np.sqrt(max(c.power, 0.0))),
+                sigma=c.sigma, numharm=c.numharm, fourier_bin=c.r,
+                z=c.z, num_dm_hits=c.num_dm_hits, reduced_chi2=chi2,
+                plots=plots))
+
+        sp_npz = os.path.join(resultsdir, f"{basenm}_sp.npz")
+        events = (np.load(sp_npz)["events"] if os.path.exists(sp_npz)
+                  else np.empty(0))
+        tarballs = [(suffix.strip("_").replace(".tgz", ""), p)
+                    for suffix in ("_singlepulse.tgz", "_inf.tgz")
+                    for p in glob.glob(os.path.join(resultsdir,
+                                                    f"{basenm}{suffix}"))]
+        sp = SinglePulseUpload(events=events, tarballs=tarballs)
+        header.add_dependent(sp)
+
+        diags = diag_mod.get_diagnostics(resultsdir, basenm)
+        return header, diags
+
+    # ------------------------------------------------------------- upload
+
+    def upload_results(self, submit_id: int, job_id: int,
+                       resultsdir: str) -> None:
+        """One-beam upload with the reference's rollback taxonomy
+        (JobUploader.py:73-206)."""
+        try:
+            header, diags = self.parse_results(resultsdir)
+        except UploadError as e:
+            self.t.update("job_submits", submit_id, status="upload_failed",
+                          details=str(e)[:4000])
+            self.t.update("jobs", job_id, status="failed",
+                          details="result parsing failed")
+            self.log.warning("submit %d parse failed: %s", submit_id, e)
+            return
+
+        db = None
+        try:
+            db = ResultsDB(self.db_url)
+            header.upload(db)
+            for d in diags:
+                d.header_id = header.header_id
+                d.upload(db)
+            db.commit()
+        except (DatabaseConnectionError, DatabaseDeadlockError) as e:
+            if db:
+                db.rollback()
+            self.log.warning("submit %d upload deferred: %s", submit_id, e)
+            return                      # leave processed: retry later
+        except UploadError as e:
+            if db:
+                db.rollback()
+            self.t.update("job_submits", submit_id, status="upload_failed",
+                          details=str(e)[:4000])
+            self.t.update("jobs", job_id, status="failed",
+                          details="upload verification failed")
+            self.log.warning("submit %d upload failed: %s", submit_id, e)
+            return
+        except Exception:
+            if db:
+                db.rollback()
+            self.log.error("submit %d unexpected upload error:\n%s",
+                           submit_id, traceback.format_exc())
+            raise
+        finally:
+            if db:
+                db.close()
+
+        self.t.update("job_submits", submit_id, status="uploaded",
+                      details="uploaded and verified")
+        self.t.update("jobs", job_id, status="uploaded",
+                      details="complete")
+        self.log.info("submit %d uploaded (header %s)", submit_id,
+                      header.header_id)
+        if self.delete_raw_on_upload:
+            self._delete_raw(job_id)
+
+    def _delete_raw(self, job_id: int) -> None:
+        for row in self.t.query(
+                "SELECT f.id, f.filename FROM files f JOIN job_files jf "
+                "ON jf.file_id=f.id WHERE jf.job_id=?", [job_id]):
+            if os.path.exists(row["filename"]):
+                os.remove(row["filename"])
+            self.t.update("files", row["id"], status="deleted",
+                          details="deleted after successful upload")
+
+
+def _basenm_from_dir(resultsdir: str) -> str:
+    """Recover the beam base name from the artifacts present."""
+    reports = glob.glob(os.path.join(resultsdir, "*.report"))
+    if reports:
+        return os.path.splitext(os.path.basename(reports[0]))[0]
+    cands = glob.glob(os.path.join(resultsdir, "*.accelcands"))
+    if cands:
+        return os.path.splitext(os.path.basename(cands[0]))[0]
+    raise UploadError(f"cannot determine base name in {resultsdir}")

@@ -1,0 +1,14 @@
+"""Parallel layer: device meshes, sharded search, distributed FFT.
+
+The reference's only parallelism is embarrassingly-parallel batch jobs
+(SURVEY.md section 2.4).  Here parallelism is first-class and TPU-
+native: a (beam, dm) jax.sharding.Mesh carries data-parallel beams and
+DM-trial sharding over ICI; long time series can additionally be
+sharded along time with a collective-transpose distributed FFT.
+"""
+
+from tpulsar.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    sharded_search_step,
+    SearchStepSpec,
+)

@@ -1,0 +1,107 @@
+"""Distributed FFT: time-axis ("sequence") parallelism for series too
+long for one chip.
+
+The reference's analogous long-sequence machinery is disk streaming
+(SURVEY.md section 5.7).  On TPU the equivalent is sharding the time
+axis across the mesh and computing the FFT with the classic four-step
+algorithm, with the inter-chip transpose expressed as an all_to_all
+that XLA lowers onto ICI:
+
+  x (length N = A*B, viewed as rows[a, b] = x[a*B+b], rows sharded)
+    1. all_to_all transpose so each device holds all a for a b-chunk
+    2. local FFT along a              -> F1[k1, b]
+    3. twiddle exp(-2*pi*i*k1*b/N)
+    4. all_to_all transpose back so each device holds all b for a
+       k1-chunk
+    5. local FFT along b              -> out[k1, k2] = X[k1 + A*k2]
+
+The output is returned in (k1, k2) "transposed digit" order together
+with an index map, which downstream power-spectrum consumers use
+directly (candidate bins are mapped back to true frequencies on host —
+no global re-sort is ever materialized).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def dist_fft(x: jnp.ndarray, mesh: Mesh, axis_name: str = "dm"):
+    """FFT of a complex series sharded along its (single) axis.
+
+    x: (N,) complex64, N = A*B with A divisible by the mesh axis size.
+    Returns X_t of shape (B, A): X_t[b, a] = X[a*B + b] — the true
+    spectrum in transposed-digit order, still sharded (B rows over the
+    axis).
+    """
+    n_dev = mesh.shape[axis_name]
+    N = x.shape[0]
+    A = _choose_A(N, n_dev)
+    B = N // A
+    A_loc, B_loc = A // n_dev, B // n_dev
+
+    def body(x_shard):
+        # x_shard: (N/n,) == A_loc contiguous rows of length B.
+        rows = x_shard.reshape(A_loc, B)
+        # --- transpose 1: (A_loc, B) -> (A, B_loc)
+        t1 = rows.reshape(A_loc, n_dev, B_loc).transpose(1, 0, 2)
+        t1 = jax.lax.all_to_all(t1, axis_name, 0, 0)   # (n, A_loc, B_loc)
+        cols = t1.reshape(A, B_loc)                    # [a, b_loc]
+        # --- FFT along a (the DFT over the slow digit must come first)
+        f1 = jnp.fft.fft(cols, axis=0)                 # [k1, b_loc]
+        # --- twiddle exp(-2 pi i k1 b / N)
+        b_idx = (jax.lax.axis_index(axis_name) * B_loc
+                 + jnp.arange(B_loc))
+        k1 = jnp.arange(A)
+        tw = jnp.exp(-2j * jnp.pi * (k1[:, None] * b_idx[None, :]) / N)
+        g = (f1 * tw).astype(jnp.complex64)
+        # --- transpose 2: (A, B_loc) -> (A_loc, B)
+        t2 = g.reshape(n_dev, A_loc, B_loc)
+        t2 = jax.lax.all_to_all(t2, axis_name, 0, 0)   # (n, A_loc, B_loc)
+        full = t2.transpose(1, 0, 2).reshape(A_loc, B)  # [k1_loc, b]
+        # --- FFT along b
+        return jnp.fft.fft(full, axis=1)               # [k1_loc, k2]
+
+    from jax import shard_map
+    fn = shard_map(body, mesh=mesh, in_specs=P(axis_name),
+                   out_specs=P(axis_name, None), check_vma=False)
+    return fn(x.astype(jnp.complex64))
+
+
+def _choose_A(N: int, n_dev: int) -> int:
+    """Pick A ~ sqrt(N) with n_dev | A and n_dev | N//A."""
+    A = int(np.sqrt(N))
+    while A > n_dev:
+        if N % A == 0 and A % n_dev == 0 and (N // A) % n_dev == 0:
+            return A
+        A -= 1
+    return n_dev
+
+
+def transposed_index_map(N: int, A: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side map between transposed-digit order and natural order:
+    out[k1, k2] = X[k1 + A*k2].  Returns (to_natural, B) where
+    to_natural[k1, k2] = k1 + A*k2."""
+    B = N // A
+    k1 = np.arange(A)[:, None]
+    k2 = np.arange(B)[None, :]
+    return k1 + A * k2, B
+
+
+def dist_fft_natural(x: np.ndarray, mesh: Mesh, axis_name: str = "dm"
+                     ) -> np.ndarray:
+    """Convenience wrapper (host in/out, natural order) for tests and
+    moderate sizes; production consumers keep transposed order."""
+    N = len(x)
+    n_dev = mesh.shape[axis_name]
+    A = _choose_A(N, n_dev)
+    Xt = np.asarray(dist_fft(jnp.asarray(x), mesh, axis_name))
+    idx, B = transposed_index_map(N, A)
+    out = np.empty(N, dtype=np.complex64)
+    out[idx.ravel()] = Xt.ravel()
+    return out

@@ -1,0 +1,140 @@
+"""Device mesh construction and the sharded per-beam search step.
+
+TPU-native equivalent of the reference's parallelism inventory
+(SURVEY.md section 2.4): beams are data-parallel (the reference fans
+them out as cluster jobs; here they ride a mesh axis), and within a
+beam the DM-trial axis — the reference's per-DM subprocess loop,
+PALFA2_presto_search.py:532-594 — is sharded across chips with a
+single all_gather at the end to collect per-trial top-k candidates.
+
+Layout choices:
+  * subbands (nsub, T') are replicated across the `dm` axis (nsub=96
+    subbands are small; replication avoids a halo exchange for the
+    shift gathers);
+  * stage-2 shift tables (ndms, nsub) are sharded along `dm`;
+  * each device computes its DM chunk's series, spectrum, whitening,
+    harmonic sums, and top-k locally — candidates (k floats per trial)
+    are the only thing crossing ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_beam: int = 1, n_dm: int | None = None,
+              devices=None) -> Mesh:
+    """Build a (beam, dm) mesh over the available devices."""
+    devices = devices if devices is not None else jax.devices()
+    n_dev = len(devices)
+    if n_dm is None:
+        if n_dev % n_beam:
+            raise ValueError(f"{n_dev} devices not divisible by beam={n_beam}")
+        n_dm = n_dev // n_beam
+    if n_beam * n_dm != n_dev:
+        raise ValueError(f"mesh {n_beam}x{n_dm} != {n_dev} devices")
+    arr = np.asarray(devices).reshape(n_beam, n_dm)
+    return Mesh(arr, axis_names=("beam", "dm"))
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchStepSpec:
+    """Static configuration of one sharded search step."""
+    nsub: int
+    nfft: int          # padded FFT length (power of 2)
+    max_numharm: int
+    topk: int
+    whiten_edges: tuple[int, ...]
+
+
+def _local_search(subbands, sub_shifts, keep_mask, spec: SearchStepSpec):
+    """Per-device body: dedisperse local DM chunk -> rfft -> whiten ->
+    harmonic top-k.  Returns dict of stage -> (vals, bins)."""
+    from tpulsar.kernels.dedisperse import _shift_gather
+    from tpulsar.kernels.fourier import (harmonic_stages, harmonic_sum,
+                                         whiten_powers)
+
+    def one_dm(shifts):
+        return _shift_gather(subbands, shifts).sum(axis=0)
+
+    series = jax.vmap(one_dm)(sub_shifts)              # (ndms_loc, T')
+    series = series - series.mean(axis=-1, keepdims=True)
+    nfft = spec.nfft
+    T = series.shape[-1]
+    if T < nfft:
+        series = jnp.pad(series, ((0, 0), (0, nfft - T)))
+    else:
+        series = series[:, :nfft]
+    powers = jnp.abs(jnp.fft.rfft(series, axis=-1)) ** 2
+    powers = powers.at[..., 0].set(0.0)
+    powers = powers * keep_mask
+    powers = whiten_powers(powers, spec.whiten_edges)
+    powers = powers * keep_mask
+
+    out = {}
+    for h in harmonic_stages(spec.max_numharm):
+        summed = harmonic_sum(powers, h)
+        left = jnp.pad(summed[:, :-1], ((0, 0), (1, 0)))
+        right = jnp.pad(summed[:, 1:], ((0, 0), (0, 1)))
+        peaks = jnp.where((summed >= left) & (summed > right), summed, 0.0)
+        vals, bins = jax.lax.top_k(peaks, min(spec.topk, peaks.shape[-1]))
+        out[h] = (vals, bins)
+    return out
+
+
+def sharded_search_step(mesh: Mesh, spec: SearchStepSpec):
+    """Build the jitted multi-chip search step for one dedispersion
+    pass.
+
+    Returns fn(subbands[nbeams, nsub, T'], sub_shifts[nbeams, ndms, nsub],
+               keep_mask[nfft//2+1])
+    -> {stage: (vals[nbeams, ndms_total... -> (nbeams, ndms, topk)], bins)}
+
+    Sharding: beams over the `beam` axis, DM trials over `dm`; output
+    candidate blocks are all_gathered over `dm` so every host sees the
+    full candidate set.
+    """
+
+    def step(subbands, sub_shifts, keep_mask):
+        def per_shard(subb, shifts, mask):
+            # shapes here are the per-device shards:
+            # subb (1, nsub, T'), shifts (1, ndms_loc, nsub)
+            res = _local_search(subb[0], shifts[0], mask, spec)
+            # gather DM-chunk results across the dm axis
+            return {h: (jax.lax.all_gather(v, "dm", axis=0, tiled=True)[None],
+                        jax.lax.all_gather(b, "dm", axis=0, tiled=True)[None])
+                    for h, (v, b) in res.items()}
+
+        from jax import shard_map
+        return shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P("beam", None, None), P("beam", "dm", None), P()),
+            out_specs={h: (P("beam", None, None), P("beam", None, None))
+                       for h in _stages(spec)},
+            check_vma=False,
+        )(subbands, sub_shifts, keep_mask)
+
+    return jax.jit(step)
+
+
+def _stages(spec: SearchStepSpec):
+    from tpulsar.kernels.fourier import harmonic_stages
+    return harmonic_stages(spec.max_numharm)
+
+
+def shard_dm_table(sub_shifts: np.ndarray, n_dm: int) -> np.ndarray:
+    """Pad the (ndms, nsub) stage-2 shift table so ndms divides the dm
+    axis size (padded trials repeat the last row; their duplicate
+    candidates merge away in sifting)."""
+    ndms = sub_shifts.shape[0]
+    rem = (-ndms) % n_dm
+    if rem:
+        pad = np.repeat(sub_shifts[-1:], rem, axis=0)
+        sub_shifts = np.concatenate([sub_shifts, pad], axis=0)
+    return sub_shifts

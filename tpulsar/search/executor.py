@@ -1,0 +1,408 @@
+"""The per-beam search executor — tpulsar's scientific core.
+
+Reproduces the stage sequence of the reference's search driver
+(lib/python/PALFA2_presto_search.py: obs_info :231, set_up_job :444,
+search_job :468, clean_up :691) with the PRESTO subprocess chain
+replaced by the TPU kernels:
+
+  rfifind            -> kernels.rfi.find_rfi / apply_mask
+  prepsubband -sub   -> kernels.dedisperse.form_subbands
+  prepsubband        -> kernels.dedisperse.dedisperse_subbands
+  single_pulse_search-> kernels.singlepulse.single_pulse_search
+  realfft/zapbirds/
+  rednoise/accelsearch(z=0) -> kernels.fourier.periodicity_search
+  accelsearch(z>0)   -> kernels.accel.accel_search_one
+  sifting            -> search.sifting
+  prepfold           -> kernels.fold.fold_and_optimize
+
+Artifacts written to the results directory mirror the reference's
+output contract (so the uploader layer parses them the same way):
+  <base>_rfifind.npz             RFI mask
+  <base>.accelcands              sifted candidate list
+  <base>_DM*.singlepulse         per-DM single-pulse events
+  <base>_DM*.inf                 per-DM series metadata
+  <base>_cand*.pfd.npz/.bestprof folded candidates
+  search_params.txt              config provenance (python-literal)
+  <base>.report                  per-stage timing breakdown
+  <base>_*.tgz                   result-class tarballs
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tarfile
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpulsar.io import accelcands, datafile
+from tpulsar.kernels import accel as accel_k
+from tpulsar.kernels import dedisperse as dd
+from tpulsar.kernels import fold as fold_k
+from tpulsar.kernels import fourier as fr
+from tpulsar.kernels import rfi as rfi_k
+from tpulsar.kernels import singlepulse as sp_k
+from tpulsar.plan import ddplan
+from tpulsar.search import sifting
+from tpulsar.search.report import StageTimers
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Search configuration (defaults mirror the reference's searching
+    config, lib/python/config/searching_example.py)."""
+    nsub: int = 96
+    rfifind_blocklen: int = 2048
+    rfi_threshold: float = 4.0
+    lo_accel_numharm: int = 16      # :21-27
+    lo_accel_zmax: int = 0
+    hi_accel_numharm: int = 8
+    hi_accel_zmax: int = 50
+    run_hi_accel: bool = True
+    topk_per_stage: int = 32
+    sp_threshold: float = 5.0       # singlepulse_threshold
+    sp_widths: tuple[int, ...] = sp_k.DEFAULT_WIDTHS
+    sifting: sifting.SiftParams = dataclasses.field(
+        default_factory=sifting.SiftParams)
+    to_prepfold_sigma: float = 6.0  # :44
+    max_cands_to_fold: int = 100    # :45
+    fold_nbin: int = 64
+    fold_npart: int = 32
+    max_dms_per_chunk: int = 128    # device memory blocking
+
+    def provenance(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["sifting"] = dataclasses.asdict(self.sifting)
+        return d
+
+
+@dataclasses.dataclass
+class SearchOutcome:
+    basenm: str
+    resultsdir: str
+    candidates: list[sifting.Candidate]
+    folded: list[fold_k.FoldResult]
+    sp_events: np.ndarray
+    masked_fraction: float
+    num_dm_trials: int
+    timers: StageTimers
+
+
+def search_beam(fns: list[str], workdir: str, resultsdir: str,
+                params: SearchParams | None = None,
+                zaplist: np.ndarray | None = None,
+                plan: list[ddplan.DedispStep] | None = None,
+                baryv: float = 0.0) -> SearchOutcome:
+    """Search one beam end-to-end and write the results directory."""
+    params = params or SearchParams()
+    os.makedirs(workdir, exist_ok=True)
+    os.makedirs(resultsdir, exist_ok=True)
+
+    obj = datafile.autogen_dataobj(fns)
+    si = obj.specinfo
+    basenm = os.path.splitext(os.path.basename(sorted(fns)[0]))[0]
+    timers = StageTimers()
+
+    nsub = params.nsub if si.num_channels % params.nsub == 0 else \
+        _largest_divisor_leq(si.num_channels, params.nsub)
+
+    if plan is None:
+        try:
+            plan = ddplan.survey_plan(si.backend)
+        except ValueError:
+            obs = ddplan.Observation(dt=si.dt, fctr=si.fctr, bw=abs(si.BW),
+                                     numchan=si.num_channels,
+                                     blocklen=si.spectra_per_subint)
+            plan = ddplan.generate_ddplan(obs, 0.0, 1000.0, numsub=nsub)
+
+    # ---------------------------------------------------------- read + RFI
+    block = si.read_all()                     # (T, nchan) ascending freq
+    with timers.timing("rfifind"):
+        mask = rfi_k.find_rfi(block, si.dt,
+                              block_len=params.rfifind_blocklen,
+                              threshold=params.rfi_threshold)
+        mask.save(os.path.join(resultsdir, f"{basenm}_rfifind.npz"))
+        clean = np.asarray(rfi_k.apply_mask(
+            jnp.asarray(block), jnp.asarray(mask.full_mask()),
+            params.rfifind_blocklen))
+    # Keep the block's native dtype in HBM (uint8 beams stay 4x
+    # smaller; form_subbands casts after its gather).
+    data = jnp.asarray(np.ascontiguousarray(clean.T))   # (nchan, T)
+    del block, clean
+
+    result = search_block(data, si.freqs, si.dt, plan, params,
+                          zaplist=zaplist, baryv=baryv, nsub=nsub,
+                          timers=timers)
+    final, folded, sp_events, num_trials = result
+
+    # ----------------------------------------------------------- artifacts
+    accelcands.write_candlist(
+        final, os.path.join(resultsdir, f"{basenm}.accelcands"))
+    _write_sp_files(resultsdir, basenm, sp_events)
+    for step in plan:
+        for ppass in step.passes():
+            _write_inf_files(resultsdir, basenm, si,
+                             np.asarray(ppass.dms), si.dt * step.downsamp,
+                             data.shape[1] // step.downsamp)
+    for i, res in enumerate(folded):
+        stem = os.path.join(resultsdir, f"{basenm}_cand{i+1}")
+        np.savez_compressed(
+            stem + ".pfd.npz", profile=res.profile,
+            subints=res.subints, period_s=res.period_s,
+            pdot=res.pdot, dm=res.dm,
+            reduced_chi2=res.reduced_chi2)
+        with open(stem + ".bestprof", "w") as fh:
+            fh.write(res.bestprof_text(si.source))
+
+    _write_header_json(resultsdir, obj)
+    _write_search_params(resultsdir, params, basenm, si, num_trials)
+    timers.write_report(os.path.join(resultsdir, f"{basenm}.report"), basenm)
+    _tar_result_classes(resultsdir, basenm)
+
+    return SearchOutcome(basenm=basenm, resultsdir=resultsdir,
+                         candidates=final, folded=folded,
+                         sp_events=sp_events,
+                         masked_fraction=mask.masked_fraction,
+                         num_dm_trials=num_trials, timers=timers)
+
+
+def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
+                 plan: list[ddplan.DedispStep],
+                 params: SearchParams | None = None,
+                 zaplist: np.ndarray | None = None, baryv: float = 0.0,
+                 nsub: int | None = None,
+                 timers: StageTimers | None = None):
+    """Run the plan loop + sifting + folding on an in-HBM block.
+
+    data: (nchan, T) device array, any numeric dtype (uint8 is fine —
+    conversion fuses into the subband reduction).  This is the
+    benchmark surface: no file I/O, just the compute chain.
+
+    Returns (candidates, folded, sp_events, num_dm_trials).
+    """
+    params = params or SearchParams()
+    timers = timers or StageTimers()
+    nchan = data.shape[0]
+    nsub = nsub or (params.nsub if nchan % params.nsub == 0
+                    else _largest_divisor_leq(nchan, params.nsub))
+
+    all_cands: list[sifting.Candidate] = []
+    sp_chunks: list[np.ndarray] = []
+    num_trials = 0
+
+    for step in plan:
+        for ppass in step.passes():
+            with timers.timing("subbanding"):
+                chan_shifts, sub_shifts = dd.plan_pass_shifts(
+                    freqs, nsub, ppass.subdm, np.asarray(ppass.dms),
+                    dt, step.downsamp)
+                subb = dd.form_subbands(data, jnp.asarray(chan_shifts),
+                                        nsub, step.downsamp)
+            dt_ds = dt * step.downsamp
+            dms = np.asarray(ppass.dms)
+            for lo in range(0, len(dms), params.max_dms_per_chunk):
+                dm_chunk = dms[lo: lo + params.max_dms_per_chunk]
+                with timers.timing("dedispersing"):
+                    series = dd.dedisperse_subbands(
+                        subb, jnp.asarray(sub_shifts[lo: lo + len(dm_chunk)]))
+                num_trials += len(dm_chunk)
+                T_s = series.shape[1] * dt_ds
+
+                with timers.timing("single-pulse"):
+                    ev = sp_k.single_pulse_search(
+                        series, dm_chunk, dt_ds,
+                        threshold=params.sp_threshold,
+                        widths=params.sp_widths)
+                    if len(ev):
+                        sp_chunks.append(ev)
+
+                with timers.timing("FFT"):
+                    nbins = series.shape[1] // 2 + 1
+                    keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
+                        if zaplist is not None else None
+                with timers.timing("lo-accelsearch"):
+                    res, _ = fr.periodicity_search(
+                        series, T_s, keep_mask=keep,
+                        max_numharm=params.lo_accel_numharm,
+                        topk=params.topk_per_stage)
+                    all_cands.extend(sifting.make_candidates(
+                        res, dm_chunk, T_s, fr.sigma_from_power))
+
+                if params.run_hi_accel and params.hi_accel_zmax > 0:
+                    with timers.timing("hi-accelsearch"):
+                        all_cands.extend(_hi_accel_pass(
+                            series, dm_chunk, T_s, params))
+            del subb
+
+    with timers.timing("sifting"):
+        final = sifting.sift(all_cands, params.sifting)
+
+    sp_events = (np.concatenate(sp_chunks) if sp_chunks
+                 else np.empty(0, dtype=[("dm", "f8"), ("sigma", "f8"),
+                                         ("time_s", "f8"), ("sample", "i8"),
+                                         ("downfact", "i4")]))
+
+    folded: list[fold_k.FoldResult] = []
+    with timers.timing("folding"):
+        to_fold = [c for c in final if c.sigma >= params.to_prepfold_sigma]
+        to_fold = to_fold[: params.max_cands_to_fold]
+        for c in to_fold:
+            series = _dedisperse_single(data, freqs, nsub, c.dm, dt)
+            folded.append(fold_k.fold_and_optimize(
+                series, dt, c.period_s, dm=c.dm,
+                nbin=params.fold_nbin, npart=params.fold_npart))
+
+    return final, folded, sp_events, num_trials
+
+
+# ------------------------------------------------------------------ helpers
+
+def _largest_divisor_leq(n: int, k: int) -> int:
+    for d in range(min(n, k), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def _dedisperse_single(data, freqs, nsub, dm, dt):
+    """One full-resolution DM series for folding."""
+    chan_shifts, sub_shifts = dd.plan_pass_shifts(freqs, nsub, dm, [dm],
+                                                  dt, 1)
+    subb = dd.form_subbands(data, jnp.asarray(chan_shifts), nsub, 1)
+    return np.asarray(dd.dedisperse_subbands(
+        subb, jnp.asarray(sub_shifts)))[0]
+
+
+def _hi_accel_pass(series, dm_chunk, T_s, params: SearchParams
+                   ) -> list[sifting.Candidate]:
+    """accelsearch zmax>0 over a DM chunk (device-batched)."""
+    bank = _get_bank(params.hi_accel_zmax)
+    spec_all = jnp.fft.rfft(series - series.mean(axis=-1, keepdims=True),
+                            axis=-1)
+    spec_all = accel_k.normalize_spectrum(spec_all)
+    res = accel_k.accel_search_batch(
+        spec_all, bank, max_numharm=params.hi_accel_numharm,
+        topk=params.topk_per_stage)
+
+    out: list[sifting.Candidate] = []
+    dms = np.atleast_1d(dm_chunk)
+    for numharm, (vals, rbins, zvals) in res.items():
+        sig = fr.sigma_from_power(vals, numharm)
+        for i, dm in enumerate(dms):
+            for v, r, z, s in zip(vals[i], rbins[i], zvals[i], sig[i]):
+                if r < 1 or v <= 0 or abs(z) < accel_k.DZ / 2:
+                    continue  # z~0 already covered by the lo search
+                f = r / T_s
+                out.append(sifting.Candidate(
+                    r=float(r), z=float(z), sigma=float(s),
+                    power=float(v), numharm=int(numharm), dm=float(dm),
+                    period_s=1.0 / f, freq_hz=f))
+    return out
+
+
+_BANK_CACHE: dict[int, accel_k.TemplateBank] = {}
+
+
+def _get_bank(zmax: int) -> accel_k.TemplateBank:
+    if zmax not in _BANK_CACHE:
+        _BANK_CACHE[zmax] = accel_k.build_template_bank(float(zmax))
+    return _BANK_CACHE[zmax]
+
+
+def _write_inf_files(resultsdir, basenm, si, dms, dt, nsamp) -> None:
+    """Minimal .inf metadata per DM series (PRESTO-inf-like keys)."""
+    for dm in np.atleast_1d(dms):
+        path = os.path.join(resultsdir, f"{basenm}_DM{dm:.2f}.inf")
+        with open(path, "w") as fh:
+            fh.write(f" Data file name without suffix          =  "
+                     f"{basenm}_DM{dm:.2f}\n")
+            fh.write(f" Telescope used                         =  "
+                     f"{si.telescope}\n")
+            fh.write(f" Object being observed                  =  "
+                     f"{si.source}\n")
+            fh.write(f" Epoch of observation (MJD)             =  "
+                     f"{si.start_MJD[0]:.15f}\n")
+            fh.write(f" Width of each time series bin (sec)    =  {dt!r}\n")
+            fh.write(f" Number of bins in the time series      =  {nsamp}\n")
+            fh.write(f" Dispersion measure (cm-3 pc)           =  {dm}\n")
+
+
+def _write_sp_files(resultsdir, basenm, events: np.ndarray) -> None:
+    for dm in np.unique(events["dm"]) if len(events) else []:
+        sp_k.write_singlepulse_file(
+            os.path.join(resultsdir, f"{basenm}_DM{dm:.2f}.singlepulse"),
+            events, dm)
+    np.savez_compressed(os.path.join(resultsdir, f"{basenm}_sp.npz"),
+                        events=events)
+
+
+def _write_header_json(resultsdir, obj) -> None:
+    """Beam header record for the uploader (the reference re-derives
+    this by re-reading raw files at upload time, header.py:239; we
+    write it once at search time)."""
+    import json
+    si = obj.specinfo
+    hdr = {
+        "obs_name": getattr(obj, "obs_name", si.source),
+        "beam_id": int(obj.beam_id) if obj.beam_id is not None else -1,
+        "original_file": obj.original_file,
+        "source_name": obj.source_name,
+        "ra_deg": float(si.ra2000),
+        "dec_deg": float(si.dec2000),
+        "gal_l": obj.galactic_longitude,
+        "gal_b": obj.galactic_latitude,
+        "obstime_s": float(si.T),
+        "timestamp_mjd": obj.timestamp_mjd,
+        "center_freq_mhz": si.fctr,
+        "bw_mhz": float(si.BW),
+        "num_channels": si.num_channels,
+        "sample_time_us": obj.sample_time,
+        "project_id": obj.project_id,
+        "observers": obj.observers,
+        "file_size": obj.file_size,
+        "data_size": int(obj.data_size),
+        "num_samples": int(si.N),
+        "telescope": si.telescope,
+        "backend": si.backend,
+    }
+    with open(os.path.join(resultsdir, "header.json"), "w") as fh:
+        json.dump(hdr, fh, indent=1)
+
+
+def _write_search_params(resultsdir, params, basenm, si, num_trials) -> None:
+    """Provenance dump, python-literal assignments like the reference's
+    search_params.txt (PALFA2_presto_search.py:695-700)."""
+    with open(os.path.join(resultsdir, "search_params.txt"), "w") as fh:
+        fh.write(f"basenm = {basenm!r}\n")
+        fh.write(f"source = {si.source!r}\n")
+        fh.write(f"backend = {si.backend!r}\n")
+        fh.write(f"num_dm_trials = {num_trials}\n")
+        for k, v in params.provenance().items():
+            fh.write(f"{k} = {v!r}\n")
+
+
+_TAR_CLASSES = (("_pfd.tgz", "_cand*.pfd.npz"),
+                ("_bestprof.tgz", "_cand*.bestprof"),
+                ("_singlepulse.tgz", "_DM*.singlepulse"),
+                ("_inf.tgz", "_DM*.inf"),
+                ("_accelcands.tgz", ".accelcands"))
+
+
+def _tar_result_classes(resultsdir: str, basenm: str) -> None:
+    """Tar up result classes like the reference's clean_up
+    (PALFA2_presto_search.py:702-724), removing the loose .inf files
+    (they can number in the thousands)."""
+    import glob
+    for suffix, pattern in _TAR_CLASSES:
+        files = sorted(glob.glob(os.path.join(resultsdir,
+                                              f"{basenm}{pattern}")))
+        if not files:
+            continue
+        tarpath = os.path.join(resultsdir, f"{basenm}{suffix}")
+        with tarfile.open(tarpath, "w:gz") as tf:
+            for f in files:
+                tf.add(f, arcname=os.path.basename(f))
+        if suffix in ("_inf.tgz", "_singlepulse.tgz"):
+            for f in files:
+                os.remove(f)
