@@ -239,3 +239,171 @@ def test_debugflags_cli():
     assert debugflags.is_on("jobtracker")
     assert not debugflags.is_on("upload")
     debugflags.set_allmodes_off()
+
+
+def test_slurm_registry_survives_restart(tmp_path):
+    """had_errors/get_errors must work after the daemon restarts
+    (stderr map persisted, not in-memory)."""
+    from tpulsar.orchestrate.queue_managers.slurm import SlurmManager
+
+    outdir = tmp_path / "out"
+    state = str(tmp_path / "slurm.json")
+
+    def fake_run(cmd, **kw):
+        class R:
+            returncode = 0
+            stdout = "4242\n"
+            stderr = ""
+        return R()
+
+    qm = SlurmManager(script="job.sh", state_file=state, runner=fake_run)
+    qid = qm.submit(["/does/not/matter"], str(outdir), job_id=7)
+    (outdir / "job7.stderr").write_text("Traceback: boom\n")
+
+    # fresh manager = daemon restart
+    qm2 = SlurmManager(script="job.sh", state_file=state, runner=fake_run)
+    assert qm2.had_errors(qid)
+    assert "boom" in qm2.get_errors(qid)
+
+
+def test_tpu_slice_restart_and_exit_markers(tmp_path):
+    """Exit-code markers make liveness/error state restart-safe, and
+    a restarted pool must not see phantom free-host capacity."""
+    from tpulsar.orchestrate.queue_managers.tpu_slice import TPUSliceManager
+
+    outdir = str(tmp_path / "out")
+    state = str(tmp_path / "tpu.json")
+    # launcher that runs the command locally, slowly enough to observe
+    qm = TPUSliceManager(hosts=["h1"], launcher="sh -c {cmd}",
+                         remote_cmd="sleep 5; true",
+                         state_file=state)
+    qid = qm.submit([], outdir, job_id=1)
+    assert qm.is_running(qid)
+    assert not qm.can_submit()          # single host is busy
+
+    # daemon restart while the job runs: no proc handle, no marker
+    qm2 = TPUSliceManager(hosts=["h1"], launcher="sh -c {cmd}",
+                          state_file=state)
+    assert qm2.is_running(qid)          # still running per registry
+    assert not qm2.can_submit()         # host still considered busy
+    qm.delete(qid)
+
+    # completed job: marker present -> not running, clean exit
+    qm3 = TPUSliceManager(hosts=["h1"], launcher="sh -c {cmd}",
+                          remote_cmd="true", state_file=state)
+    qid2 = qm3.submit([], outdir, job_id=2)
+    for _ in range(100):
+        if not qm3.is_running(qid2):
+            break
+        time.sleep(0.1)
+    assert not qm3.is_running(qid2)
+    assert not qm3.had_errors(qid2)
+
+    # failing job: nonzero exit code detected even after restart
+    qid3 = qm3.submit([], outdir, job_id=3)
+    for _ in range(100):
+        if not qm3.is_running(qid3):
+            break
+        time.sleep(0.1)
+    qm4 = TPUSliceManager(hosts=["h1"], launcher="sh -c {cmd}",
+                          remote_cmd="false", state_file=state)
+    # qid3 ran "true"; submit a real failure via qm4
+    qid4 = qm4.submit([], outdir, job_id=4)
+    for _ in range(100):
+        if not qm4.is_running(qid4):
+            break
+        time.sleep(0.1)
+    assert qm4.had_errors(qid4)
+    assert "exit code 1" in qm4.get_errors(qid4)
+
+
+def test_search_params_from_config():
+    from tpulsar.config.core import SearchingConfig
+    from tpulsar.search.executor import SearchParams
+
+    sc = SearchingConfig(hi_accel_zmax=0, sifting_sigma_threshold=6.5,
+                         max_cands_to_fold=7, nsub=32)
+    p = SearchParams.from_config(sc)
+    assert p.run_hi_accel is False          # zmax=0 disables the stage
+    assert p.sifting.sigma_threshold == 6.5
+    assert p.max_cands_to_fold == 7
+    assert p.nsub == 32
+
+
+def test_config_tpu_slice_requires_hosts(tmp_path):
+    from tpulsar.config.core import InsaneConfigsError, TpulsarConfig
+
+    cfg = TpulsarConfig()
+    cfg.jobpooler.queue_manager = "tpu_slice"
+    with pytest.raises(InsaneConfigsError, match="tpu_hosts"):
+        cfg.check_sanity(create_dirs=True)
+    cfg.jobpooler.tpu_hosts = "a,b"
+    cfg.check_sanity(create_dirs=True)
+
+
+def test_http_restore_service_and_transport(tmp_path):
+    """Drive HTTPRestoreService + HTTPTransport against a local
+    fixture HTTP server (the hermetic stand-in for the reference's
+    Cornell web service + FTPS stack)."""
+    import http.server
+    import threading
+    import urllib.parse
+
+    pool = tmp_path / "pool"
+    pool.mkdir()
+    (pool / "beam1.fits").write_bytes(b"x" * 100)
+    restored = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _text(self, body, code=200):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_HEAD(self):
+            p = pool / os.path.basename(self.path)
+            if p.exists():
+                self.send_response(200)
+                self.send_header("Content-Length",
+                                 str(p.stat().st_size))
+                self.end_headers()
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def do_GET(self):
+            url = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(url.query)
+            if url.path == "/restore":
+                restored["g1"] = int(q["num"][0])
+                self._text("g1")
+            elif url.path == "/location":
+                self._text("g1" if q["guid"][0] in restored else "")
+            elif url.path.endswith("index.txt"):
+                self._text("beam1.fits 100\n")
+            else:
+                p = pool / os.path.basename(url.path)
+                self._text(p.read_text() if p.exists() else "", 200)
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        svc = dl.HTTPRestoreService(base)
+        guid = svc.request_restore(5, 4, "mock")
+        assert guid == "g1"
+        assert svc.location("g1") == "g1"
+        tr = dl.HTTPTransport(base)
+        files = tr.list_files("g1")
+        assert files == ["g1/beam1.fits"]
+        assert tr.size(files[0]) == 100
+        dst = tmp_path / "got.fits"
+        tr.fetch(files[0], str(dst))
+        assert dst.stat().st_size == 100
+    finally:
+        srv.shutdown()

@@ -106,6 +106,8 @@ def cmd_add_files(args):
 def _make_pool(args, cfg):
     from tpulsar.orchestrate.pool import JobPool
     from tpulsar.orchestrate.queue_managers import get_queue_manager
+    state_dir = os.path.join(cfg.processing.base_working_directory,
+                             ".queue_state")
     qm_kw = {}
     if cfg.jobpooler.queue_manager == "local":
         qm_kw = {"max_jobs_running": cfg.jobpooler.max_jobs_running,
@@ -117,7 +119,17 @@ def _make_pool(args, cfg):
         qm_kw = {"script": cfg.jobpooler.submit_script,
                  "queue_name": cfg.jobpooler.queue_name,
                  "max_jobs_running": cfg.jobpooler.max_jobs_running,
-                 "max_jobs_queued": cfg.jobpooler.max_jobs_queued}
+                 "max_jobs_queued": cfg.jobpooler.max_jobs_queued,
+                 "state_file": os.path.join(
+                     state_dir, f"{cfg.jobpooler.queue_manager}.json")}
+        if cfg.jobpooler.queue_manager == "slurm":
+            qm_kw["walltime_per_gb"] = cfg.jobpooler.walltime_per_gb
+    elif cfg.jobpooler.queue_manager == "tpu_slice":
+        hosts = [h.strip() for h in cfg.jobpooler.tpu_hosts.split(",")
+                 if h.strip()]
+        qm_kw = {"hosts": hosts,
+                 "launcher": cfg.jobpooler.tpu_launcher,
+                 "state_file": os.path.join(state_dir, "tpu_slice.json")}
     qm = get_queue_manager(cfg.jobpooler.queue_manager, **qm_kw)
     return JobPool(_tracker(args), qm,
                    cfg.processing.base_results_directory,
@@ -153,7 +165,7 @@ def cmd_downloader(args):
         return 2
     if cfg.download.transport == "http":
         transport = dl.HTTPTransport(root)
-        service = dl.LocalRestoreService(root)   # TODO http restore svc
+        service = dl.HTTPRestoreService(root)
     else:
         transport = dl.LocalTransport(root)
         service = dl.LocalRestoreService(root)
@@ -164,7 +176,9 @@ def cmd_downloader(args):
                       numdownloads=cfg.download.numdownloads,
                       numrestores=cfg.download.numrestores,
                       numretries=cfg.download.numretries,
-                      request_timeout_hours=cfg.download.request_timeout_hours)
+                      request_timeout_hours=cfg.download.request_timeout_hours,
+                      request_numbits=cfg.download.request_numbits,
+                      request_datatype=cfg.download.request_datatype)
     if args.once:
         d.run()
         print(d.status())

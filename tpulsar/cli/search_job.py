@@ -117,14 +117,21 @@ def main(argv=None) -> int:
     p.add_argument("--no-accel", action="store_true")
     args = p.parse_args(argv)
 
+    from tpulsar.config import settings
+    cfg = settings()
+
     fns = get_datafns(args)
     outdir = get_outdir(args)
-    workdir = init_workspace(args.workdir_base)
+    workdir = init_workspace(args.workdir_base
+                             or cfg.processing.base_working_directory)
     try:
         staged = stage_in(fns, workdir)
         ppfns = datafile.preprocess(staged)
-        zap = choose_zaplist(ppfns, args.zaplist_dir, args.default_zaplist)
-        params = executor.SearchParams()
+        zap = choose_zaplist(
+            ppfns,
+            args.zaplist_dir or cfg.processing.zaplistdir or None,
+            args.default_zaplist or cfg.processing.default_zaplist or None)
+        params = executor.SearchParams.from_config(cfg.searching)
         if args.no_accel:
             params.run_hi_accel = False
         outcome = executor.search_beam(

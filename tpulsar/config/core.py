@@ -83,6 +83,8 @@ class JobPoolerConfig:
     submit_script: str = ""
     queue_name: str = ""
     walltime_per_gb: float = 50.0          # hours/GB heuristic (moab.py:14)
+    tpu_hosts: str = ""                    # comma-separated, for tpu_slice
+    tpu_launcher: str = "ssh {host} {cmd}"
 
 
 @dataclasses.dataclass
@@ -202,6 +204,21 @@ class TpulsarConfig:
             problems.append(
                 f"jobpooler.queue_manager unknown: "
                 f"{self.jobpooler.queue_manager!r}")
+        if (self.jobpooler.queue_manager == "tpu_slice"
+                and not self.jobpooler.tpu_hosts.strip()):
+            problems.append(
+                "jobpooler.queue_manager='tpu_slice' requires "
+                "jobpooler.tpu_hosts (comma-separated host list)")
+        if (self.jobpooler.queue_manager in ("slurm", "pbs")
+                and not self.jobpooler.submit_script):
+            problems.append(
+                f"jobpooler.queue_manager="
+                f"{self.jobpooler.queue_manager!r} requires "
+                f"jobpooler.submit_script")
+        if self.download.transport not in ("local", "http"):
+            problems.append(
+                f"download.transport unknown: "
+                f"{self.download.transport!r}")
         if self.email.enabled and not self.email.recipient:
             problems.append("email.enabled but email.recipient empty")
         if self.searching.nsub < 1:

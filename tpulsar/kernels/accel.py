@@ -176,25 +176,47 @@ def _accel_plane_topk(spectrum, bank_fft, seg, step, width, nz,
 
 
 def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
-                       max_numharm: int = 8, topk: int = 64):
+                       max_numharm: int = 8, topk: int = 64,
+                       dm_chunk: int = 4):
     """Acceleration-search a batch of whitened complex spectra.
 
-    spectra: (ndms, nbins) complex64.  Maps over DMs on device with
-    one (nz, nbins) plane in flight at a time.  Returns
+    spectra: (ndms, nbins) complex64.  DMs are processed `dm_chunk` at
+    a time as a vmapped jit call (a host loop rather than lax.map over
+    the whole batch: scan-of-scan-of-FFT is unsupported on some TPU
+    runtimes, and the chunk bound keeps at most dm_chunk (nz, nbins)
+    planes in HBM at once).  Returns
     {stage: (powers[ndms, topk], rbins[ndms, topk], zvals[ndms, topk])}.
     """
     from tpulsar.kernels.fourier import harmonic_stages
 
     nz = len(bank.zs)
+    # NB: the bank must be an explicit jit argument (a closed-over
+    # device array baked in as an executable constant is rejected by
+    # some TPU runtimes), and the chunk is carved out *inside* jit
+    # with dynamic_slice (host-side slicing of complex device arrays
+    # is likewise unsupported there).
     bank_fft = jnp.asarray(bank.bank_fft)
+    ndms = spectra.shape[0]
+    dm_chunk = min(dm_chunk, ndms)
 
-    def one(spec):
-        return _accel_plane_topk(spec, bank_fft, bank.seg, bank.step,
-                                 bank.width, nz, max_numharm, topk)
+    @partial(jax.jit, static_argnames=("nrows",))
+    def chunk_fn(full, bf, c0, nrows):
+        block = jax.lax.dynamic_slice_in_dim(full, c0, nrows, axis=0)
+        return jax.vmap(
+            lambda spec: _accel_plane_topk(
+                spec, bf, bank.seg, bank.step, bank.width, nz,
+                max_numharm, topk))(block)
 
-    vals, idx = jax.lax.map(one, spectra)      # (ndms, nstages, topk)
-    vals = np.asarray(vals)
-    idx = np.asarray(idx)
+    nstages = len(harmonic_stages(max_numharm))
+    vals = np.empty((ndms, nstages, topk), np.float32)
+    idx = np.empty((ndms, nstages, topk), np.int32)
+    for c0 in range(0, ndms, dm_chunk):
+        # clamp so the (possibly short) last chunk re-covers earlier
+        # rows instead of triggering a second compile
+        s0 = min(c0, ndms - dm_chunk)
+        v, i = chunk_fn(spectra, bank_fft, s0, dm_chunk)
+        vals[s0:s0 + dm_chunk] = np.asarray(v)
+        idx[s0:s0 + dm_chunk] = np.asarray(i)
     stages = harmonic_stages(max_numharm)
     out = {}
     nbins = spectra.shape[-1]

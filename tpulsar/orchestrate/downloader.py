@@ -95,6 +95,41 @@ class HTTPTransport:
             shutil.copyfileobj(resp, out)
 
 
+class HTTPRestoreService:
+    """Restore service over plain HTTP GET endpoints, the successor of
+    the reference's dynamic web-service client (CornellWebservice.py:
+    6-29, which synthesized Restore/Location GET calls):
+
+      GET {base}/restore?num=N&bits=B&type=T   -> guid (text/plain)
+      GET {base}/location?guid=G               -> ready subdir, or 204/
+                                                  empty body while the
+                                                  restore is pending
+    """
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _get(self, path: str) -> str:
+        import urllib.request
+        with urllib.request.urlopen(f"{self.base_url}/{path}",
+                                    timeout=self.timeout_s) as resp:
+            return resp.read().decode().strip()
+
+    def request_restore(self, num_beams: int, bits: int,
+                        file_type: str) -> str:
+        from urllib.parse import quote
+        guid = self._get(f"restore?num={num_beams}&bits={bits}"
+                         f"&type={quote(file_type)}")
+        if not guid:
+            raise IOError("restore service returned no guid")
+        return guid
+
+    def location(self, guid: str) -> str | None:
+        loc = self._get(f"location?guid={guid}")
+        return loc or None
+
+
 class LocalRestoreService:
     """Fixture restore service: a pool of beam files that get 'restored'
     into per-request directories after an optional delay (plays the

@@ -142,8 +142,7 @@ class JobTracker:
         return self._with_retries(run)
 
     def execute(self, sql: str | list[str],
-                params: Iterable[Any] | list[Iterable[Any]] = (),
-                many: bool = False) -> int:
+                params: Iterable[Any] | list[Iterable[Any]] = ()) -> int:
         """Execute one statement (or a list, atomically in one
         transaction).  Returns lastrowid of the final statement."""
         sqls = sql if isinstance(sql, list) else [sql]

@@ -11,6 +11,57 @@ fans beam jobs out to TPU hosts.
 
 from __future__ import annotations
 
+import json
+import os
+import threading
+
+
+class SubmitRegistry:
+    """Durable queue_id -> per-job paths map.
+
+    The reference detects job errors from stderr files named after the
+    submission (pbs.py:209-230) but keeps the mapping only in memory; a
+    daemon restart then loses the error taxonomy for every in-flight
+    job.  Backends persist the mapping here (a small JSON file, written
+    atomically) so had_errors()/get_errors() survive restarts."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._map: dict[str, dict] = {}
+        if path and os.path.exists(path):
+            try:
+                with open(path) as fh:
+                    self._map = json.load(fh)
+            except (OSError, ValueError):
+                self._map = {}
+
+    def put(self, queue_id: str, **info) -> None:
+        with self._lock:
+            self._map[str(queue_id)] = info
+            self._save()
+
+    def get(self, queue_id: str, key: str, default=None):
+        with self._lock:
+            return self._map.get(str(queue_id), {}).get(key, default)
+
+    def known(self, queue_id: str) -> bool:
+        with self._lock:
+            return str(queue_id) in self._map
+
+    def all_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._map)
+
+    def _save(self) -> None:
+        if not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._map, fh)
+        os.replace(tmp, self.path)
+
 
 class QueueManagerFatalError(Exception):
     """The queue system itself is broken: stop the daemon."""

@@ -17,6 +17,7 @@ import subprocess
 from tpulsar.orchestrate.queue_managers import (
     QueueManagerJobFatalError,
     QueueManagerNonFatalError,
+    SubmitRegistry,
 )
 
 
@@ -24,6 +25,7 @@ class PBSManager:
     def __init__(self, script: str, queue_name: str = "",
                  max_jobs_running: int = 50, max_jobs_queued: int = 1,
                  job_basename: str = "tpulsar", ppn: int = 1,
+                 state_file: str | None = None,
                  runner=subprocess.run):
         self.script = script
         self.queue_name = queue_name
@@ -32,7 +34,7 @@ class PBSManager:
         self.job_basename = job_basename
         self.ppn = ppn
         self._run = runner
-        self._stderr: dict[str, str] = {}
+        self._stderr = SubmitRegistry(state_file)
 
     def submit(self, datafiles: list[str], outdir: str, job_id: int) -> str:
         os.makedirs(outdir, exist_ok=True)
@@ -56,7 +58,7 @@ class PBSManager:
         qid = r.stdout.strip().splitlines()[-1].strip()
         if not qid:
             raise QueueManagerNonFatalError("qsub returned no job id")
-        self._stderr[qid] = errpath
+        self._stderr.put(qid, errpath=errpath)
         return qid
 
     def _qstat_states(self) -> dict[str, str]:
@@ -99,12 +101,12 @@ class PBSManager:
         return queued, running
 
     def had_errors(self, queue_id: str) -> bool:
-        errpath = self._stderr.get(queue_id)
+        errpath = self._stderr.get(queue_id, "errpath")
         return bool(errpath and os.path.exists(errpath)
                     and os.path.getsize(errpath) > 0)
 
     def get_errors(self, queue_id: str) -> str:
-        errpath = self._stderr.get(queue_id)
+        errpath = self._stderr.get(queue_id, "errpath")
         if errpath and os.path.exists(errpath):
             with open(errpath, errors="replace") as fh:
                 return fh.read()

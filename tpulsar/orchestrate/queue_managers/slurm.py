@@ -16,13 +16,16 @@ import subprocess
 from tpulsar.orchestrate.queue_managers import (
     QueueManagerJobFatalError,
     QueueManagerNonFatalError,
+    SubmitRegistry,
 )
 
 
 class SlurmManager:
     def __init__(self, script: str, queue_name: str = "",
                  max_jobs_running: int = 50, max_jobs_queued: int = 1,
-                 walltime_per_gb: float = 2.0, job_basename: str = "tpulsar",
+                 walltime_per_gb: float = 50.0,
+                 job_basename: str = "tpulsar",
+                 state_file: str | None = None,
                  runner=subprocess.run):
         self.script = script
         self.queue_name = queue_name
@@ -31,7 +34,7 @@ class SlurmManager:
         self.walltime_per_gb = walltime_per_gb
         self.job_basename = job_basename
         self._run = runner           # injectable for hermetic tests
-        self._stderr: dict[str, str] = {}
+        self._stderr = SubmitRegistry(state_file)
 
     def _walltime(self, datafiles: list[str]) -> str:
         gb = sum(os.path.getsize(f) for f in datafiles
@@ -62,7 +65,7 @@ class SlurmManager:
         qid = r.stdout.strip().split(";")[0]
         if not qid:
             raise QueueManagerNonFatalError("sbatch returned no job id")
-        self._stderr[qid] = errpath
+        self._stderr.put(qid, errpath=errpath)
         return qid
 
     def _squeue(self, extra: list[str]) -> list[str]:
@@ -102,12 +105,12 @@ class SlurmManager:
         return queued, running
 
     def had_errors(self, queue_id: str) -> bool:
-        errpath = self._stderr.get(queue_id)
+        errpath = self._stderr.get(queue_id, "errpath")
         return bool(errpath and os.path.exists(errpath)
                     and os.path.getsize(errpath) > 0)
 
     def get_errors(self, queue_id: str) -> str:
-        errpath = self._stderr.get(queue_id)
+        errpath = self._stderr.get(queue_id, "errpath")
         if errpath and os.path.exists(errpath):
             with open(errpath, errors="replace") as fh:
                 return fh.read()

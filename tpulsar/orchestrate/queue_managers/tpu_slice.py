@@ -20,13 +20,25 @@ import os
 import shlex
 import subprocess
 import threading
+import uuid
+
+from tpulsar.orchestrate.queue_managers import SubmitRegistry
 
 
 class TPUSliceManager:
+    """Restart-safe: each launch wraps the remote command so its exit
+    code lands in an `<qid>.exit` marker on the shared filesystem.
+    Liveness and error state are derived from the marker + stderr
+    file, not from in-memory Popen handles, so a JobPool daemon
+    restart neither kills nor double-submits in-flight beams (the
+    same restart-from-durable-state property the reference gets from
+    queue_id polling, job.py:131-135)."""
+
     def __init__(self, hosts: list[str],
                  launcher: str = "ssh {host} {cmd}",
                  remote_cmd: str = "python -m tpulsar.cli.search_job",
-                 env_extra: dict | None = None):
+                 env_extra: dict | None = None,
+                 state_file: str | None = None):
         """hosts: TPU host addresses, one concurrent beam each.
         launcher: template with {host} and {cmd} placeholders."""
         if not hosts:
@@ -37,18 +49,25 @@ class TPUSliceManager:
         self.env_extra = env_extra or {}
         self._lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
-        self._host_of: dict[str, str] = {}
-        self._stderr: dict[str, str] = {}
-        self._next = 1
+        self._registry = SubmitRegistry(state_file)
 
     def _free_host(self) -> str | None:
-        with self._lock:
-            busy = {self._host_of[qid] for qid, p in self._procs.items()
-                    if p.poll() is None}
+        busy = {self._registry.get(qid, "host")
+                for qid in self._live_qids()}
         for h in self.hosts:
             if h not in busy:
                 return h
         return None
+
+    def _live_qids(self) -> list[str]:
+        with self._lock:
+            qids = list(self._procs)
+        # registry entries from a previous daemon life are live until
+        # their exit marker appears
+        for qid in self._registry.all_ids():
+            if qid not in qids:
+                qids.append(qid)
+        return [qid for qid in qids if self.is_running(qid)]
 
     def submit(self, datafiles: list[str], outdir: str, job_id: int) -> str:
         host = self._free_host()
@@ -57,32 +76,52 @@ class TPUSliceManager:
                 QueueManagerNonFatalError)
             raise QueueManagerNonFatalError("no free TPU slice")
         os.makedirs(outdir, exist_ok=True)
+        qid = f"tpu-{job_id}-{uuid.uuid4().hex[:8]}"
+        errpath = os.path.join(outdir, f"{qid}.stderr")
+        exitpath = os.path.join(outdir, f"{qid}.exit")
         envs = {"DATAFILES": ";".join(datafiles), "OUTDIR": outdir,
                 **self.env_extra}
         env_prefix = " ".join(f"{k}={shlex.quote(v)}"
                               for k, v in envs.items())
-        cmd = f"{env_prefix} {self.remote_cmd}"
-        full = self.launcher.format(host=host, cmd=shlex.quote(cmd))
-        with self._lock:
-            qid = f"tpu-{self._next}"
-            self._next += 1
-        errpath = os.path.join(outdir, f"{qid}.stderr")
-        errfh = open(errpath, "wb")
-        proc = subprocess.Popen(shlex.split(full),
-                                stdout=subprocess.DEVNULL, stderr=errfh)
+        inner = (f"{env_prefix} {self.remote_cmd}; "
+                 f"echo $? > {shlex.quote(exitpath)}")
+        full = self.launcher.format(host=host, cmd=shlex.quote(inner))
+        with open(errpath, "wb") as errfh:
+            proc = subprocess.Popen(shlex.split(full),
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=errfh)
         with self._lock:
             self._procs[qid] = proc
-            self._host_of[qid] = host
-            self._stderr[qid] = errpath
+        self._registry.put(qid, host=host, errpath=errpath,
+                           exitpath=exitpath)
         return qid
 
     def can_submit(self) -> bool:
         return self._free_host() is not None
 
+    def _exit_code(self, queue_id: str) -> int | None:
+        exitpath = self._registry.get(queue_id, "exitpath")
+        if exitpath and os.path.exists(exitpath):
+            try:
+                with open(exitpath) as fh:
+                    return int(fh.read().strip() or 1)
+            except (OSError, ValueError):
+                return 1
+        return None
+
     def is_running(self, queue_id: str) -> bool:
+        if self._exit_code(queue_id) is not None:
+            return False
         with self._lock:
             proc = self._procs.get(queue_id)
-        return proc is not None and proc.poll() is None
+        if proc is not None:
+            if proc.poll() is None:
+                return True
+            # launcher exited without writing the marker: launch failed
+            return False
+        # no handle (daemon restarted): still running until the marker
+        # appears, as long as we ever knew about it
+        return self._registry.known(queue_id)
 
     def delete(self, queue_id: str) -> bool:
         with self._lock:
@@ -95,32 +134,47 @@ class TPUSliceManager:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+        # killing the launcher means the remote wrapper never writes
+        # its marker: write it here so the slot frees and the state
+        # machine converges
+        exitpath = self._registry.get(queue_id, "exitpath")
+        if exitpath and not os.path.exists(exitpath):
+            try:
+                with open(exitpath, "w") as fh:
+                    fh.write("143\n")
+            except OSError:
+                pass
         return True
 
     def status(self) -> tuple[int, int]:
-        with self._lock:
-            running = sum(1 for p in self._procs.values()
-                          if p.poll() is None)
-        return 0, running
+        return 0, len(self._live_qids())
 
     def had_errors(self, queue_id: str) -> bool:
-        with self._lock:
-            proc = self._procs.get(queue_id)
-            errpath = self._stderr.get(queue_id)
-        if proc is None:
+        if not self._registry.known(queue_id):
             return True
-        if proc.poll() not in (0, None):
+        code = self._exit_code(queue_id)
+        if code is None:
+            with self._lock:
+                proc = self._procs.get(queue_id)
+            if proc is not None and proc.poll() not in (0, None):
+                return True     # launcher itself failed
+        elif code != 0:
             return True
+        errpath = self._registry.get(queue_id, "errpath")
         return bool(errpath and os.path.exists(errpath)
                     and os.path.getsize(errpath) > 0)
 
     def get_errors(self, queue_id: str) -> str:
+        parts = []
+        code = self._exit_code(queue_id)
+        if code not in (0, None):
+            parts.append(f"exit code {code}")
         with self._lock:
             proc = self._procs.get(queue_id)
-            errpath = self._stderr.get(queue_id)
-        parts = []
-        if proc is not None and proc.poll() not in (0, None):
-            parts.append(f"exit code {proc.poll()}")
+        if code is None and proc is not None \
+                and proc.poll() not in (0, None):
+            parts.append(f"launcher exit code {proc.poll()}")
+        errpath = self._registry.get(queue_id, "errpath")
         if errpath and os.path.exists(errpath) and os.path.getsize(errpath):
             with open(errpath, errors="replace") as fh:
                 parts.append(fh.read())

@@ -76,6 +76,29 @@ class SearchParams:
         d["sifting"] = dataclasses.asdict(self.sifting)
         return d
 
+    @classmethod
+    def from_config(cls, searching) -> "SearchParams":
+        """Build from a SearchingConfig domain, so queue-launched
+        workers honour the operator's searching settings (the
+        reference wires config.searching straight into the search
+        module, PALFA2_presto_search.py:26-41)."""
+        return cls(
+            nsub=searching.nsub,
+            lo_accel_numharm=searching.lo_accel_numharm,
+            lo_accel_zmax=searching.lo_accel_zmax,
+            hi_accel_numharm=searching.hi_accel_numharm,
+            hi_accel_zmax=searching.hi_accel_zmax,
+            run_hi_accel=searching.use_hi_accel
+            and searching.hi_accel_zmax > 0,
+            sp_threshold=searching.singlepulse_threshold,
+            sifting=sifting.SiftParams(
+                sigma_threshold=searching.sifting_sigma_threshold,
+                r_err=searching.sifting_r_err,
+                min_num_dms=searching.sifting_min_num_dms,
+                low_dm_cutoff=searching.sifting_low_dm_cutoff),
+            to_prepfold_sigma=searching.to_prepfold_sigma,
+            max_cands_to_fold=searching.max_cands_to_fold)
+
 
 @dataclasses.dataclass
 class SearchOutcome:
