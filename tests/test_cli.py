@@ -73,3 +73,15 @@ def test_jobpool_once_with_added_files(tmp_path, capsys, _iso_config):
     assert main(["--db", db, "show", "processing"]) == 0
     out = capsys.readouterr().out
     assert "job_id" in out or "nothing processing" in out
+
+
+def test_stats_and_monitor(tmp_path, capsys):
+    from tpulsar.cli import main as cli
+    db = str(tmp_path / "t.db")
+    assert cli.main(["--db", db, "init-db"]) == 0
+    png = str(tmp_path / "stats.png")
+    assert cli.main(["--db", db, "stats", "--png", png]) == 0
+    assert os.path.exists(png)
+    assert cli.main(["--db", db, "monitor", "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "downloads" in out

@@ -85,3 +85,23 @@ def test_tarballs(beam_outcome):
     if beam_outcome.folded:
         assert os.path.exists(os.path.join(rd, f"{base}_pfd.tgz"))
         assert os.path.exists(os.path.join(rd, f"{base}_bestprof.tgz"))
+
+
+def test_plots_written(beam_outcome):
+    """Fold-candidate PNGs and the three single-pulse DM-range plots
+    (reference PALFA2_presto_search.py:617-641,683-688)."""
+    out = beam_outcome
+    rd, base = out.resultsdir, out.basenm
+    sp_plots = sorted(glob.glob(os.path.join(
+        rd, f"{base}_singlepulse_DMs*.png")))
+    assert len(sp_plots) == 3
+    if out.folded:
+        assert os.path.exists(os.path.join(rd, f"{base}_cand1.png"))
+
+
+def test_diagnostics_include_plots(beam_outcome):
+    from tpulsar.orchestrate.diagnostics import get_diagnostics
+    diags = get_diagnostics(beam_outcome.resultsdir, beam_outcome.basenm)
+    names = [d.name for d in diags]
+    assert sum(1 for n in names if n.startswith("Single-pulse plot")) == 3
+    assert any(n.startswith("RFI mask") for n in names)

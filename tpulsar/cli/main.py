@@ -281,6 +281,98 @@ def cmd_remove_files(args):
     return 0
 
 
+def cmd_stats(args):
+    """Pipeline statistics dashboard (reference
+    bin/show_pipeline_stats.py:12-99): cumulative job counts, restore
+    history, and raw-data disk usage — rendered to a PNG (and printed
+    as text)."""
+    t = _tracker(args)
+    jobs = t.query("SELECT status, COUNT(*) c FROM jobs GROUP BY status")
+    files = t.query("SELECT status, COUNT(*) c, COALESCE(SUM(size),0) s "
+                    "FROM files GROUP BY status")
+    reqs = t.query("SELECT status, COUNT(*) c FROM requests "
+                   "GROUP BY status")
+    print("jobs:     ", {r["status"]: r["c"] for r in jobs} or "none")
+    print("files:    ", {r["status"]: r["c"] for r in files} or "none")
+    print("requests: ", {r["status"]: r["c"] for r in reqs} or "none")
+    disk_bytes = sum(r["s"] for r in files
+                     if r["status"] in ("downloading", "unverified",
+                                        "downloaded", "added"))
+    print(f"raw data on disk: {disk_bytes / 2**30:.2f} GiB")
+
+    if args.png:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        # cumulative created/uploaded/terminal over time
+        created = [r["created_at"] for r in t.query(
+            "SELECT created_at FROM jobs ORDER BY created_at")]
+        uploaded = [r["updated_at"] for r in t.query(
+            "SELECT updated_at FROM jobs WHERE status='uploaded' "
+            "ORDER BY updated_at")]
+        failed = [r["updated_at"] for r in t.query(
+            "SELECT updated_at FROM jobs WHERE status='terminal_failure' "
+            "ORDER BY updated_at")]
+        from datetime import datetime
+
+        def _ts(series):
+            return [datetime.strptime(s, "%Y-%m-%d %H:%M:%S")
+                    for s in series if s]
+
+        fig, axes = plt.subplots(2, 1, figsize=(8, 7))
+        for series, label in ((created, "created"),
+                              (uploaded, "uploaded"),
+                              (failed, "terminal failure")):
+            times = _ts(series)
+            if times:
+                axes[0].step(times, range(1, len(times) + 1),
+                             where="post", label=label)
+        axes[0].set_ylabel("cumulative jobs")
+        axes[0].tick_params(axis="x", rotation=30, labelsize=7)
+        axes[0].legend(loc="upper left", fontsize=8)
+        labels = [r["status"] for r in files]
+        sizes = [r["s"] / 2**30 for r in files]
+        axes[1].bar(labels, sizes, color="0.5")
+        axes[1].set_ylabel("raw data (GiB)")
+        axes[1].tick_params(axis="x", rotation=30)
+        fig.suptitle("tpulsar pipeline stats")
+        fig.tight_layout()
+        fig.savefig(args.png, dpi=100)
+        print(f"wrote {args.png}")
+    return 0
+
+
+def cmd_monitor(args):
+    """Live download monitor (reference bin/monitor_downloads.py):
+    refreshes per-file progress until interrupted."""
+    t = _tracker(args)
+    try:
+        while True:
+            rows = t.query(
+                "SELECT id, remote_filename, filename, size, status "
+                "FROM files WHERE status IN ('downloading','unverified',"
+                "'new','retrying')")
+            os.system("clear" if os.name != "nt" else "cls")
+            print(f"=== downloads ({time.strftime('%H:%M:%S')}) ===")
+            if not rows:
+                print("nothing in flight")
+            for r in rows:
+                have = (os.path.getsize(r["filename"])
+                        if r["filename"] and os.path.exists(r["filename"])
+                        else 0)
+                total = r["size"] or 0
+                pct = 100.0 * have / total if total else 0.0
+                bar = "#" * int(pct / 5)
+                print(f"[{r['id']:>4}] {os.path.basename(r['remote_filename'] or '?'):<40.40} "
+                      f"{r['status']:<12} |{bar:<20}| {pct:5.1f}%")
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_search(args):
     from tpulsar.cli import search_job
     argv = list(args.files) + ["--outdir", args.outdir]
@@ -328,6 +420,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("remove-files")
     sp.add_argument("file_ids", nargs="+", type=int)
     sp.set_defaults(fn=cmd_remove_files)
+
+    sp = sub.add_parser("stats")
+    sp.add_argument("--png", default=None,
+                    help="also render the dashboard to this PNG")
+    sp.set_defaults(fn=cmd_stats)
+
+    sp = sub.add_parser("monitor")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--once", action="store_true")
+    sp.set_defaults(fn=cmd_monitor)
 
     sp = sub.add_parser("search")
     sp.add_argument("files", nargs="+")

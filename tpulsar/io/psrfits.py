@@ -228,6 +228,14 @@ class SpectraInfo:
         nrows = raw.shape[0]
         nsblk, npol, nchan = self.spectra_per_subint, self.num_polns, self.num_channels
 
+        fused = self._read_fused_4bit(rows, raw, nrows, nsblk, nchan,
+                                      apply_calibration)
+        if fused is not None:
+            data = fused
+            if self.need_flipband:
+                data = data[:, ::-1]
+            return np.ascontiguousarray(data)
+
         data = unpack_samples(raw.reshape(nrows, -1), self.bits_per_sample,
                               self.signed_ints)
         data = data.reshape(nrows, nsblk, npol, nchan).astype(np.float32)
@@ -260,6 +268,46 @@ class SpectraInfo:
             data = data[:, ::-1]
         return np.ascontiguousarray(data)
 
+    def _read_fused_4bit(self, rows, raw, nrows, nsblk, nchan,
+                         apply_calibration):
+        """Single-poln 4-bit fast path: the native fused unpack +
+        calibrate kernel (tpulsar/native/unpack.cpp), with zero-off
+        and weights folded into per-row effective scale/offset:
+        (x - z)*scl*wts + offs*wts = x*(scl*wts) + (offs - z*scl)*wts.
+        Returns (nrows*nsblk, nchan) float32 or None if inapplicable.
+        """
+        if (self.bits_per_sample != 4 or self.signed_ints
+                or self.num_polns != 1 or nchan % 2):
+            return None
+        from tpulsar import native
+        if native.load() is None:
+            return None
+        packed = np.ascontiguousarray(
+            np.asarray(raw).reshape(nrows, nsblk, nchan // 2))
+        ones = np.ones(nchan, dtype=np.float32)
+        zeros = np.zeros(nchan, dtype=np.float32)
+        out = np.empty((nrows * nsblk, nchan), dtype=np.float32)
+        for r in range(nrows):
+            if apply_calibration:
+                scl = (np.asarray(rows["DAT_SCL"][r], np.float32)
+                       .reshape(nchan) if self.need_scale else ones)
+                offs = (np.asarray(rows["DAT_OFFS"][r], np.float32)
+                        .reshape(nchan) if self.need_offset else zeros)
+                eff_off = offs - self.zero_off * scl
+                eff_scl = scl
+                if self.need_weight:
+                    wts = np.asarray(rows["DAT_WTS"][r],
+                                     np.float32).reshape(nchan)
+                    eff_scl = eff_scl * wts
+                    eff_off = eff_off * wts
+            else:
+                eff_scl, eff_off = ones, zeros
+            res = native.unpack4_calibrate(packed[r], eff_scl, eff_off)
+            if res is None:
+                return None
+            out[r * nsblk:(r + 1) * nsblk] = res
+        return out
+
     def read_all(self, apply_calibration: bool = True) -> np.ndarray:
         """Decode the entire observation into one (N, nchan) float32
         block, inserting padding (channel medians) between files."""
@@ -287,6 +335,11 @@ def unpack_samples(raw: np.ndarray, nbits: int, signed: bool = False) -> np.ndar
     if nbits == 16:
         dt = ">i2" if signed else ">u2"
         return raw.view(dt).astype(np.int32)
+    if nbits in (4, 2, 1) and not signed:
+        from tpulsar import native
+        out = native.unpack_bits(raw, nbits)
+        if out is not None:
+            return out
     if nbits == 4:
         hi = (raw >> 4) & 0x0F
         lo = raw & 0x0F

@@ -80,16 +80,30 @@ def form_subbands(data: jnp.ndarray, chan_shifts: jnp.ndarray,
 
 
 @jax.jit
-def dedisperse_subbands(subbands: jnp.ndarray,
-                        sub_shifts: jnp.ndarray) -> jnp.ndarray:
-    """Stage 2: (nsub, T') + (ndms, nsub) shifts -> (ndms, T') DM series.
-
-    vmapped shift-and-sum over the DM-trial axis.
-    """
+def _dedisperse_subbands_xla(subbands: jnp.ndarray,
+                             sub_shifts: jnp.ndarray) -> jnp.ndarray:
+    """vmapped shift-and-sum over the DM-trial axis (gather
+    formulation; re-reads the subband array once per trial)."""
     def one_dm(shifts):
         return _shift_gather(subbands, shifts).sum(axis=0)
 
     return jax.vmap(one_dm)(sub_shifts)
+
+
+def dedisperse_subbands(subbands: jnp.ndarray,
+                        sub_shifts: jnp.ndarray) -> jnp.ndarray:
+    """Stage 2: (nsub, T') + (ndms, nsub) shifts -> (ndms, T') DM series.
+
+    On TPU this dispatches to the Pallas sliding-window kernel
+    (kernels/pallas_dd.py), which stages each time block in VMEM once
+    for all DM trials; elsewhere (and under TPULSAR_PALLAS=0) it runs
+    the XLA gather formulation.
+    """
+    from tpulsar.kernels import pallas_dd
+
+    if pallas_dd.use_pallas():
+        return pallas_dd.dedisperse_subbands_pallas(subbands, sub_shifts)
+    return _dedisperse_subbands_xla(subbands, sub_shifts)
 
 
 def subband_reference_freqs(freqs_mhz: np.ndarray, nsub: int) -> np.ndarray:

@@ -1,0 +1,102 @@
+"""Native (C++) host-side kernels, bound via ctypes.
+
+Holds the framework's native runtime tier for host work that NumPy
+does inefficiently — currently PSRFITS bit-unpacking (unpack.cpp).
+The library is compiled on first use with the system g++ and cached
+next to the source; every entry point has a NumPy fallback, so the
+package works (slower) without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "unpack.cpp")
+_LIB = os.path.join(_HERE, "_tpulsar_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", _LIB]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=120)
+        return r.returncode == 0 and os.path.exists(_LIB)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, building it on first call (None if no
+    toolchain / build failure — callers must fall back)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i16p = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        for name in ("tpulsar_unpack4", "tpulsar_unpack2",
+                     "tpulsar_unpack1"):
+            fn = getattr(lib, name)
+            fn.argtypes = [u8p, i16p, ctypes.c_size_t]
+            fn.restype = None
+        lib.tpulsar_unpack4_cal.argtypes = [
+            u8p, f32p, ctypes.c_size_t, ctypes.c_size_t, f32p, f32p]
+        lib.tpulsar_unpack4_cal.restype = None
+        _lib = lib
+        return _lib
+
+
+def unpack_bits(raw: np.ndarray, nbits: int) -> np.ndarray | None:
+    """Unpack (..., nbytes) uint8 -> (..., nsamples) int16 natively;
+    None if the native library is unavailable."""
+    lib = load()
+    if lib is None or nbits not in (4, 2, 1):
+        return None
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    per = 8 // nbits
+    out = np.empty(raw.shape[:-1] + (raw.shape[-1] * per,),
+                   dtype=np.int16)
+    fn = {4: lib.tpulsar_unpack4, 2: lib.tpulsar_unpack2,
+          1: lib.tpulsar_unpack1}[nbits]
+    fn(raw.reshape(-1), out.reshape(-1), raw.size)
+    return out
+
+
+def unpack4_calibrate(raw: np.ndarray, scales: np.ndarray,
+                      offsets: np.ndarray) -> np.ndarray | None:
+    """Fused 4-bit unpack + per-channel scale/offset: (nspec, nchan/2)
+    uint8 -> (nspec, nchan) float32.  None if unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    nspec, nb = raw.shape
+    nchan = nb * 2
+    scales = np.ascontiguousarray(scales, dtype=np.float32)
+    offsets = np.ascontiguousarray(offsets, dtype=np.float32)
+    if scales.shape != (nchan,) or offsets.shape != (nchan,):
+        return None
+    out = np.empty((nspec, nchan), dtype=np.float32)
+    lib.tpulsar_unpack4_cal(raw, out, nspec, nchan, scales, offsets)
+    return out

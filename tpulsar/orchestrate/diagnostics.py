@@ -73,6 +73,21 @@ def get_diagnostics(resultsdir: str, basenm: str):
         if os.path.exists(path):
             diags.append(PlotDiagnosticUpload(name, path))
 
+    # Per-beam single-pulse plots, one per reference DM window
+    # (sp_candidates.py:293-311)
+    for path in sorted(glob.glob(os.path.join(
+            resultsdir, f"{basenm}_singlepulse_DMs*.png"))):
+        tag = os.path.basename(path).split("_singlepulse_")[1]
+        tag = tag.rsplit(".", 1)[0]
+        diags.append(PlotDiagnosticUpload(
+            f"Single-pulse plot {tag}", path))
+
+    # Folded-candidate plots (reference PeriodicityCandidatePNG)
+    for path in sorted(glob.glob(os.path.join(
+            resultsdir, f"{basenm}_cand*.png"))):
+        diags.append(PlotDiagnosticUpload(
+            os.path.basename(path).rsplit(".", 1)[0], path))
+
     if not diags:
         raise UploadError(f"no diagnostics derivable from {resultsdir}")
     return diags

@@ -25,7 +25,12 @@ class SubmitRegistry:
     job.  Backends persist the mapping here (a small JSON file, written
     atomically) so had_errors()/get_errors() survive restarts."""
 
+    #: registry entries older than this are pruned at load time — far
+    #: beyond any plausible walltime, purely a growth bound
+    MAX_AGE_S = 14 * 86400.0
+
     def __init__(self, path: str | None):
+        import time
         self.path = path
         self._lock = threading.Lock()
         self._map: dict[str, dict] = {}
@@ -35,8 +40,17 @@ class SubmitRegistry:
                     self._map = json.load(fh)
             except (OSError, ValueError):
                 self._map = {}
+            cutoff = time.time() - self.MAX_AGE_S
+            stale = [q for q, info in self._map.items()
+                     if info.get("ts", cutoff + 1) < cutoff]
+            for q in stale:
+                del self._map[q]
+            if stale:
+                self._save()
 
     def put(self, queue_id: str, **info) -> None:
+        import time
+        info.setdefault("ts", time.time())
         with self._lock:
             self._map[str(queue_id)] = info
             self._save()

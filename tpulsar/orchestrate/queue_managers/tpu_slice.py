@@ -38,17 +38,24 @@ class TPUSliceManager:
                  launcher: str = "ssh {host} {cmd}",
                  remote_cmd: str = "python -m tpulsar.cli.search_job",
                  env_extra: dict | None = None,
-                 state_file: str | None = None):
+                 state_file: str | None = None,
+                 lost_job_timeout_s: float = 24 * 3600.0):
         """hosts: TPU host addresses, one concurrent beam each.
-        launcher: template with {host} and {cmd} placeholders."""
+        launcher: template with {host} and {cmd} placeholders.
+        lost_job_timeout_s: a restart-orphaned job whose exit marker
+        never appears is declared lost (and its slot freed) after this
+        long — the guard against a host that died before the wrapper
+        could write the marker."""
         if not hosts:
             raise ValueError("TPUSliceManager needs at least one host")
         self.hosts = list(hosts)
         self.launcher = launcher
         self.remote_cmd = remote_cmd
         self.env_extra = env_extra or {}
+        self.lost_job_timeout_s = lost_job_timeout_s
         self._lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
+        self._done: set[str] = set()   # qids observed finished (cache)
         self._registry = SubmitRegistry(state_file)
 
     def _free_host(self) -> str | None:
@@ -62,12 +69,15 @@ class TPUSliceManager:
     def _live_qids(self) -> list[str]:
         with self._lock:
             qids = list(self._procs)
+            done = set(self._done)
         # registry entries from a previous daemon life are live until
-        # their exit marker appears
+        # their exit marker appears; qids already seen finished are
+        # skipped without touching the filesystem again
         for qid in self._registry.all_ids():
-            if qid not in qids:
+            if qid not in qids and qid not in done:
                 qids.append(qid)
-        return [qid for qid in qids if self.is_running(qid)]
+        return [qid for qid in qids
+                if qid not in done and self.is_running(qid)]
 
     def submit(self, datafiles: list[str], outdir: str, job_id: int) -> str:
         host = self._free_host()
@@ -111,6 +121,8 @@ class TPUSliceManager:
 
     def is_running(self, queue_id: str) -> bool:
         if self._exit_code(queue_id) is not None:
+            with self._lock:
+                self._done.add(queue_id)
             return False
         with self._lock:
             proc = self._procs.get(queue_id)
@@ -118,32 +130,46 @@ class TPUSliceManager:
             if proc.poll() is None:
                 return True
             # launcher exited without writing the marker: launch failed
+            self._mark_done(queue_id)
             return False
-        # no handle (daemon restarted): still running until the marker
-        # appears, as long as we ever knew about it
-        return self._registry.known(queue_id)
+        # No handle (daemon restarted): still running until the marker
+        # appears — bounded by the lost-job timeout so a host that died
+        # before the wrapper ran cannot leak its slot forever.
+        if not self._registry.known(queue_id):
+            return False
+        import time
+        submitted = self._registry.get(queue_id, "ts", 0.0)
+        if time.time() - submitted > self.lost_job_timeout_s:
+            self._mark_done(queue_id, code="137")
+            return False
+        return True
+
+    def _mark_done(self, queue_id: str, code: str = "1") -> None:
+        """Write the exit marker on the job's behalf (launcher death /
+        operator delete / lost-job timeout) so the state converges."""
+        exitpath = self._registry.get(queue_id, "exitpath")
+        if exitpath and not os.path.exists(exitpath):
+            try:
+                with open(exitpath, "w") as fh:
+                    fh.write(code + "\n")
+            except OSError:
+                pass
+        with self._lock:
+            self._done.add(queue_id)
 
     def delete(self, queue_id: str) -> bool:
         with self._lock:
             proc = self._procs.get(queue_id)
-        if proc is None:
-            return False
-        if proc.poll() is None:
+        if proc is not None and proc.poll() is None:
             proc.terminate()
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
-        # killing the launcher means the remote wrapper never writes
-        # its marker: write it here so the slot frees and the state
-        # machine converges
-        exitpath = self._registry.get(queue_id, "exitpath")
-        if exitpath and not os.path.exists(exitpath):
-            try:
-                with open(exitpath, "w") as fh:
-                    fh.write("143\n")
-            except OSError:
-                pass
+        if proc is None and not self._registry.known(queue_id):
+            return False
+        # the killed (or unreachable) wrapper never writes its marker
+        self._mark_done(queue_id, code="143")
         return True
 
     def status(self) -> tuple[int, int]:

@@ -70,6 +70,7 @@ class SearchParams:
     fold_nbin: int = 64
     fold_npart: int = 32
     max_dms_per_chunk: int = 128    # device memory blocking
+    make_plots: bool = True         # fold + single-pulse PNGs
 
     def provenance(self) -> dict:
         d = dataclasses.asdict(self)
@@ -177,6 +178,19 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
             reduced_chi2=res.reduced_chi2)
         with open(stem + ".bestprof", "w") as fh:
             fh.write(res.bestprof_text(si.source))
+
+    if params.make_plots:
+        with timers.timing("plotting"):
+            from tpulsar.search import plots
+            for i, res in enumerate(folded):
+                plots.prepfold_plot(
+                    res,
+                    os.path.join(resultsdir, f"{basenm}_cand{i+1}.png"),
+                    source=si.source,
+                    extra_title=f"{basenm} cand {i+1}")
+            plots.single_pulse_plots(
+                sp_events, resultsdir, basenm,
+                t_obs=data.shape[1] * si.dt)
 
     _write_header_json(resultsdir, obj)
     _write_search_params(resultsdir, params, basenm, si, num_trials)
