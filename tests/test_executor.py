@@ -105,3 +105,75 @@ def test_diagnostics_include_plots(beam_outcome):
     names = [d.name for d in diags]
     assert sum(1 for n in names if n.startswith("Single-pulse plot")) == 3
     assert any(n.startswith("RFI mask") for n in names)
+
+
+def test_pass_checkpoint_resume(tmp_path):
+    """Interrupting a plan mid-way and re-entering must resume at the
+    first incomplete pass and produce identical results."""
+    import jax.numpy as jnp
+    from tpulsar.plan.ddplan import DedispStep
+
+    rng = np.random.default_rng(21)
+    data = jnp.asarray(
+        rng.integers(0, 16, size=(24, 4096), dtype=np.uint8))
+    freqs = 1214.2 + (np.arange(24) + 0.5) * (322.6 / 24)
+    plan = [DedispStep(0.0, 1.0, 8, 2, 12, 1),
+            DedispStep(16.0, 2.0, 8, 1, 12, 2)]
+    params = executor.SearchParams(run_hi_accel=False,
+                                   max_cands_to_fold=0, make_plots=False)
+    ck = str(tmp_path / "ck")
+
+    ref_c, _, ref_sp, ref_n = executor.search_block(
+        data, freqs, 65e-6, plan, params)
+
+    # run once with checkpointing: all 3 passes dumped
+    c1, _, sp1, n1 = executor.search_block(
+        data, freqs, 65e-6, plan, params, checkpoint_dir=ck)
+    import glob as g
+    dumps = sorted(g.glob(os.path.join(ck, "pass_*.npz")))
+    assert len(dumps) == 3
+    # delete the last pass dump: simulates a crash during pass 3
+    os.remove(dumps[-1])
+    c2, _, sp2, n2 = executor.search_block(
+        data, freqs, 65e-6, plan, params, checkpoint_dir=ck)
+    assert n1 == n2 == ref_n
+    assert len(c2) == len(ref_c)
+    key = lambda c: (round(c.dm, 3), round(c.freq_hz, 3))
+    assert sorted(map(key, c2)) == sorted(map(key, ref_c))
+    assert len(sp2) == len(ref_sp)
+
+
+def test_checkpoint_config_mismatch_wipes(tmp_path):
+    """Dumps from a different search configuration must not be
+    resumed — the fingerprint mismatch wipes them."""
+    import jax.numpy as jnp
+    from tpulsar.plan.ddplan import DedispStep
+
+    rng = np.random.default_rng(3)
+    data = jnp.asarray(rng.integers(0, 16, (16, 2048), dtype=np.uint8))
+    freqs = 1214.2 + (np.arange(16) + 0.5) * (322.6 / 16)
+    plan = [DedispStep(0.0, 1.0, 8, 1, 8, 1)]
+    ck = str(tmp_path / "ck")
+    p1 = executor.SearchParams(run_hi_accel=False, max_cands_to_fold=0,
+                               make_plots=False)
+    executor.search_block(data, freqs, 65e-6, plan, p1,
+                          checkpoint_dir=ck)
+    import glob as g
+    assert len(g.glob(os.path.join(ck, "pass_*.npz"))) == 1
+    mtime = os.path.getmtime(g.glob(os.path.join(ck, "pass_*.npz"))[0])
+    # different sift threshold -> different fingerprint -> fresh run
+    p2 = executor.SearchParams(run_hi_accel=False, max_cands_to_fold=0,
+                               make_plots=False, sp_threshold=9.0)
+    executor.search_block(data, freqs, 65e-6, plan, p2,
+                          checkpoint_dir=ck)
+    path2 = g.glob(os.path.join(ck, "pass_*.npz"))[0]
+    assert os.path.getmtime(path2) >= mtime
+    with open(os.path.join(ck, "manifest.txt")) as fh:
+        fp2 = fh.read()
+    # same config -> resumed (manifest unchanged, dump not rewritten)
+    mtime2 = os.path.getmtime(path2)
+    executor.search_block(data, freqs, 65e-6, plan, p2,
+                          checkpoint_dir=ck)
+    assert os.path.getmtime(path2) == mtime2
+    with open(os.path.join(ck, "manifest.txt")) as fh:
+        assert fh.read() == fp2

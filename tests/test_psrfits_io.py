@@ -125,3 +125,37 @@ def test_multifile_padding(tmp_path):
     assert si.N == 2 * spec1.nsamp + gap
     block = si.read_all()
     assert block.shape[0] == si.N
+
+
+def test_wapp_position_correction(tmp_path):
+    """WAPP coordinate-table fix: RA/DEC patched in place in the FITS
+    header and the domain object refreshed (reference
+    datafile.py:153-197,339-393)."""
+    import shutil
+    from tpulsar.io import datafile, fitscore, synth
+
+    spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64, nbits=4,
+                          ra_str="05:34:31.900", dec_str="+22:00:52.00")
+    paths = synth.synth_beam(str(tmp_path / "b"), spec, merged=True)
+    wapp_fn = str(tmp_path / "P1234_55555_00042_0007_G55.0+0.0_3.w4bit.fits")
+    shutil.copy(paths[0], wapp_fn)
+
+    table = tmp_path / "coords.txt"
+    table.write_text("# mjd scan beam ra dec\n"
+                     "55555 7 3 19:07:09.900 +09:09:09.00\n")
+
+    obj = datafile.autogen_dataobj([wapp_fn])
+    assert isinstance(obj, datafile.WappPsrfitsData)
+    assert obj.get_correct_positions(str(table)) == (
+        "19:07:09.900", "+09:09:09.00")
+    assert obj.update_positions(str(table))
+    # header really changed on disk
+    hdus = fitscore.read_fits(wapp_fn)
+    assert hdus[0].header["RA"] == "19:07:09.900"
+    assert hdus[0].header["DEC"] == "+09:09:09.00"
+    assert abs(obj.orig_ra_deg - 286.79125) < 1e-3
+    # no table entry -> no-op
+    obj2 = datafile.autogen_dataobj([wapp_fn])
+    table2 = tmp_path / "empty.txt"
+    table2.write_text("")
+    assert not obj2.update_positions(str(table2))

@@ -373,6 +373,62 @@ def cmd_monitor(args):
         return 0
 
 
+def cmd_db_shell(args):
+    """Interactive SQL prompt on the results DB (reference
+    lib/python/database.py:184-224 InteractiveDatabasePrompt, with
+    table-name completion instead of sproc completion)."""
+    import cmd as cmd_mod
+
+    from tpulsar.config import settings
+    from tpulsar.orchestrate.results_db import ResultsDB
+
+    db = ResultsDB(args.url or settings().resultsdb.url)
+    tables = [r["name"] for r in db.execute(
+        "SELECT name FROM sqlite_master WHERE type='table'").fetchall()]
+
+    class Prompt(cmd_mod.Cmd):
+        prompt = "resultsdb> "
+        intro = (f"connected ({', '.join(tables) or 'no tables'}); "
+                 f"'.tables' lists tables, EOF/quit exits")
+
+        def default(self, line):
+            if line.strip() in (".tables", "tables"):
+                print("\n".join(tables))
+                return
+            try:
+                cur = db.execute(line)
+                rows = cur.fetchall()
+            except Exception as e:
+                print(f"error: {e}")
+                return
+            if rows:
+                cols = rows[0].keys()
+                print(" | ".join(cols))
+                for r in rows[:200]:
+                    print(" | ".join(str(r[c])[:40] for c in cols))
+                if len(rows) > 200:
+                    print(f"... {len(rows) - 200} more rows")
+            db.commit()
+
+        def completenames(self, text, *ignored):
+            kw = ["SELECT", "INSERT", "UPDATE", "DELETE", "quit"]
+            return [k for k in kw + tables if k.lower().startswith(
+                text.lower())]
+
+        def do_quit(self, line):
+            return True
+
+        do_EOF = do_quit
+
+    try:
+        Prompt().cmdloop()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        db.close()
+    return 0
+
+
 def cmd_search(args):
     from tpulsar.cli import search_job
     argv = list(args.files) + ["--outdir", args.outdir]
@@ -420,6 +476,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("remove-files")
     sp.add_argument("file_ids", nargs="+", type=int)
     sp.set_defaults(fn=cmd_remove_files)
+
+    sp = sub.add_parser("db-shell")
+    sp.add_argument("--url", default=None,
+                    help="results DB (default: resultsdb.url)")
+    sp.set_defaults(fn=cmd_db_shell)
 
     sp = sub.add_parser("stats")
     sp.add_argument("--png", default=None,

@@ -134,13 +134,18 @@ def main(argv=None) -> int:
         params = executor.SearchParams.from_config(cfg.searching)
         if args.no_accel:
             params.run_hi_accel = False
+        # checkpoints live in the durable output dir, so a retried
+        # submission resumes at the first incomplete DDplan pass
+        ckdir = os.path.join(outdir, ".checkpoint")
         outcome = executor.search_beam(
             ppfns, workdir, os.path.join(workdir, "results"),
-            params=params, zaplist=zap)
+            params=params, zaplist=zap, checkpoint_dir=ckdir)
         os.makedirs(outdir, exist_ok=True)
         for name in os.listdir(outcome.resultsdir):
             shutil.copy2(os.path.join(outcome.resultsdir, name),
                          os.path.join(outdir, name))
+        # only after results are durable is resume state disposable
+        shutil.rmtree(ckdir, ignore_errors=True)
         print(f"search complete: {len(outcome.candidates)} candidates, "
               f"{outcome.num_dm_trials} DM trials")
         return 0

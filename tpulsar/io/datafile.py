@@ -182,7 +182,14 @@ class MergedMockPsrfitsData(PsrfitsData):
 
 
 class WappPsrfitsData(PsrfitsData):
-    """WAPP 4-bit PSRFITS (reference: lib/python/datafile.py:312-317)."""
+    """WAPP 4-bit PSRFITS (reference: lib/python/datafile.py:312-317).
+
+    Early WAPP headers carry wrong sky positions; the reference fixes
+    them from a survey coordinate table before searching
+    (`get_correct_positions`/`update_positions`,
+    lib/python/datafile.py:153-197,339-393).  The table here is plain
+    whitespace columns: ``mjd scan beam ra_str dec_str``.
+    """
 
     filename_re = re.compile(
         r"^(?P<projid>[Pp]\d{4})_(?P<mjd>\d{5})_"
@@ -194,8 +201,69 @@ class WappPsrfitsData(PsrfitsData):
         self.obstype = "WAPP"
         m = self.fnmatch(self.fns[0])
         self.scan_num = m.group("scan")
+        self.mjd_str = m.group("mjd")
         if self.beam_id is None:
             self.beam_id = int(m.group("beam"))
+
+    def get_correct_positions(self, coords_table: str
+                              ) -> tuple[str, str] | None:
+        """(ra_str, dec_str) from the survey coordinate table, or None
+        when this observation has no entry."""
+        key = (int(self.mjd_str), int(self.scan_num), int(self.beam_id))
+        return load_coords_table(coords_table).get(key)
+
+    def update_positions(self, coords_table: str) -> bool:
+        """Patch RA/DEC in every file's primary header in place and
+        refresh the in-memory header.  True if a correction applied."""
+        pos = self.get_correct_positions(coords_table)
+        if pos is None:
+            return False
+        ra_str, dec_str = pos
+        for fn in self.fns:
+            n = fitscore.rewrite_cards(fn, {"RA": ra_str,
+                                            "DEC": dec_str})
+            if n != 2:
+                raise DatafileError(
+                    f"position correction failed for {fn}: "
+                    f"{n}/2 header cards rewritten")
+        self.specinfo = si = SpectraInfo(self.fns)   # re-read headers
+        self.orig_ra_deg = si.ra2000
+        self.orig_dec_deg = si.dec2000
+        self.right_ascension = _compact_hms(si.ra2000)
+        self.declination = _compact_dms(si.dec2000)
+        l, b = coords.equatorial_to_galactic(si.ra2000, si.dec2000)
+        self.galactic_longitude = float(l)
+        self.galactic_latitude = float(b)
+        return True
+
+    def preprocess(self) -> list[str]:
+        """Apply the coordinate correction when a survey table is
+        configured (reference wires this into the search set-up)."""
+        from tpulsar.config import settings
+        table = settings().basic.coords_table
+        if table and os.path.exists(table):
+            self.update_positions(table)
+        return list(self.fns)
+
+
+def load_coords_table(path: str) -> dict:
+    """Parse a survey coordinate table: ``mjd scan beam ra dec`` per
+    line ('#' comments allowed) -> {(mjd, scan, beam): (ra, dec)}."""
+    table = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) < 5:
+                continue
+            try:
+                key = (int(parts[0]), int(parts[1]), int(parts[2]))
+            except ValueError:
+                continue
+            table[key] = (parts[3], parts[4])
+    return table
 
 
 def get_datafile_type(fns: list[str]) -> type[Data]:

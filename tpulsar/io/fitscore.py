@@ -223,6 +223,50 @@ def read_header(fh) -> tuple[Header, int]:
     return hdr, consumed
 
 
+def rewrite_cards(path: str | os.PathLike, updates: dict[str, Any],
+                  hdu_index: int = 0) -> int:
+    """Rewrite header cards of an existing file in place.
+
+    Card slots are fixed 80-byte records, so replacing a value never
+    moves data (the same property the reference exploits by patching
+    RA/DEC through pyfits, lib/python/datafile.py:339-393).  Only keys
+    already present are rewritten; returns the number updated.
+    """
+    updates = {k.upper(): v for k, v in updates.items()}
+    n_updated = 0
+    with open(path, "r+b") as fh:
+        # seek to the target HDU's header
+        for _ in range(hdu_index):
+            hdr, _consumed = read_header(fh)
+            size = _data_size(hdr)
+            fh.seek((size + BLOCK - 1) // BLOCK * BLOCK, os.SEEK_CUR)
+        hdr_start = fh.tell()
+        done = False
+        offset = hdr_start
+        while not done:
+            block = fh.read(BLOCK)
+            if len(block) < BLOCK:
+                raise FitsError("truncated FITS header")
+            for i in range(0, BLOCK, CARDLEN):
+                card = block[i:i + CARDLEN]
+                if card[:3] == b"END" and card[3:8].strip() == b"":
+                    done = True
+                    break
+                key = card[:8].decode("ascii", "replace").strip()
+                if key in updates and card[8:10] == b"= ":
+                    parsed = _parse_card(card)
+                    comment = parsed[2] if parsed else ""
+                    newcard = _format_card(key, updates[key], comment)
+                    pos = offset + i
+                    cur = fh.tell()
+                    fh.seek(pos)
+                    fh.write(newcard)
+                    fh.seek(cur)
+                    n_updated += 1
+            offset += BLOCK
+    return n_updated
+
+
 def parse_tform(tform: str) -> tuple[int, str]:
     """'16E' -> (16, 'E');  'D' -> (1, 'D')."""
     m = _TFORM_RE.match(tform.strip())

@@ -21,6 +21,12 @@ import numpy as np
 
 DEFAULT_WIDTHS = (1, 2, 3, 4, 6, 9, 14, 20, 30)
 
+#: structured dtype of single-pulse event records (shared by the
+#: executor's empty fallback and checkpoint round-trips)
+SP_EVENT_DTYPE = np.dtype([("dm", "f8"), ("sigma", "f8"),
+                           ("time_s", "f8"), ("sample", "i8"),
+                           ("downfact", "i4")])
+
 
 @partial(jax.jit, static_argnames=("detrend_block",))
 def normalize_series(series: jnp.ndarray, detrend_block: int = 1000):
@@ -93,9 +99,7 @@ def single_pulse_search(series: jnp.ndarray, dms: np.ndarray, dt: float,
     keep = snrs >= threshold
     snr_f = snrs[keep]
     if snr_f.size == 0:
-        return np.empty(0, dtype=[("dm", "f8"), ("sigma", "f8"),
-                                  ("time_s", "f8"), ("sample", "i8"),
-                                  ("downfact", "i4")])
+        return np.empty(0, dtype=SP_EVENT_DTYPE)
     wi_f = np.broadcast_to(wi, snrs.shape)[keep]
     di_f = np.broadcast_to(di, snrs.shape)[keep]
     samp_f = idx[keep]
@@ -108,9 +112,7 @@ def single_pulse_search(series: jnp.ndarray, dms: np.ndarray, dt: float,
     first[1:] = combo_sorted[1:] != combo_sorted[:-1]
     sel = order[first]
 
-    out = np.empty(len(sel), dtype=[("dm", "f8"), ("sigma", "f8"),
-                                    ("time_s", "f8"), ("sample", "i8"),
-                                    ("downfact", "i4")])
+    out = np.empty(len(sel), dtype=SP_EVENT_DTYPE)
     out["dm"] = dms[di_f[sel]]
     out["sigma"] = snr_f[sel]
     out["time_s"] = samp_f[sel] * dt

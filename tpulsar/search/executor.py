@@ -117,7 +117,8 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                 params: SearchParams | None = None,
                 zaplist: np.ndarray | None = None,
                 plan: list[ddplan.DedispStep] | None = None,
-                baryv: float = 0.0) -> SearchOutcome:
+                baryv: float = 0.0,
+                checkpoint_dir: str | None = None) -> SearchOutcome:
     """Search one beam end-to-end and write the results directory."""
     params = params or SearchParams()
     os.makedirs(workdir, exist_ok=True)
@@ -157,7 +158,7 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
 
     result = search_block(data, si.freqs, si.dt, plan, params,
                           zaplist=zaplist, baryv=baryv, nsub=nsub,
-                          timers=timers)
+                          timers=timers, checkpoint_dir=checkpoint_dir)
     final, folded, sp_events, num_trials = result
 
     # ----------------------------------------------------------- artifacts
@@ -209,12 +210,18 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                  params: SearchParams | None = None,
                  zaplist: np.ndarray | None = None, baryv: float = 0.0,
                  nsub: int | None = None,
-                 timers: StageTimers | None = None):
+                 timers: StageTimers | None = None,
+                 checkpoint_dir: str | None = None):
     """Run the plan loop + sifting + folding on an in-HBM block.
 
     data: (nchan, T) device array, any numeric dtype (uint8 is fine —
     conversion fuses into the subband reduction).  This is the
     benchmark surface: no file I/O, just the compute chain.
+
+    checkpoint_dir: when set, per-pass candidate dumps are written
+    there and completed passes are skipped on re-entry — pass-level
+    resume on top of the reference's job-level restart unit
+    (SURVEY.md 5.4).
 
     Returns (candidates, folded, sp_events, num_dm_trials).
     """
@@ -227,9 +234,27 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     all_cands: list[sifting.Candidate] = []
     sp_chunks: list[np.ndarray] = []
     num_trials = 0
+    pass_idx = -1
+    if checkpoint_dir:
+        _prepare_checkpoint_dir(
+            checkpoint_dir,
+            _ckpt_fingerprint(plan, params, zaplist, baryv, nsub))
 
     for step in plan:
         for ppass in step.passes():
+            pass_idx += 1
+            if checkpoint_dir:
+                done = _load_pass_checkpoint(checkpoint_dir, pass_idx)
+                if done is not None:
+                    cands, events, ntr = done
+                    all_cands.extend(cands)
+                    if len(events):
+                        sp_chunks.append(events)
+                    num_trials += ntr
+                    continue
+            pass_cands_start = len(all_cands)
+            pass_sp_start = len(sp_chunks)
+            pass_trials_start = num_trials
             with timers.timing("subbanding"):
                 chan_shifts, sub_shifts = dd.plan_pass_shifts(
                     freqs, nsub, ppass.subdm, np.asarray(ppass.dms),
@@ -271,14 +296,19 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                         all_cands.extend(_hi_accel_pass(
                             series, dm_chunk, T_s, params))
             del subb
+            if checkpoint_dir:
+                _save_pass_checkpoint(
+                    checkpoint_dir, pass_idx,
+                    all_cands[pass_cands_start:],
+                    (np.concatenate(sp_chunks[pass_sp_start:])
+                     if len(sp_chunks) > pass_sp_start
+                     else _EMPTY_SP),
+                    num_trials - pass_trials_start)
 
     with timers.timing("sifting"):
         final = sifting.sift(all_cands, params.sifting)
 
-    sp_events = (np.concatenate(sp_chunks) if sp_chunks
-                 else np.empty(0, dtype=[("dm", "f8"), ("sigma", "f8"),
-                                         ("time_s", "f8"), ("sample", "i8"),
-                                         ("downfact", "i4")]))
+    sp_events = (np.concatenate(sp_chunks) if sp_chunks else _EMPTY_SP)
 
     folded: list[fold_k.FoldResult] = []
     with timers.timing("folding"):
@@ -294,6 +324,74 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
 
 
 # ------------------------------------------------------------------ helpers
+
+_EMPTY_SP = np.empty(0, dtype=sp_k.SP_EVENT_DTYPE)
+
+_CAND_FIELDS = ("r", "z", "sigma", "power", "numharm", "dm",
+                "period_s", "freq_hz")
+
+
+def _ckpt_fingerprint(plan, params, zaplist, baryv, nsub) -> str:
+    """Configuration fingerprint stored with the checkpoints: dumps
+    from a run with different search settings must not be resumed."""
+    import hashlib
+    zap = (np.asarray(zaplist).tobytes() if zaplist is not None
+           else b"none")
+    blob = repr((
+        [(s.lodm, s.dmstep, s.dms_per_pass, s.numpasses, s.numsub,
+          s.downsamp) for s in plan],
+        sorted(params.provenance().items()), baryv, nsub,
+    )).encode() + zap
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _prepare_checkpoint_dir(ckdir: str, fingerprint: str) -> None:
+    """Create/validate the checkpoint dir; wipe stale dumps written
+    under a different configuration."""
+    import shutil
+    manifest = os.path.join(ckdir, "manifest.txt")
+    if os.path.isdir(ckdir):
+        old = None
+        if os.path.exists(manifest):
+            with open(manifest) as fh:
+                old = fh.read().strip()
+        if old != fingerprint:
+            shutil.rmtree(ckdir, ignore_errors=True)
+    os.makedirs(ckdir, exist_ok=True)
+    with open(manifest, "w") as fh:
+        fh.write(fingerprint)
+
+
+def _save_pass_checkpoint(ckdir: str, pass_idx: int,
+                          cands: list[sifting.Candidate],
+                          events: np.ndarray, ntrials: int) -> None:
+    """Durable per-pass dump; written atomically so a crash mid-write
+    re-runs the pass instead of resuming from garbage."""
+    path = os.path.join(ckdir, f"pass_{pass_idx:04d}.npz")
+    arrs = {f: np.asarray([getattr(c, f) for c in cands])
+            for f in _CAND_FIELDS}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(fh, events=events,
+                            ntrials=np.int64(ntrials), **arrs)
+    os.replace(tmp, path)
+
+
+def _load_pass_checkpoint(ckdir: str, pass_idx: int):
+    """(cands, events, ntrials) for a completed pass, else None."""
+    path = os.path.join(ckdir, f"pass_{pass_idx:04d}.npz")
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            n = len(z["sigma"])
+            cands = [sifting.Candidate(**{
+                f: (int if f == "numharm" else float)(z[f][i])
+                for f in _CAND_FIELDS}) for i in range(n)]
+            return cands, z["events"], int(z["ntrials"])
+    except (OSError, ValueError, KeyError):
+        return None      # corrupt checkpoint: redo the pass
+
 
 def _largest_divisor_leq(n: int, k: int) -> int:
     for d in range(min(n, k), 0, -1):
