@@ -67,3 +67,23 @@ def test_generated_plan_covers_range_and_balances_smearing():
         assert obs.blocklen % s.downsamp == 0
     fr = ddplan.work_fractions(steps)
     assert abs(fr.sum() - 1.0) < 1e-12
+
+
+def test_describe_and_plot_plan(tmp_path):
+    from tpulsar.plan import ddplan
+    steps = ddplan.survey_plan("pdev")
+    obs = ddplan.Observation(dt=65.476e-6, fctr=1375.5, bw=322.617,
+                             numchan=960, blocklen=2048)
+    text = ddplan.describe_plan(steps, obs)
+    assert "total DM trials" in text and "4188" in text
+    png = str(tmp_path / "plan.png")
+    assert ddplan.plot_plan(steps, obs, png) == png
+    import os
+    assert os.path.getsize(png) > 1000
+
+
+def test_plan_cli(tmp_path, capsys):
+    from tpulsar.cli import main as cli
+    assert cli.main(["plan", "--survey", "pdev"]) == 0
+    out = capsys.readouterr().out
+    assert "total DM trials" in out

@@ -177,3 +177,26 @@ def test_checkpoint_config_mismatch_wipes(tmp_path):
     assert os.path.getmtime(path2) == mtime2
     with open(os.path.join(ck, "manifest.txt")) as fh:
         assert fh.read() == fp2
+
+
+def test_checkpoint_beam_mismatch_wipes(tmp_path):
+    """A different beam's dumps in the same checkpoint dir must be
+    invalidated via the data_id fingerprint component."""
+    import jax.numpy as jnp
+    from tpulsar.plan.ddplan import DedispStep
+
+    rng = np.random.default_rng(4)
+    data = jnp.asarray(rng.integers(0, 16, (16, 2048), dtype=np.uint8))
+    freqs = 1214.2 + (np.arange(16) + 0.5) * (322.6 / 16)
+    plan = [DedispStep(0.0, 1.0, 8, 1, 8, 1)]
+    ck = str(tmp_path / "ck")
+    p = executor.SearchParams(run_hi_accel=False, max_cands_to_fold=0,
+                              make_plots=False)
+    executor.search_block(data, freqs, 65e-6, plan, p,
+                          checkpoint_dir=ck, data_id="beamA")
+    with open(os.path.join(ck, "manifest.txt")) as fh:
+        fp_a = fh.read()
+    executor.search_block(data, freqs, 65e-6, plan, p,
+                          checkpoint_dir=ck, data_id="beamB")
+    with open(os.path.join(ck, "manifest.txt")) as fh:
+        assert fh.read() != fp_a

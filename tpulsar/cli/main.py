@@ -373,6 +373,39 @@ def cmd_monitor(args):
         return 0
 
 
+def cmd_plan(args):
+    """Show (and optionally plot) the dedispersion plan for an
+    observation or explicit parameters (reference DDplan2b.py CLI)."""
+    from tpulsar.plan import ddplan
+
+    # An explicit DM range suppresses the file-backend survey plan —
+    # the operator's range always wins; --survey always forces the
+    # hardcoded plan.
+    explicit_range = args.lodm is not None or args.hidm is not None
+    lodm = args.lodm if args.lodm is not None else 0.0
+    hidm = args.hidm if args.hidm is not None else 1000.0
+    if args.files:
+        from tpulsar.io import datafile
+        si = datafile.autogen_dataobj(args.files).specinfo
+        survey = args.survey if args.survey is not None else \
+            ("" if explicit_range else None)
+        steps, obs, _nsub = ddplan.plan_for(
+            si, lodm, hidm, args.numsub, survey=survey)
+    else:
+        obs = ddplan.Observation(dt=args.dt, fctr=args.fctr, bw=args.bw,
+                                 numchan=args.numchan,
+                                 blocklen=args.blocklen)
+        if args.survey:
+            steps = ddplan.survey_plan(args.survey)
+        else:
+            steps = ddplan.generate_ddplan(obs, lodm, hidm,
+                                           numsub=args.numsub)
+    print(ddplan.describe_plan(steps, obs))
+    if args.png:
+        print("wrote", ddplan.plot_plan(steps, obs, args.png))
+    return 0
+
+
 def cmd_db_shell(args):
     """Interactive SQL prompt on the results DB (reference
     lib/python/database.py:184-224 InteractiveDatabasePrompt, with
@@ -476,6 +509,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("remove-files")
     sp.add_argument("file_ids", nargs="+", type=int)
     sp.set_defaults(fn=cmd_remove_files)
+
+    sp = sub.add_parser("plan")
+    sp.add_argument("files", nargs="*", help="observation files")
+    sp.add_argument("--dt", type=float, default=65.476e-6)
+    sp.add_argument("--fctr", type=float, default=1375.5)
+    sp.add_argument("--bw", type=float, default=322.617)
+    sp.add_argument("--numchan", type=int, default=960)
+    sp.add_argument("--blocklen", type=int, default=2048)
+    sp.add_argument("--lodm", type=float, default=None)
+    sp.add_argument("--hidm", type=float, default=None)
+    sp.add_argument("--numsub", type=int, default=96)
+    sp.add_argument("--survey", default=None,
+                    help="use the hardcoded survey plan (pdev|wapp)")
+    sp.add_argument("--png", default=None)
+    sp.set_defaults(fn=cmd_plan)
 
     sp = sub.add_parser("db-shell")
     sp.add_argument("--url", default=None,

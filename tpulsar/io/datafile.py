@@ -219,6 +219,15 @@ class WappPsrfitsData(PsrfitsData):
         if pos is None:
             return False
         ra_str, dec_str = pos
+        # pre-validate every file so a multi-file group is never left
+        # half-patched by a predictable failure
+        for fn in self.fns:
+            hdr = fitscore.read_fits(fn)[0].header
+            missing = [k for k in ("RA", "DEC") if k not in hdr]
+            if missing:
+                raise DatafileError(
+                    f"cannot correct position: {fn} primary header "
+                    f"lacks {missing}")
         for fn in self.fns:
             n = fitscore.rewrite_cards(fn, {"RA": ra_str,
                                             "DEC": dec_str})

@@ -102,7 +102,16 @@ def dedisperse_subbands(subbands: jnp.ndarray,
     from tpulsar.kernels import pallas_dd
 
     if pallas_dd.use_pallas():
-        return pallas_dd.dedisperse_subbands_pallas(subbands, sub_shifts)
+        try:
+            out = pallas_dd.dedisperse_subbands_pallas(subbands,
+                                                       sub_shifts)
+            # jax dispatch is async: force execution here so a kernel
+            # fault is caught by this except (and triggers the
+            # fallback) rather than surfacing downstream
+            jax.block_until_ready(out)
+            return out
+        except Exception as e:   # Mosaic unsupported on this runtime
+            pallas_dd.disable_pallas(reason=str(e)[:200])
     return _dedisperse_subbands_xla(subbands, sub_shifts)
 
 

@@ -126,13 +126,29 @@ def dedisperse_subbands_pallas(subbands, sub_shifts,
     return jnp.concatenate(outs, axis=0)
 
 
+_DISABLED_REASON: str | None = None
+
+
 def use_pallas() -> bool:
     """Pallas path gate: on by default on TPU, overridable with
     TPULSAR_PALLAS=0/1 (the escape hatch for TPU runtimes whose
     Mosaic support is broken)."""
+    if _DISABLED_REASON is not None:
+        return False
     env = os.environ.get("TPULSAR_PALLAS", "").strip()
     if env in ("0", "off", "false"):
         return False
     if env in ("1", "on", "true"):
         return True
     return jax.default_backend() == "tpu"
+
+
+def disable_pallas(reason: str) -> None:
+    """Kill the Pallas path for this process after a runtime/compile
+    failure; callers fall back to the XLA formulation."""
+    global _DISABLED_REASON
+    if _DISABLED_REASON is None:
+        _DISABLED_REASON = reason
+        import warnings
+        warnings.warn(f"Pallas dedispersion disabled, using XLA "
+                      f"fallback: {reason}")

@@ -232,6 +232,89 @@ def _round_dmstep(ddm: float) -> float:
     return float(snapped * 10 ** exp)
 
 
+def largest_divisor_leq(n: int, k: int) -> int:
+    for d in range(min(n, k), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def plan_for(si, lodm: float = 0.0, hidm: float = 1000.0,
+             numsub: int = 96, survey: str | None = None
+             ) -> tuple[list[DedispStep], Observation, int]:
+    """The plan the executor will actually run for an observation:
+    survey plan when requested (or the backend has one and no explicit
+    range narrows it), else a generated plan — with nsub corrected to
+    divide the channel count.  Returns (steps, obs, nsub)."""
+    nsub = numsub if si.num_channels % numsub == 0 else \
+        largest_divisor_leq(si.num_channels, numsub)
+    obs = Observation(dt=si.dt, fctr=si.fctr, bw=abs(si.BW),
+                      numchan=si.num_channels,
+                      blocklen=si.spectra_per_subint)
+    backend = survey if survey is not None else si.backend
+    try:
+        steps = survey_plan(backend)
+    except ValueError:
+        steps = generate_ddplan(obs, lodm, hidm, numsub=nsub)
+    return steps, obs, nsub
+
+
+def describe_plan(steps: list[DedispStep], obs: Observation | None = None
+                  ) -> str:
+    """Human-readable plan table (the text the reference's DDplan2b
+    prints: low/high DM, step, downsample, subbands, passes, trials)."""
+    lines = ["  loDM    hiDM    dDM  downsamp  nsub  dms/pass  passes  trials"]
+    for s in steps:
+        lines.append(
+            f"{s.lodm:7.1f} {s.hidm:7.1f} {s.dmstep:6.2f}  "
+            f"{s.downsamp:8d} {s.numsub:5d}  {s.dms_per_pass:8d} "
+            f"{s.numpasses:7d} {s.numdms:7d}")
+    lines.append(f"total DM trials: {total_dm_trials(steps)}")
+    if obs is not None:
+        wf = work_fractions(steps)
+        lines.append("work fractions: "
+                     + ", ".join(f"{w:.2f}" for w in wf))
+    return "\n".join(lines)
+
+
+def plot_plan(steps: list[DedispStep], obs: Observation, path: str) -> str:
+    """Smearing-budget plot over DM (the reference's DDplan2b.plot,
+    lib/python/DDplan2b.py:326-425): per-contribution smearing curves
+    and the per-step total."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(8, 6))
+    for s in steps:
+        dms = s.all_dms()
+        if not len(dms):
+            continue
+        chan = dm_smear(dms, obs.chanwidth, obs.fctr)
+        sub = dm_smear(np.abs(dms - np.repeat(
+            [p.subdm for p in s.passes()],
+            [p.numdms for p in s.passes()])[:len(dms)]),
+            obs.bw / s.numsub, obs.fctr)
+        samp = np.full_like(dms, obs.dt * s.downsamp)
+        stepsm = np.full_like(dms, 0.5 * s.dmstep
+                              * dm_smear(1.0, obs.bw, obs.fctr))
+        total = np.sqrt(chan ** 2 + sub ** 2 + samp ** 2 + stepsm ** 2)
+        (line,) = ax.plot(dms, total * 1e3, lw=1.5,
+                          label=f"dDM={s.dmstep:g} ds={s.downsamp}")
+        ax.plot(dms, chan * 1e3, ls=":", lw=0.7, color=line.get_color())
+        ax.plot(dms, samp * 1e3, ls="--", lw=0.7, color=line.get_color())
+    ax.set_xlabel("DM (pc cm$^{-3}$)")
+    ax.set_ylabel("Smearing (ms)")
+    ax.set_yscale("log")
+    ax.legend(fontsize=8)
+    ax.set_title(f"dedispersion plan  (dt={obs.dt*1e6:.1f} us, "
+                 f"{obs.numchan} chans, BW={obs.bw:g} MHz)")
+    fig.tight_layout()
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+    return path
+
+
 def total_dm_trials(steps: list[DedispStep]) -> int:
     return sum(s.numdms for s in steps)
 

@@ -130,16 +130,9 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     timers = StageTimers()
 
     nsub = params.nsub if si.num_channels % params.nsub == 0 else \
-        _largest_divisor_leq(si.num_channels, params.nsub)
-
+        ddplan.largest_divisor_leq(si.num_channels, params.nsub)
     if plan is None:
-        try:
-            plan = ddplan.survey_plan(si.backend)
-        except ValueError:
-            obs = ddplan.Observation(dt=si.dt, fctr=si.fctr, bw=abs(si.BW),
-                                     numchan=si.num_channels,
-                                     blocklen=si.spectra_per_subint)
-            plan = ddplan.generate_ddplan(obs, 0.0, 1000.0, numsub=nsub)
+        plan, _obs, nsub = ddplan.plan_for(si, numsub=params.nsub)
 
     # ---------------------------------------------------------- read + RFI
     block = si.read_all()                     # (T, nchan) ascending freq
@@ -156,9 +149,13 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     data = jnp.asarray(np.ascontiguousarray(clean.T))   # (nchan, T)
     del block, clean
 
+    data_id = ";".join(
+        f"{os.path.basename(fn)}:{os.path.getsize(fn)}" for fn in
+        sorted(fns)) + f"|mjd={float(si.start_MJD[0])!r}"
     result = search_block(data, si.freqs, si.dt, plan, params,
                           zaplist=zaplist, baryv=baryv, nsub=nsub,
-                          timers=timers, checkpoint_dir=checkpoint_dir)
+                          timers=timers, checkpoint_dir=checkpoint_dir,
+                          data_id=data_id)
     final, folded, sp_events, num_trials = result
 
     # ----------------------------------------------------------- artifacts
@@ -211,7 +208,8 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                  zaplist: np.ndarray | None = None, baryv: float = 0.0,
                  nsub: int | None = None,
                  timers: StageTimers | None = None,
-                 checkpoint_dir: str | None = None):
+                 checkpoint_dir: str | None = None,
+                 data_id: str = ""):
     """Run the plan loop + sifting + folding on an in-HBM block.
 
     data: (nchan, T) device array, any numeric dtype (uint8 is fine —
@@ -221,7 +219,9 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     checkpoint_dir: when set, per-pass candidate dumps are written
     there and completed passes are skipped on re-entry — pass-level
     resume on top of the reference's job-level restart unit
-    (SURVEY.md 5.4).
+    (SURVEY.md 5.4).  data_id should identify the input beam (file
+    names/sizes/MJD); it is folded into the checkpoint fingerprint so
+    another beam's dumps in the same directory are never resumed.
 
     Returns (candidates, folded, sp_events, num_dm_trials).
     """
@@ -236,9 +236,11 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     num_trials = 0
     pass_idx = -1
     if checkpoint_dir:
+        shape_id = f"{tuple(data.shape)}|{dt!r}|{freqs[0]!r}|{freqs[-1]!r}"
         _prepare_checkpoint_dir(
             checkpoint_dir,
-            _ckpt_fingerprint(plan, params, zaplist, baryv, nsub))
+            _ckpt_fingerprint(plan, params, zaplist, baryv, nsub,
+                              data_id=data_id + "|" + shape_id))
 
     for step in plan:
         for ppass in step.passes():
@@ -331,16 +333,18 @@ _CAND_FIELDS = ("r", "z", "sigma", "power", "numharm", "dm",
                 "period_s", "freq_hz")
 
 
-def _ckpt_fingerprint(plan, params, zaplist, baryv, nsub) -> str:
-    """Configuration fingerprint stored with the checkpoints: dumps
-    from a run with different search settings must not be resumed."""
+def _ckpt_fingerprint(plan, params, zaplist, baryv, nsub,
+                      data_id: str = "") -> str:
+    """Configuration + input fingerprint stored with the checkpoints:
+    dumps from a different search configuration OR a different beam
+    must not be resumed."""
     import hashlib
     zap = (np.asarray(zaplist).tobytes() if zaplist is not None
            else b"none")
     blob = repr((
         [(s.lodm, s.dmstep, s.dms_per_pass, s.numpasses, s.numsub,
           s.downsamp) for s in plan],
-        sorted(params.provenance().items()), baryv, nsub,
+        sorted(params.provenance().items()), baryv, nsub, data_id,
     )).encode() + zap
     return hashlib.sha256(blob).hexdigest()
 
