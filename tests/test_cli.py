@@ -85,3 +85,25 @@ def test_stats_and_monitor(tmp_path, capsys):
     assert cli.main(["--db", db, "monitor", "--once"]) == 0
     out = capsys.readouterr().out
     assert "downloads" in out
+
+
+def test_short_observation_clean_skip(tmp_path, capsys, monkeypatch):
+    """A below-threshold beam must exit 0 with a skip marker, not a
+    stderr-visible failure the scheduler would retry forever."""
+    from tpulsar.config import core, set_settings
+    from tpulsar.cli import search_job
+    from tpulsar.io import synth
+
+    cfg = core.TpulsarConfig()
+    cfg.searching.low_T_to_search = 3600.0
+    set_settings(cfg)
+    try:
+        spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64)
+        fns = synth.synth_beam(str(tmp_path / "b"), spec, merged=True)
+        out = str(tmp_path / "out")
+        rc = search_job.main(list(fns) + ["--outdir", out])
+        assert rc == 0
+        assert os.path.exists(os.path.join(out, "skipped.txt"))
+        assert "skipped" in capsys.readouterr().out
+    finally:
+        set_settings(core.TpulsarConfig())

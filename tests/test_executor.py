@@ -200,3 +200,20 @@ def test_checkpoint_beam_mismatch_wipes(tmp_path):
                           checkpoint_dir=ck, data_id="beamB")
     with open(os.path.join(ck, "manifest.txt")) as fh:
         assert fh.read() != fp_a
+
+
+def test_low_T_guard(tmp_path):
+    from tpulsar.io import synth
+    spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64)
+    fns = synth.synth_beam(str(tmp_path / "short"), spec, merged=True)
+    params = executor.SearchParams(low_T_to_search_s=60.0)
+    with pytest.raises(executor.TooShortToSearchError):
+        executor.search_beam(fns, str(tmp_path / "w"),
+                             str(tmp_path / "r"), params=params)
+
+
+def test_default_zaplist_fallback(tmp_path):
+    from tpulsar.cli.search_job import choose_zaplist
+    zap = choose_zaplist(["nonexistent.fits"], None, None)
+    assert zap is not None and zap.shape[1] == 2
+    assert (zap[:, 0] > 0).all()

@@ -80,6 +80,15 @@ def choose_zaplist(fns: list[str], zapdir: str | None,
                 zapdir, f"{gd['projid']}.{gd['date']}.all.zaplist"))
     if default:
         candidates.append(default)
+    if default and not os.path.exists(default):
+        # a configured-but-missing default is an operator error; do
+        # not silently search with the wrong birdie list
+        raise SystemExit(f"configured default zaplist missing: {default}")
+    # packaged default birdie list as the last resort (the reference
+    # ships lib/zaplists/PALFA.zaplist as its default)
+    import tpulsar
+    candidates.append(os.path.join(os.path.dirname(tpulsar.__file__),
+                                   "data", "default.zaplist"))
     for c in candidates:
         if c and os.path.exists(c):
             return parse_zaplist(c)
@@ -137,9 +146,19 @@ def main(argv=None) -> int:
         # checkpoints live in the durable output dir, so a retried
         # submission resumes at the first incomplete DDplan pass
         ckdir = os.path.join(outdir, ".checkpoint")
-        outcome = executor.search_beam(
-            ppfns, workdir, os.path.join(workdir, "results"),
-            params=params, zaplist=zap, checkpoint_dir=ckdir)
+        try:
+            outcome = executor.search_beam(
+                ppfns, workdir, os.path.join(workdir, "results"),
+                params=params, zaplist=zap, checkpoint_dir=ckdir)
+        except executor.TooShortToSearchError as e:
+            # a permanently-short observation is a clean skip, not a
+            # job failure (stderr would make the scheduler retry it
+            # forever) — record why in the output dir and succeed
+            os.makedirs(outdir, exist_ok=True)
+            with open(os.path.join(outdir, "skipped.txt"), "w") as fh:
+                fh.write(str(e) + "\n")
+            print(f"skipped: {e}")
+            return 0
         os.makedirs(outdir, exist_ok=True)
         for name in os.listdir(outcome.resultsdir):
             shutil.copy2(os.path.join(outcome.resultsdir, name),

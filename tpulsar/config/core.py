@@ -103,6 +103,7 @@ class SearchingConfig:
     singlepulse_threshold: float = 5.0
     nsub: int = 96
     datatype: str = "mock"
+    low_T_to_search: float = 0.0       # seconds; 0 = search everything
 
 
 @dataclasses.dataclass

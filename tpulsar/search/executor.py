@@ -71,6 +71,10 @@ class SearchParams:
     fold_npart: int = 32
     max_dms_per_chunk: int = 128    # device memory blocking
     make_plots: bool = True         # fold + single-pulse PNGs
+    low_T_to_search_s: float = 0.0  # skip observations shorter than
+    #                                 this (reference set_up_job guard,
+    #                                 PALFA2_presto_search.py:450);
+    #                                 0 = search everything
 
     def provenance(self) -> dict:
         d = dataclasses.asdict(self)
@@ -98,7 +102,12 @@ class SearchParams:
                 min_num_dms=searching.sifting_min_num_dms,
                 low_dm_cutoff=searching.sifting_low_dm_cutoff),
             to_prepfold_sigma=searching.to_prepfold_sigma,
-            max_cands_to_fold=searching.max_cands_to_fold)
+            max_cands_to_fold=searching.max_cands_to_fold,
+            low_T_to_search_s=searching.low_T_to_search)
+
+
+class TooShortToSearchError(ValueError):
+    """Observation below the low_T_to_search threshold."""
 
 
 @dataclasses.dataclass
@@ -126,6 +135,11 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
 
     obj = datafile.autogen_dataobj(fns)
     si = obj.specinfo
+    if si.T < params.low_T_to_search_s:
+        raise TooShortToSearchError(
+            f"observation is {si.T:.1f} s < low_T_to_search "
+            f"{params.low_T_to_search_s:.1f} s "
+            f"(reference PALFA2_presto_search.py:450)")
     basenm = os.path.splitext(os.path.basename(sorted(fns)[0]))[0]
     timers = StageTimers()
 
