@@ -165,7 +165,10 @@ def test_downloader_retry_and_terminal(tracker, tmp_path):
                       numretries=2)
     for _ in range(30):
         d.run()
-        time.sleep(0.05)
+        # deterministic under load: wait for the download threads'
+        # DB writes instead of racing them with a fixed sleep
+        for th in list(d._threads.values()):
+            th.join(timeout=10)
         if tracker.count("files", "terminal_failure"):
             break
     assert tracker.count("files", "terminal_failure") == 1

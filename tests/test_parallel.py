@@ -112,3 +112,36 @@ def test_dist_fft_tone_bin():
     x = np.exp(2j * np.pi * 333 * t / N).astype(np.complex64)
     got = dist_fft.dist_fft_natural(x, m, axis_name="dm")
     assert np.argmax(np.abs(got)) == 333
+
+
+def test_seq_dedisperse_matches_single_device():
+    """Time-sharded dedispersion with ring halo exchange must equal
+    the single-device gather formulation exactly."""
+    import jax.numpy as jnp
+    from tpulsar.kernels.dedisperse import _dedisperse_subbands_xla
+    from tpulsar.parallel.mesh import make_mesh
+    from tpulsar.parallel.seq_dedisperse import seq_dedisperse
+
+    rng = np.random.default_rng(17)
+    nsub, T, ndms = 8, 4096, 6
+    subb = rng.standard_normal((nsub, T)).astype(np.float32)
+    shifts = rng.integers(0, 300, size=(ndms, nsub)).astype(np.int32)
+    shifts[0] = 0
+    mesh = make_mesh(n_beam=1, n_dm=8)
+
+    want = np.asarray(_dedisperse_subbands_xla(jnp.asarray(subb),
+                                               jnp.asarray(shifts)))
+    got = np.asarray(seq_dedisperse(jnp.asarray(subb), shifts, mesh))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-5)
+
+
+def test_seq_dedisperse_rejects_oversized_halo():
+    from tpulsar.parallel.mesh import make_mesh
+    from tpulsar.parallel.seq_dedisperse import seq_dedisperse
+    import jax.numpy as jnp
+
+    mesh = make_mesh(n_beam=1, n_dm=8)
+    subb = jnp.zeros((4, 1024), jnp.float32)
+    shifts = np.full((2, 4), 200, np.int32)   # chunk = 128 < 200
+    with pytest.raises(ValueError, match="halo"):
+        seq_dedisperse(subb, shifts, mesh)

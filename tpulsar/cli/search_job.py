@@ -80,19 +80,20 @@ def choose_zaplist(fns: list[str], zapdir: str | None,
                 zapdir, f"{gd['projid']}.{gd['date']}.all.zaplist"))
     if default:
         candidates.append(default)
-    if default and not os.path.exists(default):
-        # a configured-but-missing default is an operator error; do
-        # not silently search with the wrong birdie list
+    for c in candidates:
+        if c and os.path.exists(c):
+            return parse_zaplist(c)
+    if default:
+        # no custom list matched and the configured default is
+        # missing: operator error — do not silently search with the
+        # packaged birdie list instead
         raise SystemExit(f"configured default zaplist missing: {default}")
     # packaged default birdie list as the last resort (the reference
     # ships lib/zaplists/PALFA.zaplist as its default)
     import tpulsar
-    candidates.append(os.path.join(os.path.dirname(tpulsar.__file__),
-                                   "data", "default.zaplist"))
-    for c in candidates:
-        if c and os.path.exists(c):
-            return parse_zaplist(c)
-    return None
+    packaged = os.path.join(os.path.dirname(tpulsar.__file__),
+                            "data", "default.zaplist")
+    return parse_zaplist(packaged) if os.path.exists(packaged) else None
 
 
 def _keep_stderr_clean() -> None:

@@ -13,6 +13,7 @@ so retried uploads use the original version (:48-70).
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import json
 import os
@@ -68,6 +69,22 @@ def get_version_number(resultsdir: str) -> str:
     return ver
 
 
+#: per-category accumulated upload times, printed after each upload
+#: under the 'upload' debug flag (reference upload_timing_summary,
+#: JobUploader.py:88-90,105-129,208-215)
+upload_timing_summary: dict[str, float] = {}
+
+
+@contextlib.contextmanager
+def _timed(category: str):
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        upload_timing_summary[category] = (
+            upload_timing_summary.get(category, 0.0) + time.time() - t0)
+
+
 class JobUploader:
     def __init__(self, tracker: JobTracker, db_url: str | None = None,
                  notify=None, delete_raw_on_upload: bool = False,
@@ -86,6 +103,11 @@ class JobUploader:
         for row in rows:
             self.upload_results(row["sid"], row["job_id"],
                                 row["output_dir"])
+        from tpulsar.obs import debugflags
+        if rows and debugflags.is_on("upload"):
+            print("Upload timing summary:")
+            for cat, secs in sorted(upload_timing_summary.items()):
+                print(f"    {cat}: {secs:.2f} s")
 
     # -------------------------------------------------------------- parse
 
@@ -140,8 +162,10 @@ class JobUploader:
                        resultsdir: str) -> None:
         """One-beam upload with the reference's rollback taxonomy
         (JobUploader.py:73-206)."""
+        t_start = time.time()
         try:
-            header, diags = self.parse_results(resultsdir)
+            with _timed("Parsing"):
+                header, diags = self.parse_results(resultsdir)
         except UploadError as e:
             self.t.update("job_submits", submit_id, status="upload_failed",
                           details=str(e)[:4000])
@@ -153,11 +177,16 @@ class JobUploader:
         db = None
         try:
             db = ResultsDB(self.db_url)
-            header.upload(db)
-            for d in diags:
-                d.header_id = header.header_id
-                d.upload(db)
+            with _timed("Header (incl. candidates + SP)"):
+                header.upload(db)
+            with _timed("Diagnostics"):
+                for d in diags:
+                    d.header_id = header.header_id
+                    d.upload(db)
             db.commit()
+            upload_timing_summary["End-to-end"] = (
+                upload_timing_summary.get("End-to-end", 0.0)
+                + time.time() - t_start)
         except (DatabaseConnectionError, DatabaseDeadlockError) as e:
             if db:
                 db.rollback()
