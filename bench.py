@@ -14,18 +14,31 @@ The reference's implicit baseline is hours per beam on one CPU core
 60 s (BASELINE.md).  vs_baseline = target_seconds / measured_seconds
 (>1 means faster than target).
 
+Hang resistance (the TPU chip in this environment can wedge so hard
+that jax.devices() never returns): the parent process never imports
+jax.  It first health-probes the chip in a subprocess under a hard
+timeout, then runs the measured search in a second subprocess under a
+deadline, killing it if it stalls.  Per-pass progress goes to stderr
+and to `bench_partial.jsonl`, so even a killed run leaves evidence.
+The parent ALWAYS prints exactly one JSON line on stdout.
+
 Environment knobs:
-  TPULSAR_BENCH_SCALE   fraction of the full beam length (default 1.0)
-  TPULSAR_BENCH_ACCEL   "0" to skip the zmax>0 acceleration stage
-  TPULSAR_BENCH_DTYPE   device block dtype: uint8 (default) | bfloat16
-  TPULSAR_BENCH_NBEAMS  search N beams back-to-back (default 1): the
-                        first beam pays all compiles, the rest measure
-                        the amortized steady-state rate (BASELINE
-                        config 5, the 8-beam batch)
+  TPULSAR_BENCH_SCALE     fraction of the full beam length (default 1.0)
+  TPULSAR_BENCH_ACCEL     "0" to skip the zmax>0 acceleration stage
+  TPULSAR_BENCH_DTYPE     device block dtype: uint8 (default) | bfloat16
+  TPULSAR_BENCH_NBEAMS    search N beams back-to-back (default 1): the
+                          first beam pays all compiles, the rest measure
+                          the amortized steady-state rate (BASELINE
+                          config 5, the 8-beam batch)
+  TPULSAR_BENCH_PROBE_TIMEOUT  health-probe timeout, s (default 180)
+  TPULSAR_BENCH_DEADLINE  measured-run hard deadline, s (default 900)
+  TPULSAR_BENCH_CPU_FALLBACK   "0" to skip the reduced-scale CPU run
+                          when the TPU is unhealthy (default on)
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -33,9 +46,6 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(_REPO, ".jax_cache"))
 sys.path.insert(0, _REPO)
-
-import numpy as np  # noqa: E402
-
 
 TARGET_SECONDS = 60.0   # BASELINE.json north-star target (v5e-4)
 
@@ -47,33 +57,111 @@ FCTR, BW = 1375.5, 322.617
 
 P_TRUE, DM_TRUE = 0.012345, 250.0
 
+PARTIAL_PATH = os.path.join(_REPO, "bench_partial.jsonl")
 
-def make_block(nsamp: int, seed: int = 42) -> np.ndarray:
-    """(nchan, nsamp) uint8 beam: noise + one injected pulsar.
 
-    Generated channel-chunked so host memory stays ~O(chunk)."""
+def _log(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------- child: probe
+
+_PROBE_SRC = r"""
+import json, os, sys, time
+t0 = time.time()
+import jax
+# sitecustomize registers the axon TPU backend at interpreter start,
+# which beats the JAX_PLATFORMS env var — re-apply through the config.
+want = os.environ.get("JAX_PLATFORMS", "").strip()
+if want:
+    jax.config.update("jax_platforms", want)
+devs = jax.devices()
+t_dev = time.time() - t0
+import jax.numpy as jnp
+t1 = time.time()
+y = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
+t_mm = time.time() - t1
+print(json.dumps({
+    "ok": True, "platform": devs[0].platform, "ndev": len(devs),
+    "device": str(devs[0]),
+    "devices_s": round(t_dev, 1), "matmul_s": round(t_mm, 1)}))
+"""
+
+
+def probe_device(timeout: float, force_cpu: bool = False) -> dict | None:
+    """Run jax.devices() + a tiny matmul in a subprocess under a hard
+    timeout.  Returns the probe record, or None if the chip is wedged
+    (hang, crash, or nonsense output)."""
+    env = dict(os.environ)
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], env=env,
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        _log(f"probe rc={out.returncode}: {out.stderr.strip()[-300:]}")
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if rec.get("ok"):
+                return rec
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+# ---------------------------------------------------------- child: measured run
+
+def make_block_device(nsamp: int, seed: int = 42, chan_chunk: int = 120):
+    """(NCHAN, nsamp) uint8 beam on device: noise + one injected
+    pulsar.  Generated on-accelerator in float32 channel chunks so the
+    host never materializes multi-GB float64 noise (round-1 weakness:
+    the old NumPy path burned minutes of untimed wall-clock)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
     from tpulsar.constants import dispersion_delay_s
 
-    rng = np.random.default_rng(seed)
-    out = np.empty((NCHAN, nsamp), dtype=np.uint8)
     freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
-    delays = dispersion_delay_s(DM_TRUE, freqs, freqs[-1])
-    t = np.arange(nsamp) * TSAMP
-    for c0 in range(0, NCHAN, 64):
-        c1 = min(NCHAN, c0 + 64)
-        noise = rng.normal(8.0, 2.0, size=(c1 - c0, nsamp))
-        for c in range(c0, c1):
-            phase = ((t - delays[c]) / P_TRUE) % 1.0
-            dph = np.minimum(phase, 1 - phase)
-            noise[c - c0] += 1.0 * np.exp(-0.5 * (dph / 0.02) ** 2)
-        out[c0:c1] = np.clip(np.round(noise), 0, 15).astype(np.uint8)
-    return out
+    delays = dispersion_delay_s(DM_TRUE, freqs, freqs[-1]).astype(np.float32)
+
+    @partial(jax.jit, static_argnames=("n", "nc"))
+    def gen(key, delay_chunk, n, nc):
+        t = jnp.arange(n, dtype=jnp.float32) * TSAMP
+        noise = 8.0 + 2.0 * jax.random.normal(key, (nc, n), jnp.float32)
+        phase = ((t[None, :] - delay_chunk[:, None]) / P_TRUE) % 1.0
+        dph = jnp.minimum(phase, 1.0 - phase)
+        x = noise + jnp.exp(-0.5 * (dph / 0.02) ** 2)
+        return jnp.clip(jnp.round(x), 0, 15).astype(jnp.uint8)
+
+    key = jax.random.PRNGKey(seed)
+    parts = []
+    for c0 in range(0, NCHAN, chan_chunk):
+        nc = min(chan_chunk, NCHAN - c0)
+        key, sub = jax.random.split(key)
+        parts.append(gen(sub, jnp.asarray(delays[c0:c0 + nc]), nsamp, nc))
+    return jnp.concatenate(parts, axis=0)
 
 
-def main() -> None:
+def run_measured() -> None:
+    """The measured search (runs inside the deadline-guarded child).
+    Prints progress to stderr, appends per-pass records to
+    bench_partial.jsonl, and prints the result JSON to stdout."""
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
+    # sitecustomize's axon registration beats the env var; re-apply.
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if want:
+        jax.config.update("jax_platforms", want)
     try:
         jax.config.update("jax_compilation_cache_dir",
                           os.environ["JAX_COMPILATION_CACHE_DIR"])
@@ -101,23 +189,46 @@ def main() -> None:
     params = executor.SearchParams(run_hi_accel=run_accel,
                                    max_cands_to_fold=20)
     dev_dtype = jnp.uint8 if dtype == "uint8" else jnp.bfloat16
+    npasses = sum(s.numpasses for s in plan)
+
+    with open(PARTIAL_PATH, "w") as fh:
+        fh.write(json.dumps({"event": "start", "nsamp": nsamp,
+                             "npasses": npasses, "nbeams": nbeams,
+                             "backend": jax.default_backend(),
+                             "t": time.time()}) + "\n")
 
     per_beam_s = []
     found = False
     for b in range(nbeams):
-        block = make_block(nsamp, seed=42 + b)
-        data = jnp.asarray(block).astype(dev_dtype)
+        _log(f"beam {b}: generating {NCHAN}x{nsamp} block on device")
+        t_gen = time.time()
+        data = make_block_device(nsamp, seed=42 + b).astype(dev_dtype)
         data.block_until_ready()
-        del block
+        _log(f"beam {b}: block ready in {time.time()-t_gen:.1f} s")
 
         t0 = time.time()
         mask = rfi_k.find_rfi(data.T, TSAMP, block_len=2048)
         data = rfi_k.apply_mask(data.T, jnp.asarray(mask.full_mask()),
                                 2048).T
         data.block_until_ready()
+        _log(f"beam {b}: rfifind done at +{time.time()-t0:.1f} s")
+
+        def progress(rec, _b=b, _t0=t0):
+            rec = dict(rec, beam=_b, elapsed_s=round(time.time() - _t0, 2),
+                       t=time.time())
+            with open(PARTIAL_PATH, "a") as fh:
+                fh.write(json.dumps(rec) + "\n")
+            _log(f"beam {_b}: pass {rec.get('pass_idx', '?')}/"
+                 f"{rec.get('npasses', npasses)} "
+                 f"(step {rec.get('step_idx', '?')}, "
+                 f"{rec.get('ntrials_done', '?')} trials) "
+                 f"+{rec['elapsed_s']} s")
+
         cands, folded, sp_events, ntrials = executor.search_block(
-            data, freqs, TSAMP, plan, params)
+            data, freqs, TSAMP, plan, params, progress_cb=progress)
         per_beam_s.append(time.time() - t0)
+        _log(f"beam {b}: search done in {per_beam_s[-1]:.1f} s, "
+             f"{len(cands)} candidates")
 
         if b == 0:
             found = any(
@@ -146,7 +257,177 @@ def main() -> None:
         result["nbeams"] = nbeams
         result["steady_state_beam_s"] = round(steady, 2)
         result["beams_per_hour"] = round(3600.0 / steady, 1)
-    print(json.dumps(result))
+    with open(PARTIAL_PATH, "a") as fh:
+        fh.write(json.dumps({"event": "done", **result}) + "\n")
+    print(json.dumps(result), flush=True)
+
+
+# ----------------------------------------------------------------- parent
+
+def _read_partial() -> dict:
+    """Summarize bench_partial.jsonl for a timed-out/killed run.
+    Parsed line-by-line: a SIGKILL mid-append truncates the final line
+    and must not discard the evidence before it."""
+    info: dict = {}
+    lines = []
+    try:
+        with open(PARTIAL_PATH) as fh:
+            for ln in fh:
+                try:
+                    lines.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return info
+    passes = [r for r in lines if "pass_idx" in r]
+    if passes:
+        last = passes[-1]
+        info["passes_done"] = len(passes)
+        info["npasses"] = last.get("npasses")
+        info["ntrials_done"] = last.get("ntrials_done")
+        info["last_pass_elapsed_s"] = last.get("elapsed_s")
+        stage_s = last.get("stage_s")
+        if stage_s:
+            info["stage_s"] = stage_s
+    return info
+
+
+def run_child(deadline: float, extra_env: dict | None = None
+              ) -> tuple[str, dict | None]:
+    """Run the measured search in a subprocess under `deadline`.
+    Returns (status, result): ("ok", json) on success, ("timeout",
+    None) if killed at the deadline, ("crash", None) on nonzero exit
+    or unparseable output — the distinction matters for the evidence
+    record (a 10 s ImportError is not a deadline overrun)."""
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--measured"],
+        env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+    try:
+        out, _ = proc.communicate(timeout=deadline)
+    except subprocess.TimeoutExpired:
+        _log(f"measured run exceeded deadline {deadline:.0f} s — killing")
+        proc.kill()
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+        return "timeout", None
+    if proc.returncode != 0:
+        _log(f"measured run failed rc={proc.returncode}")
+        return "crash", None
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            return "ok", json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return "crash", None
+
+
+def main() -> None:
+    if "--measured" in sys.argv:
+        run_measured()
+        return
+    if "--probe" in sys.argv:
+        rec = probe_device(
+            float(os.environ.get("TPULSAR_BENCH_PROBE_TIMEOUT", "180")))
+        print(json.dumps(rec if rec else {"ok": False}))
+        return
+
+    probe_timeout = float(os.environ.get("TPULSAR_BENCH_PROBE_TIMEOUT",
+                                         "180"))
+    deadline = float(os.environ.get("TPULSAR_BENCH_DEADLINE", "900"))
+
+    result: dict | None = None
+    t_start = time.time()
+    try:
+        _log(f"health-probing accelerator (timeout {probe_timeout:.0f} s)")
+        probe = probe_device(probe_timeout)
+        want_cpu = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+        if probe is not None and not want_cpu \
+                and probe.get("platform") == "cpu":
+            # The TPU plugin failed to register and jax silently fell
+            # back to CPU: running the full-scale search there would
+            # blow the deadline and be misreported as a timeout.
+            _log(f"probe came back on CPU, not TPU: {probe}")
+            probe = None
+        if probe is not None:
+            _log(f"probe OK: {probe}")
+            if probe.get("platform") not in (None, "cpu"):
+                # Pre-run the Pallas smoke probe from here, while no
+                # process holds the chip; on success the measured
+                # child reads the cached verdict instead of probing
+                # mid-run (device contention).
+                _log("pre-running Pallas smoke probe")
+                try:
+                    smoke = subprocess.run(
+                        [sys.executable, "-c",
+                         "import sys; sys.path.insert(0, %r); "
+                         "from tpulsar.kernels.pallas_dd import "
+                         "smoke_test_ok; print(smoke_test_ok())"
+                         % _REPO],
+                        capture_output=True, text=True,
+                        timeout=probe_timeout + 330)
+                    _log(f"Pallas smoke: {smoke.stdout.strip()[-40:]}")
+                except (subprocess.TimeoutExpired, OSError):
+                    _log("Pallas smoke probe hung (kernel will use "
+                         "XLA fallback via signature disable)")
+            status, result = run_child(deadline)
+            if result is None:
+                partial = _read_partial()
+                elapsed = round(time.time() - t_start, 2)
+                err = (f"timed_out_after_{deadline:.0f}s"
+                       if status == "timeout" else "measured_run_crashed")
+                result = {
+                    "metric": "mock_beam_full_plan_search_wallclock",
+                    "value": elapsed if status == "timeout" else -1.0,
+                    "unit": "s",
+                    "vs_baseline": 0.0,
+                    "error": err,
+                    "probe": probe, **partial,
+                }
+        else:
+            _log("accelerator UNHEALTHY (probe hung/crashed/fell back "
+                 "to CPU)")
+            result = {
+                "metric": "mock_beam_full_plan_search_wallclock",
+                "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+                "error": "tpu_unhealthy",
+                "probe": f"TPU jax.devices()+matmul did not complete in "
+                         f"{probe_timeout:.0f} s (or fell back to CPU)",
+            }
+            if os.environ.get("TPULSAR_BENCH_CPU_FALLBACK", "1") != "0":
+                _log("running reduced-scale CPU fallback for evidence")
+                cpu_probe = probe_device(probe_timeout, force_cpu=True)
+                if cpu_probe is not None:
+                    _, fb = run_child(
+                        min(deadline, 600.0),
+                        extra_env={
+                            "JAX_PLATFORMS": "cpu",
+                            "TPULSAR_BENCH_SCALE":
+                                os.environ.get(
+                                    "TPULSAR_BENCH_CPU_SCALE", "0.0833"),
+                            "TPULSAR_BENCH_ACCEL": "0",
+                        })
+                    if fb is not None:
+                        result["cpu_fallback"] = {
+                            "value_s": fb["value"],
+                            "scale": float(os.environ.get(
+                                "TPULSAR_BENCH_CPU_SCALE", "0.0833")),
+                            "accel_stage": False,
+                            "dm_trials": fb.get("dm_trials"),
+                            "injected_pulsar_recovered":
+                                fb.get("injected_pulsar_recovered"),
+                        }
+    except Exception as e:  # the one JSON line must still appear
+        result = {
+            "metric": "mock_beam_full_plan_search_wallclock",
+            "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+            "error": f"bench_harness_error: {type(e).__name__}: {e}",
+        }
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

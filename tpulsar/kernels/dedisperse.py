@@ -101,7 +101,8 @@ def dedisperse_subbands(subbands: jnp.ndarray,
     """
     from tpulsar.kernels import pallas_dd
 
-    if pallas_dd.use_pallas():
+    sig = (tuple(subbands.shape), tuple(np.asarray(sub_shifts).shape))
+    if pallas_dd.use_pallas() and pallas_dd.signature_enabled(sig):
         try:
             out = pallas_dd.dedisperse_subbands_pallas(subbands,
                                                        sub_shifts)
@@ -111,7 +112,9 @@ def dedisperse_subbands(subbands: jnp.ndarray,
             jax.block_until_ready(out)
             return out
         except Exception as e:   # Mosaic unsupported on this runtime
-            pallas_dd.disable_pallas(reason=str(e)[:200])
+            if pallas_dd.forced():
+                raise      # TPULSAR_PALLAS=1 = no-fallback (CI mode)
+            pallas_dd.disable_signature(sig, reason=str(e)[:200])
     return _dedisperse_subbands_xla(subbands, sub_shifts)
 
 
