@@ -77,3 +77,105 @@ def test_candlist_roundtrip(tmp_path):
     assert back[1].numharm == 4
     assert abs(back[1].z - 12.0) < 0.01
     assert abs(back[1].period_s - cands[1].period_s) < 1e-9
+
+
+def test_sift_scales_to_1e6_candidates():
+    """Round-1 verdict weakness #5: the survey plan feeds sifting
+    ~10^5-10^6 raw candidates; the chain must be far from O(n^2)."""
+    import time
+
+    rng = np.random.default_rng(7)
+    n = 1_000_000
+    T_s = 100.0
+    # clustered r values (heavy duplicate load) + uniform background
+    r = np.where(rng.random(n) < 0.5,
+                 rng.choice(np.linspace(100, 5e5, 2000), size=n)
+                 + rng.normal(0, 0.3, n),
+                 rng.uniform(10, 1e6, n))
+    sigma = rng.uniform(4.0, 12.0, n)
+    dm = rng.choice(np.arange(0, 1000, 2.0), size=n)
+    cands = [sifting.Candidate(
+        r=float(ri), z=0.0, sigma=float(si), power=float(si**2),
+        numharm=1, dm=float(di), period_s=T_s / ri, freq_hz=ri / T_s)
+        for ri, si, di in zip(r, sigma, dm)]
+    t0 = time.time()
+    out = sifting.sift(cands, sifting.SiftParams())
+    elapsed = time.time() - t0
+    assert elapsed < 30.0, f"sift of 1e6 candidates took {elapsed:.1f}s"
+    assert 0 < len(out) < n
+
+
+def test_duplicate_removal_bucket_matches_bruteforce():
+    """The grid-bucket dedup must agree with the direct O(n^2) scan."""
+    rng = np.random.default_rng(3)
+    n = 400
+    cands = []
+    for _ in range(n):
+        r = float(rng.choice([100.0, 100.5, 101.4, 250.0, 251.2])
+                  + rng.normal(0, 0.2))
+        s = float(rng.uniform(4, 10))
+        cands.append(_cand(r, s, float(rng.uniform(0, 100))))
+    params = sifting.SiftParams()
+
+    def brute(cs):
+        cs = sorted(cs, key=lambda c: -c.sigma)
+        kept = []
+        for c in cs:
+            for k, hits in kept:
+                if abs(c.r - k.r) < params.r_err and abs(c.z - k.z) <= 2.0:
+                    hits.append((c.dm, c.sigma))
+                    break
+            else:
+                kept.append((c, [(c.dm, c.sigma)]))
+        return kept
+
+    import copy
+    want = brute(copy.deepcopy(cands))
+    got = sifting.remove_duplicates(copy.deepcopy(cands), params)
+    assert len(got) == len(want)
+    assert sorted(c.r for c in got) == sorted(c.r for c, _ in want)
+    assert sorted(len(c.dm_hits) for c in got) == \
+        sorted(len(h) for _, h in want)
+
+
+def test_harmonic_rejection_matches_bruteforce():
+    """The fraction-window harmonic filter must agree with the direct
+    all-pairs ratio scan."""
+    rng = np.random.default_rng(5)
+    params = sifting.SiftParams()
+    cands = []
+    base = rng.uniform(10, 50, 8)
+    for f0 in base:
+        for mult in (1.0, 2.0, 3.0, 0.5, 1.5):
+            f = f0 * mult * (1 + rng.normal(0, 2e-4))
+            cands.append(sifting.Candidate(
+                r=f * 100.0, z=0.0, sigma=float(rng.uniform(4, 12)),
+                power=25.0, numharm=1, dm=50.0, period_s=1 / f,
+                freq_hz=f))
+
+    def brute(cs):
+        cs = sorted(cs, key=lambda c: -c.sigma)
+        kept = []
+        for c in cs:
+            is_harm = False
+            for k in kept:
+                ratio = c.freq_hz / k.freq_hz
+                for b in range(1, params.max_harm + 1):
+                    a = ratio * b
+                    ar = round(a)
+                    if ar < 1 or ar > params.max_harm:
+                        continue
+                    if abs(a - ar) / b < params.harm_frac_tol * max(1.0, ratio):
+                        is_harm = True
+                        break
+                if is_harm:
+                    break
+            if not is_harm:
+                kept.append(c)
+        return kept
+
+    import copy
+    want = {round(c.freq_hz, 9) for c in brute(copy.deepcopy(cands))}
+    got = {round(c.freq_hz, 9)
+           for c in sifting.remove_harmonics(copy.deepcopy(cands), params)}
+    assert got == want

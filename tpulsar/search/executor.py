@@ -312,7 +312,8 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                         max_numharm=params.lo_accel_numharm,
                         topk=params.topk_per_stage)
                     all_cands.extend(sifting.make_candidates(
-                        res, dm_chunk, T_s, fr.sigma_from_power))
+                        res, dm_chunk, T_s, fr.sigma_from_power,
+                        sigma_min=params.sifting.sigma_threshold))
 
                 if params.run_hi_accel and params.hi_accel_zmax > 0:
                     with timers.timing("hi-accelsearch"):
@@ -453,20 +454,12 @@ def _hi_accel_pass(series, dm_chunk, T_s, params: SearchParams
         spec_all, bank, max_numharm=params.hi_accel_numharm,
         topk=params.topk_per_stage)
 
-    out: list[sifting.Candidate] = []
-    dms = np.atleast_1d(dm_chunk)
-    for numharm, (vals, rbins, zvals) in res.items():
-        sig = fr.sigma_from_power(vals, numharm)
-        for i, dm in enumerate(dms):
-            for v, r, z, s in zip(vals[i], rbins[i], zvals[i], sig[i]):
-                if r < 1 or v <= 0 or abs(z) < accel_k.DZ / 2:
-                    continue  # z~0 already covered by the lo search
-                f = r / T_s
-                out.append(sifting.Candidate(
-                    r=float(r), z=float(z), sigma=float(s),
-                    power=float(v), numharm=int(numharm), dm=float(dm),
-                    period_s=1.0 / f, freq_hz=f))
-    return out
+    # z~0 rows are the lo search's job (z_min_abs); sub-threshold rows
+    # never become Python objects (sigma_min pre-filter).
+    return sifting.make_candidates(
+        res, dm_chunk, T_s, fr.sigma_from_power,
+        sigma_min=params.sifting.sigma_threshold,
+        z_min_abs=accel_k.DZ / 2)
 
 
 _BANK_CACHE: dict[int, accel_k.TemplateBank] = {}
