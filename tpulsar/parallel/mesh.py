@@ -57,8 +57,8 @@ def _local_search(subbands, sub_shifts, keep_mask, spec: SearchStepSpec):
     """Per-device body: dedisperse local DM chunk -> rfft -> whiten ->
     harmonic top-k.  Returns dict of stage -> (vals, bins)."""
     from tpulsar.kernels.dedisperse import _shift_gather
-    from tpulsar.kernels.fourier import (harmonic_stages, harmonic_sum,
-                                         whiten_powers)
+    from tpulsar.kernels.fourier import (blockmax_topk, harmonic_stages,
+                                         harmonic_sum, whiten_powers)
 
     def one_dm(shifts):
         return _shift_gather(subbands, shifts).sum(axis=0)
@@ -80,11 +80,8 @@ def _local_search(subbands, sub_shifts, keep_mask, spec: SearchStepSpec):
     out = {}
     for h in harmonic_stages(spec.max_numharm):
         summed = harmonic_sum(powers, h)
-        left = jnp.pad(summed[:, :-1], ((0, 0), (1, 0)))
-        right = jnp.pad(summed[:, 1:], ((0, 0), (0, 1)))
-        peaks = jnp.where((summed >= left) & (summed > right), summed, 0.0)
-        vals, bins = jax.lax.top_k(peaks, min(spec.topk, peaks.shape[-1]))
-        out[h] = (vals, bins)
+        # same hierarchical top-k as the single-device stage_candidates
+        out[h] = blockmax_topk(summed, spec.topk)
     return out
 
 

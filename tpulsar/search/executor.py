@@ -306,19 +306,33 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                     nbins = series.shape[1] // 2 + 1
                     keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
                         if zaplist is not None else None
+                    # One rfft + one whitening estimate per chunk,
+                    # shared by the lo (powers) and hi (complex
+                    # spectrum) stages.
+                    spec = fr.complex_spectrum(series)
+                    powers, wpow = fr.whitened_powers(
+                        spec,
+                        jnp.asarray(keep) if keep is not None else None)
                 with timers.timing("lo-accelsearch"):
-                    res, _ = fr.periodicity_search(
-                        series, T_s, keep_mask=keep,
-                        max_numharm=params.lo_accel_numharm,
-                        topk=params.topk_per_stage)
+                    res = {
+                        h: fr.stage_candidates(wpow, h,
+                                               params.topk_per_stage)
+                        for h in fr.harmonic_stages(
+                            params.lo_accel_numharm)}
                     all_cands.extend(sifting.make_candidates(
                         res, dm_chunk, T_s, fr.sigma_from_power,
                         sigma_min=params.sifting.sigma_threshold))
 
                 if params.run_hi_accel and params.hi_accel_zmax > 0:
                     with timers.timing("hi-accelsearch"):
+                        # Whitening scale from the already-computed
+                        # powers; zapped bins have wpow==0 so they
+                        # vanish from the correlation input too.
+                        wspec = fr.scale_spectrum(spec, powers, wpow)
                         all_cands.extend(_hi_accel_pass(
-                            series, dm_chunk, T_s, params))
+                            wspec, dm_chunk, T_s, params))
+                        del wspec
+                del spec, powers, wpow
             del subb
             if checkpoint_dir:
                 _save_pass_checkpoint(
@@ -443,15 +457,14 @@ def _dedisperse_single(data, freqs, nsub, dm, dt):
         subb, jnp.asarray(sub_shifts)))[0]
 
 
-def _hi_accel_pass(series, dm_chunk, T_s, params: SearchParams
+def _hi_accel_pass(wspec, dm_chunk, T_s, params: SearchParams
                    ) -> list[sifting.Candidate]:
-    """accelsearch zmax>0 over a DM chunk (device-batched)."""
+    """accelsearch zmax>0 over a DM chunk of already-whitened complex
+    spectra (device-batched; the spectrum is shared with the lo
+    stage)."""
     bank = _get_bank(params.hi_accel_zmax)
-    spec_all = jnp.fft.rfft(series - series.mean(axis=-1, keepdims=True),
-                            axis=-1)
-    spec_all = accel_k.normalize_spectrum(spec_all)
     res = accel_k.accel_search_batch(
-        spec_all, bank, max_numharm=params.hi_accel_numharm,
+        wspec, bank, max_numharm=params.hi_accel_numharm,
         topk=params.topk_per_stage)
 
     # z~0 rows are the lo search's job (z_min_abs); sub-threshold rows
