@@ -76,3 +76,47 @@ def test_drifting_tone_recovered_at_correct_z():
     r_idx = int(round(true_r))
     zi_best = int(np.argmin(np.abs(np.asarray(bank.zs) - z_true)))
     assert plane[zi_best, r_idx] > 2.0 * plane[zi0, r_idx]
+
+
+def test_batch_matches_per_dm_path():
+    """The rank-2-flattened batched path (_accel_block_topk) and the
+    proven per-DM path (_accel_plane_topk) must agree exactly: same
+    correlation, different FFT batching (the axon TPU runtime rejects
+    some batched FFT shapes, so production may run either)."""
+    rng = np.random.default_rng(7)
+    nbins = 6000
+    specs = (rng.normal(size=(3, nbins))
+             + 1j * rng.normal(size=(3, nbins))).astype(np.complex64)
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    nz = len(bank.zs)
+    bf = jnp.asarray(bank.bank_fft)
+    bv, br, bz = accel._accel_block_topk(
+        jnp.asarray(specs), bf, bank.seg, bank.step, bank.width, nz, 2, 8)
+    for i in range(3):
+        sv, sr, sz = accel._accel_plane_topk(
+            specs[i], bf, bank.seg, bank.step, bank.width, nz, 2, 8)
+        np.testing.assert_allclose(np.asarray(bv[i]), np.asarray(sv),
+                                   rtol=2e-4)
+        np.testing.assert_array_equal(np.asarray(br[i]), np.asarray(sr))
+        np.testing.assert_array_equal(np.asarray(bz[i]), np.asarray(sz))
+
+
+def test_forced_fallback_matches_batch(monkeypatch):
+    """accel_search_batch with TPULSAR_ACCEL_BATCH=0 (per-DM fallback)
+    returns the same candidates as the batched path."""
+    rng = np.random.default_rng(11)
+    nbins = 5000
+    specs = jnp.asarray((rng.normal(size=(2, nbins))
+                         + 1j * rng.normal(size=(2, nbins))
+                         ).astype(np.complex64))
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+
+    monkeypatch.setattr(accel, "_BATCH_OK", True)
+    batched = accel.accel_search_batch(specs, bank, max_numharm=2, topk=8)
+    monkeypatch.setattr(accel, "_BATCH_OK", False)
+    fallback = accel.accel_search_batch(specs, bank, max_numharm=2, topk=8)
+    monkeypatch.setattr(accel, "_BATCH_OK", None)
+    for h in batched:
+        np.testing.assert_allclose(batched[h][0], fallback[h][0], rtol=2e-4)
+        np.testing.assert_array_equal(batched[h][1], fallback[h][1])
+        np.testing.assert_array_equal(batched[h][2], fallback[h][2])

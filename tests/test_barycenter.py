@@ -1,0 +1,102 @@
+"""Physics checks of the analytic barycentric-velocity ephemeris.
+
+No TEMPO/astropy in the image, so correctness is established through
+tight physical invariants of the Earth's motion rather than a golden
+ephemeris value: amplitude bounds from the known orbital speed
+(29.29-30.29 km/s), seasonal phase, the vanishing of the annual term
+toward the ecliptic pole, annual periodicity, and the diurnal term's
+amplitude from the known rotation speed at the site.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from tpulsar.astro import barycenter as bc
+
+MJD_2025_JUN_21 = 60847.5
+MJD_2025_DEC_21 = 61030.5
+C = bc.C_KM_S
+
+
+def test_magnitude_bounded_by_orbital_speed():
+    rng = np.random.default_rng(42)
+    for _ in range(200):
+        mjd = float(rng.uniform(50000, 62000))
+        ra = float(rng.uniform(0, 360))
+        dec = float(rng.uniform(-90, 90))
+        v = bc.baryv_at(mjd, ra, dec, obs="AO")
+        # max orbital 30.29 km/s + rotation 0.45 km/s
+        assert abs(v) < (30.29 + 0.46) / C
+
+
+def test_seasonal_phase_toward_vernal_equinox():
+    # Source at the vernal equinox point (RA=0, Dec=0).  Near the June
+    # solstice the Earth's velocity points almost straight at it
+    # (approaching => negative, PRESTO sign convention); near the
+    # December solstice straight away (receding => positive).
+    v_jun = bc.average_baryv(0.0, 0.0, MJD_2025_JUN_21, 600.0, obs="AO")
+    v_dec = bc.average_baryv(0.0, 0.0, MJD_2025_DEC_21, 600.0, obs="AO")
+    assert v_jun < -0.9e-4
+    assert v_dec > 0.9e-4
+
+
+def test_annual_term_vanishes_at_ecliptic_pole():
+    # North ecliptic pole: RA 18h, Dec +66.56 deg.  The orbital
+    # velocity lies in the ecliptic plane, so only the diurnal term
+    # (<1.5e-6) and small model errors project onto the line of sight.
+    for mjd in (55000.3, 58321.7, 60847.1):
+        v = bc.baryv_at(mjd, 270.0, 66.5607, obs="AO")
+        assert abs(v) < 3e-6
+
+
+def test_annual_periodicity():
+    # The orbital velocity repeats after one sidereal year to within
+    # the slow drift of the orbital elements (the diurnal term does
+    # not — a sidereal year is not a whole number of sidereal days).
+    for mjd in (58000.2, 60500.7):
+        v1 = bc.earth_orbital_velocity_kms(mjd)
+        v2 = bc.earth_orbital_velocity_kms(mjd + 365.25636)
+        assert float(np.linalg.norm(v1 - v2)) < 0.05  # km/s
+
+
+def test_diurnal_amplitude_matches_site_rotation():
+    # Equatorial source seen from Arecibo over one sidereal day: after
+    # removing the (nearly linear) annual drift, the residual is the
+    # diurnal sinusoid with amplitude omega*R*cos(lat)*cos(dec)/c.
+    sidereal_day_s = 86164.0905
+    t = np.linspace(0, sidereal_day_s / 86400.0, 200)
+    v = np.array([bc.baryv_at(58500.0 + ti, 80.0, 0.0, obs="AO")
+                  for ti in t])
+    trend = np.polynomial.polynomial.polyfit(t, v, 1)
+    resid = v - np.polynomial.polynomial.polyval(t, trend)
+    amp = (resid.max() - resid.min()) / 2.0
+    lat = math.radians(18.34417)
+    expected = bc.EARTH_OMEGA * 6378.0 * math.cos(lat) / C
+    assert amp == pytest.approx(expected, rel=0.25)
+
+
+def test_average_matches_midpoint_for_short_obs():
+    mjd, T = 56000.1, 600.0
+    avg = bc.average_baryv(143.2, 18.5, mjd, T, obs="AO")
+    mid = bc.baryv_at(mjd + T / 2.0 / 86400.0, 143.2, 18.5, obs="AO")
+    assert avg == pytest.approx(mid, abs=1e-9)
+
+
+def test_unknown_observatory_raises():
+    with pytest.raises(ValueError, match="unknown observatory"):
+        bc.baryv_at(56000.0, 0.0, 0.0, obs="not-a-scope")
+
+
+def test_perihelion_speed_bracket():
+    # Earth's orbital speed peaks near perihelion (early January) at
+    # ~30.29 km/s and bottoms near aphelion (early July) at ~29.29.
+    speeds = {mjd: float(np.linalg.norm(bc.earth_orbital_velocity_kms(mjd)))
+              for mjd in np.arange(60676.0, 60676.0 + 366.0, 1.0)}
+    vmax, vmin = max(speeds.values()), min(speeds.values())
+    assert vmax == pytest.approx(30.287, abs=0.03)
+    assert vmin == pytest.approx(29.291, abs=0.03)
+    peak_mjd = max(speeds, key=speeds.get)
+    # MJD 60676 = 2025-01-01; perihelion 2025 was Jan 4.
+    assert abs(peak_mjd - 60679.0) < 3.0

@@ -22,15 +22,27 @@ _CAND_RE = re.compile(
 _HIT_RE = re.compile(r"^\s+DM=\s*(?P<dm>[\d.]+)\s+sigma=\s*(?P<sigma>[\d.]+)")
 
 
-def write_candlist(cands: list[Candidate], path: str) -> None:
+def write_candlist(cands: list[Candidate], path: str,
+                   baryv: float = 0.0) -> None:
+    """Write the sifted candidate list.
+
+    baryv (v/c, positive receding) converts the internally topocentric
+    candidate frequencies to the barycentric frame for reporting,
+    f_bary = f_topo * (1 + baryv) — the frame PRESTO's .accelcands
+    carry because its time series are barycentred before the FFT
+    (the reference passes the same velocity to zapbirds,
+    PALFA2_presto_search.py:551-553).  r and z stay topocentric: they
+    record where in our spectra the detection actually is.
+    """
+    scale = 1.0 + baryv
     with open(path, "w") as fh:
         fh.write("#cand   sigma  numharm     power        DM"
                  "            r         z   period(ms)     freq(Hz)\n")
         for i, c in enumerate(cands, start=1):
             fh.write(f"{i:5d} {c.sigma:8.2f} {c.numharm:8d} "
                      f"{c.power:12.4f} {c.dm:9.2f} {c.r:12.2f} "
-                     f"{c.z:9.2f} {c.period_s * 1e3:12.6f} "
-                     f"{c.freq_hz:12.6f}\n")
+                     f"{c.z:9.2f} {c.period_s / scale * 1e3:12.6f} "
+                     f"{c.freq_hz * scale:12.6f}\n")
             for dm, sigma in sorted(c.dm_hits):
                 fh.write(f"    DM= {dm:7.2f} sigma= {sigma:6.2f}\n")
 

@@ -126,15 +126,24 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                 params: SearchParams | None = None,
                 zaplist: np.ndarray | None = None,
                 plan: list[ddplan.DedispStep] | None = None,
-                baryv: float = 0.0,
+                baryv: float | None = None,
                 checkpoint_dir: str | None = None) -> SearchOutcome:
-    """Search one beam end-to-end and write the results directory."""
+    """Search one beam end-to-end and write the results directory.
+
+    baryv: average barycentric velocity (v/c, positive receding) of
+    the observation.  None (default) computes it from the beam header
+    the way the reference does at obs_info time
+    (PALFA2_presto_search.py:43-57,269); pass 0.0 explicitly to
+    disable barycentric correction.
+    """
     params = params or SearchParams()
     os.makedirs(workdir, exist_ok=True)
     os.makedirs(resultsdir, exist_ok=True)
 
     obj = datafile.autogen_dataobj(fns)
     si = obj.specinfo
+    if baryv is None:
+        baryv = _compute_baryv(si)
     if si.T < params.low_T_to_search_s:
         raise TooShortToSearchError(
             f"observation is {si.T:.1f} s < low_T_to_search "
@@ -174,7 +183,8 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
 
     # ----------------------------------------------------------- artifacts
     accelcands.write_candlist(
-        final, os.path.join(resultsdir, f"{basenm}.accelcands"))
+        final, os.path.join(resultsdir, f"{basenm}.accelcands"),
+        baryv=baryv)
     _write_sp_files(resultsdir, basenm, sp_events)
     for step in plan:
         for ppass in step.passes():
@@ -205,7 +215,8 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                 t_obs=data.shape[1] * si.dt)
 
     _write_header_json(resultsdir, obj)
-    _write_search_params(resultsdir, params, basenm, si, num_trials)
+    _write_search_params(resultsdir, params, basenm, si, num_trials,
+                         baryv=baryv)
     timers.write_report(os.path.join(resultsdir, f"{basenm}.report"), basenm)
     _tar_result_classes(resultsdir, basenm)
 
@@ -441,6 +452,25 @@ def _load_pass_checkpoint(ckdir: str, pass_idx: int):
         return None      # corrupt checkpoint: redo the pass
 
 
+def _compute_baryv(si) -> float:
+    """Average barycentric velocity for the observation from the beam
+    header, like the reference's obs_info (PALFA2_presto_search.py:269).
+    Unknown telescopes get 0.0 (topocentric reporting) with a warning
+    rather than a failed search."""
+    from tpulsar.astro import barycenter
+    try:
+        return barycenter.average_baryv(
+            si.ra2000, si.dec2000, float(si.start_MJD[0]), float(si.T),
+            obs=si.telescope)
+    except ValueError:
+        import warnings
+        warnings.warn(
+            f"no observatory coordinates for telescope "
+            f"{si.telescope!r}; candidate frequencies will be "
+            f"topocentric (baryv=0)")
+        return 0.0
+
+
 def _largest_divisor_leq(n: int, k: int) -> int:
     for d in range(min(n, k), 0, -1):
         if n % d == 0:
@@ -544,7 +574,8 @@ def _write_header_json(resultsdir, obj) -> None:
         json.dump(hdr, fh, indent=1)
 
 
-def _write_search_params(resultsdir, params, basenm, si, num_trials) -> None:
+def _write_search_params(resultsdir, params, basenm, si, num_trials,
+                         baryv: float = 0.0) -> None:
     """Provenance dump, python-literal assignments like the reference's
     search_params.txt (PALFA2_presto_search.py:695-700)."""
     with open(os.path.join(resultsdir, "search_params.txt"), "w") as fh:
@@ -552,6 +583,7 @@ def _write_search_params(resultsdir, params, basenm, si, num_trials) -> None:
         fh.write(f"source = {si.source!r}\n")
         fh.write(f"backend = {si.backend!r}\n")
         fh.write(f"num_dm_trials = {num_trials}\n")
+        fh.write(f"baryv = {baryv!r}\n")
         for k, v in params.provenance().items():
             fh.write(f"{k} = {v!r}\n")
 
