@@ -21,6 +21,11 @@ import numpy as np
 
 DEFAULT_WIDTHS = (1, 2, 3, 4, 6, 9, 14, 20, 30)
 
+#: device-side top-k events kept per (width, DM) before host dedup —
+#: the single constant both the single-device and sharded paths use
+#: (they must agree for their event sets to be identical)
+DEFAULT_TOPK = 128
+
 #: structured dtype of single-pulse event records (shared by the
 #: executor's empty fallback and checkpoint round-trips)
 SP_EVENT_DTYPE = np.dtype([("dm", "f8"), ("sigma", "f8"),
@@ -49,7 +54,7 @@ def normalize_series(series: jnp.ndarray, detrend_block: int = 1000):
 @partial(jax.jit, static_argnames=("widths", "topk"))
 def boxcar_search(norm_series: jnp.ndarray,
                   widths: tuple[int, ...] = DEFAULT_WIDTHS,
-                  topk: int = 128):
+                  topk: int = DEFAULT_TOPK):
     """Matched-filter SNR for each boxcar width via cumsum differencing.
 
     norm_series: (ndms, T), zero-mean unit-variance.
@@ -81,7 +86,7 @@ def boxcar_search(norm_series: jnp.ndarray,
 def single_pulse_search(series: jnp.ndarray, dms: np.ndarray, dt: float,
                         threshold: float = 5.0,
                         widths: tuple[int, ...] = DEFAULT_WIDTHS,
-                        topk: int = 128) -> np.ndarray:
+                        topk: int = DEFAULT_TOPK) -> np.ndarray:
     """Full SP search of a DM-series block.
 
     Returns a structured array of events (dm, sigma, time_s, sample,
@@ -91,6 +96,18 @@ def single_pulse_search(series: jnp.ndarray, dms: np.ndarray, dt: float,
     """
     norm = normalize_series(series)
     snrs, idx = boxcar_search(norm, tuple(widths), topk)
+    return events_from_topk(snrs, idx, dms, dt, threshold, widths)
+
+
+def events_from_topk(snrs, idx, dms: np.ndarray, dt: float,
+                     threshold: float = 5.0,
+                     widths: tuple[int, ...] = DEFAULT_WIDTHS
+                     ) -> np.ndarray:
+    """Host half of the SP search: threshold + dedup the device top-k
+    output (snrs, idx) of shape (nwidths, ndms, k) into event records.
+    Shared by the single-device path and the sharded per-pass search
+    (which all_gathers the top-k blocks over the dm mesh axis first).
+    """
     snrs = np.asarray(snrs)                       # (nw, ndms, k)
     idx = np.asarray(idx).astype(np.int64)
     dms = np.atleast_1d(np.asarray(dms))

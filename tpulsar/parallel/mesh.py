@@ -125,6 +125,132 @@ def _stages(spec: SearchStepSpec):
     return harmonic_stages(spec.max_numharm)
 
 
+@dataclasses.dataclass(frozen=True)
+class PassSpec:
+    """Static configuration of the full sharded per-pass search (the
+    production pipeline: dedisperse -> SP boxcars -> whiten -> lo
+    harmonic stages -> hi z-template correlation)."""
+    max_numharm: int            # lo-accel harmonic stages
+    topk: int
+    sp_widths: tuple[int, ...]
+    sp_topk: int
+    hi: bool                    # run the accelerated (zmax>0) search
+    hi_numharm: int = 8
+    hi_seg: int = 0             # TemplateBank geometry (static)
+    hi_step: int = 0
+    hi_width: int = 0
+    hi_nz: int = 0
+    pallas_dd: bool = False     # stage-2 dedispersion via the Pallas
+    #                             sliding-window kernel (decided
+    #                             host-side with the same gate as the
+    #                             single-device path)
+    dd_stage_s: int = 0         # static staging overhang (>= max
+    #                             shift, power of 2) for the Pallas
+    #                             kernel's sliding window
+    dd_interpret: bool = False  # Pallas interpret mode (CPU testing)
+
+
+def _pallas_dd_local(subb, shifts, stage_s: int, interpret: bool,
+                     block_t: int = 2048, dm_chunk: int = 32):
+    """Per-shard stage-2 dedispersion via the Pallas sliding-window
+    kernel (tpulsar/kernels/pallas_dd.py) — same HBM-bandwidth win as
+    the single-device product path, expressed with static staging
+    geometry so it traces inside shard_map (the host wrapper
+    dedisperse_subbands_pallas inspects the shift table with NumPy,
+    which a traced shard cannot).  stage_s must be >= the max shift of
+    the FULL pass table (computed host-side once, shared by every
+    shard so all shards compile the same kernel)."""
+    from tpulsar.kernels.pallas_dd import _dedisperse_chunk
+
+    ndms_loc = shifts.shape[0]
+    T = subb.shape[-1]
+    window = block_t + stage_s
+    n_blocks = -(-T // block_t)
+    pad = n_blocks * block_t + stage_s - T
+    subbp = jnp.pad(subb.astype(jnp.float32), ((0, 0), (0, pad)),
+                    mode="edge")
+    rows = []
+    for c0 in range(0, ndms_loc, dm_chunk):
+        n = min(dm_chunk, ndms_loc - c0)
+        chunk = jax.lax.dynamic_slice_in_dim(shifts, c0, n, axis=0)
+        rows.append(_dedisperse_chunk(subbp, chunk, block_t, window,
+                                      interpret)[:, :T])
+    return jnp.concatenate(rows, axis=0) if len(rows) > 1 else rows[0]
+
+
+def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
+    """Build the jitted sharded per-pass search.
+
+    Returns fn(subbands[nsub, T'], sub_shifts[ndms, nsub],
+               keep_mask[nbins] float, bank_fft[nz, seg] complex)
+    -> dict of gathered arrays:
+         lo_vals/lo_bins: (nstages_lo, ndms, topk)
+         sp_snr/sp_idx:   (nwidths, ndms, sp_topk)
+         hi_vals/hi_rbins/hi_zidx: (ndms, nstages_hi, topk)  [hi only]
+
+    ndms must be a multiple of mesh.shape['dm'] (shard_dm_table pads).
+    Subbands and masks are replicated; only the DM-trial axis is
+    sharded, and the per-trial top-k blocks are the only arrays that
+    cross ICI (one tiled all_gather each) — the TPU realization of the
+    reference's embarrassingly-parallel per-DM loop
+    (PALFA2_presto_search.py:532-594, SURVEY.md section 2.4).
+    """
+    from jax import shard_map
+
+    from tpulsar.kernels import accel as ak
+    from tpulsar.kernels import fourier as fr
+    from tpulsar.kernels import singlepulse as sp_k
+    from tpulsar.kernels.dedisperse import _shift_gather
+
+    def body(subb, shifts, keep, bank):
+        if spec.pallas_dd:
+            series = _pallas_dd_local(subb, shifts, spec.dd_stage_s,
+                                      spec.dd_interpret)
+        else:
+            series = jax.vmap(
+                lambda s: _shift_gather(subb, s).sum(axis=0))(shifts)
+        norm = sp_k.normalize_series(series)
+        sp_snr, sp_idx = sp_k.boxcar_search(norm, spec.sp_widths,
+                                            spec.sp_topk)
+        cspec = fr.complex_spectrum(series)
+        powers, wpow = fr.whitened_powers(cspec, keep)
+        lo_vals, lo_bins = [], []
+        for h in fr.harmonic_stages(spec.max_numharm):
+            v, b = fr.stage_candidates(wpow, h, spec.topk)
+            lo_vals.append(v)
+            lo_bins.append(b)
+
+        def g(x, axis):
+            return jax.lax.all_gather(x, "dm", axis=axis, tiled=True)
+
+        out = {
+            "lo_vals": g(jnp.stack(lo_vals), 1),
+            "lo_bins": g(jnp.stack(lo_bins), 1),
+            "sp_snr": g(sp_snr, 1),
+            "sp_idx": g(sp_idx, 1),
+        }
+        if spec.hi:
+            wspec = fr.scale_spectrum(cspec, powers, wpow)
+            hv, hr, hz = ak._accel_block_topk(
+                wspec, bank, spec.hi_seg, spec.hi_step, spec.hi_width,
+                spec.hi_nz, spec.hi_numharm, spec.topk)
+            out["hi_vals"] = g(hv, 0)
+            out["hi_rbins"] = g(hr, 0)
+            out["hi_zidx"] = g(hz, 0)
+        return out
+
+    out_specs = {k: P() for k in
+                 (("lo_vals", "lo_bins", "sp_snr", "sp_idx")
+                  + (("hi_vals", "hi_rbins", "hi_zidx")
+                     if spec.hi else ()))}
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P("dm", None), P(), P()),
+        out_specs=out_specs,
+        check_vma=False,
+    ))
+
+
 def shard_dm_table(sub_shifts: np.ndarray, n_dm: int) -> np.ndarray:
     """Pad the (ndms, nsub) stage-2 shift table so ndms divides the dm
     axis size (padded trials repeat the last row; their duplicate

@@ -127,7 +127,8 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                 zaplist: np.ndarray | None = None,
                 plan: list[ddplan.DedispStep] | None = None,
                 baryv: float | None = None,
-                checkpoint_dir: str | None = None) -> SearchOutcome:
+                checkpoint_dir: str | None = None,
+                mesh=None) -> SearchOutcome:
     """Search one beam end-to-end and write the results directory.
 
     baryv: average barycentric velocity (v/c, positive receding) of
@@ -178,7 +179,7 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     result = search_block(data, si.freqs, si.dt, plan, params,
                           zaplist=zaplist, baryv=baryv, nsub=nsub,
                           timers=timers, checkpoint_dir=checkpoint_dir,
-                          data_id=data_id)
+                          data_id=data_id, mesh=mesh)
     final, folded, sp_events, num_trials = result
 
     # ----------------------------------------------------------- artifacts
@@ -235,12 +236,19 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                  timers: StageTimers | None = None,
                  checkpoint_dir: str | None = None,
                  data_id: str = "",
-                 progress_cb=None):
+                 progress_cb=None,
+                 mesh=None):
     """Run the plan loop + sifting + folding on an in-HBM block.
 
     data: (nchan, T) device array, any numeric dtype (uint8 is fine —
     conversion fuses into the subband reduction).  This is the
     benchmark surface: no file I/O, just the compute chain.
+
+    mesh: a jax.sharding.Mesh with a 'dm' axis — each pass's DM trials
+    are sharded across it (dedispersion, single-pulse, lo- and
+    hi-accel all run per-shard; per-trial top-k blocks are the only
+    ICI traffic).  None = single-device.  Candidates are identical to
+    the single-device path up to float reduction order.
 
     checkpoint_dir: when set, per-pass candidate dumps are written
     there and completed passes are skipped on re-entry — pass-level
@@ -297,53 +305,64 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                                         nsub, step.downsamp)
             dt_ds = dt * step.downsamp
             dms = np.asarray(ppass.dms)
-            for lo in range(0, len(dms), params.max_dms_per_chunk):
-                dm_chunk = dms[lo: lo + params.max_dms_per_chunk]
-                with timers.timing("dedispersing"):
-                    series = dd.dedisperse_subbands(
-                        subb, jnp.asarray(sub_shifts[lo: lo + len(dm_chunk)]))
-                num_trials += len(dm_chunk)
-                T_s = series.shape[1] * dt_ds
+            if mesh is not None:
+                with timers.timing("sharded-search"):
+                    cands, events = _search_pass_sharded(
+                        mesh, subb, sub_shifts, dms, dt_ds, params,
+                        zaplist, baryv, timers=timers)
+                all_cands.extend(cands)
+                if len(events):
+                    sp_chunks.append(events)
+                num_trials += len(dms)
+            else:
+                for lo in range(0, len(dms), params.max_dms_per_chunk):
+                    dm_chunk = dms[lo: lo + params.max_dms_per_chunk]
+                    with timers.timing("dedispersing"):
+                        series = dd.dedisperse_subbands(
+                            subb,
+                            jnp.asarray(sub_shifts[lo: lo + len(dm_chunk)]))
+                    num_trials += len(dm_chunk)
+                    T_s = series.shape[1] * dt_ds
 
-                with timers.timing("single-pulse"):
-                    ev = sp_k.single_pulse_search(
-                        series, dm_chunk, dt_ds,
-                        threshold=params.sp_threshold,
-                        widths=params.sp_widths)
-                    if len(ev):
-                        sp_chunks.append(ev)
+                    with timers.timing("single-pulse"):
+                        ev = sp_k.single_pulse_search(
+                            series, dm_chunk, dt_ds,
+                            threshold=params.sp_threshold,
+                            widths=params.sp_widths)
+                        if len(ev):
+                            sp_chunks.append(ev)
 
-                with timers.timing("FFT"):
-                    nbins = series.shape[1] // 2 + 1
-                    keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
-                        if zaplist is not None else None
-                    # One rfft + one whitening estimate per chunk,
-                    # shared by the lo (powers) and hi (complex
-                    # spectrum) stages.
-                    spec = fr.complex_spectrum(series)
-                    powers, wpow = fr.whitened_powers(
-                        spec,
-                        jnp.asarray(keep) if keep is not None else None)
-                with timers.timing("lo-accelsearch"):
-                    res = {
-                        h: fr.stage_candidates(wpow, h,
-                                               params.topk_per_stage)
-                        for h in fr.harmonic_stages(
-                            params.lo_accel_numharm)}
-                    all_cands.extend(sifting.make_candidates(
-                        res, dm_chunk, T_s, fr.sigma_from_power,
-                        sigma_min=params.sifting.sigma_threshold))
+                    with timers.timing("FFT"):
+                        nbins = series.shape[1] // 2 + 1
+                        keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
+                            if zaplist is not None else None
+                        # One rfft + one whitening estimate per chunk,
+                        # shared by the lo (powers) and hi (complex
+                        # spectrum) stages.
+                        spec = fr.complex_spectrum(series)
+                        powers, wpow = fr.whitened_powers(
+                            spec,
+                            jnp.asarray(keep) if keep is not None else None)
+                    with timers.timing("lo-accelsearch"):
+                        res = {
+                            h: fr.stage_candidates(wpow, h,
+                                                   params.topk_per_stage)
+                            for h in fr.harmonic_stages(
+                                params.lo_accel_numharm)}
+                        all_cands.extend(sifting.make_candidates(
+                            res, dm_chunk, T_s, fr.sigma_from_power,
+                            sigma_min=params.sifting.sigma_threshold))
 
-                if params.run_hi_accel and params.hi_accel_zmax > 0:
-                    with timers.timing("hi-accelsearch"):
-                        # Whitening scale from the already-computed
-                        # powers; zapped bins have wpow==0 so they
-                        # vanish from the correlation input too.
-                        wspec = fr.scale_spectrum(spec, powers, wpow)
-                        all_cands.extend(_hi_accel_pass(
-                            wspec, dm_chunk, T_s, params))
-                        del wspec
-                del spec, powers, wpow
+                    if params.run_hi_accel and params.hi_accel_zmax > 0:
+                        with timers.timing("hi-accelsearch"):
+                            # Whitening scale from the already-computed
+                            # powers; zapped bins have wpow==0 so they
+                            # vanish from the correlation input too.
+                            wspec = fr.scale_spectrum(spec, powers, wpow)
+                            all_cands.extend(_hi_accel_pass(
+                                wspec, dm_chunk, T_s, params))
+                            del wspec
+                    del spec, powers, wpow
             del subb
             if checkpoint_dir:
                 _save_pass_checkpoint(
@@ -512,6 +531,142 @@ def _get_bank(zmax: int) -> accel_k.TemplateBank:
     if zmax not in _BANK_CACHE:
         _BANK_CACHE[zmax] = accel_k.build_template_bank(float(zmax))
     return _BANK_CACHE[zmax]
+
+
+_SHARDED_FN_CACHE: dict[tuple, object] = {}
+
+
+def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
+                         params: SearchParams, zaplist, baryv,
+                         timers: StageTimers | None = None):
+    """One dedispersion pass with the DM axis sharded over the mesh.
+
+    Runs the same pipeline as the single-device chunk loop —
+    dedisperse, SP boxcars, whiten, lo harmonic stages, hi z-template
+    correlation — as ONE fused sharded program per DM chunk, then
+    converts the gathered top-k blocks with the same host code.
+    Returns (candidates, sp_events).
+
+    Robustness gates carry over from the single-device path: stage-2
+    dedispersion uses the Pallas sliding-window kernel exactly when
+    dedisperse_subbands would, and the hi z-template correlation only
+    runs sharded when the batched-FFT subprocess gate passes — when it
+    does not (the runtime that rejects batched complex-FFT shapes),
+    the hi stage drops to the single-device accel_search_batch, which
+    has its own proven per-DM fallback.
+    """
+    import jax
+
+    from tpulsar.kernels import pallas_dd
+    from tpulsar.parallel import mesh as pmesh
+
+    n_dm = int(mesh.shape["dm"])
+    T_ds = int(subb.shape[-1])
+    nbins = T_ds // 2 + 1
+    T_s = T_ds * dt_ds
+    hi = params.run_hi_accel and params.hi_accel_zmax > 0
+    hi_sharded = hi and accel_k._batch_path_usable()
+    bank = _get_bank(params.hi_accel_zmax) if hi else None
+    nz = len(bank.zs) if hi else 0
+    use_pallas = pallas_dd.use_pallas()
+    stage_s = 0
+    if use_pallas:
+        smax = int(np.asarray(sub_shifts).max(initial=0))
+        stage_s = max(256, 1 << int(np.ceil(np.log2(max(smax, 1)))))
+    spec = pmesh.PassSpec(
+        max_numharm=params.lo_accel_numharm,
+        topk=params.topk_per_stage,
+        sp_widths=tuple(params.sp_widths), sp_topk=sp_k.DEFAULT_TOPK,
+        hi=hi_sharded, hi_numharm=params.hi_accel_numharm,
+        hi_seg=bank.seg if hi_sharded else 0,
+        hi_step=bank.step if hi_sharded else 0,
+        hi_width=bank.width if hi_sharded else 0,
+        hi_nz=nz if hi_sharded else 0,
+        pallas_dd=use_pallas, dd_stage_s=stage_s,
+        dd_interpret=use_pallas
+        and jax.default_backend() not in ("tpu", "axon"))
+    key = (mesh, spec)
+    if key not in _SHARDED_FN_CACHE:
+        _SHARDED_FN_CACHE[key] = pmesh.sharded_pass_fn(mesh, spec)
+    fn = _SHARDED_FN_CACHE[key]
+
+    keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
+        if zaplist is not None else np.ones(nbins, bool)
+    keep_arr = jnp.asarray(keep.astype(np.float32))
+    bank_arr = (jnp.asarray(bank.bank_fft) if hi_sharded
+                else jnp.zeros((1, 1), jnp.complex64))
+
+    padded = pmesh.shard_dm_table(np.asarray(sub_shifts), n_dm)
+    ndms_pad, ndms = len(padded), len(dms)
+    # Chunk size: multiple of the dm axis, bounded by the per-device
+    # accel-plane HBM budget and the configured DM chunk.
+    chunk = params.max_dms_per_chunk
+    if hi_sharded:
+        chunk = min(chunk, accel_k.plane_dm_chunk(nbins, nz) * n_dm)
+    chunk = max(n_dm, (chunk // n_dm) * n_dm)
+    chunk = min(chunk, ndms_pad)
+
+    stages_lo = fr.harmonic_stages(params.lo_accel_numharm)
+    stages_hi = fr.harmonic_stages(params.hi_accel_numharm) if hi else []
+    lo_vals = np.empty((len(stages_lo), ndms_pad, params.topk_per_stage),
+                       np.float32)
+    lo_bins = np.empty_like(lo_vals, dtype=np.int64)
+    sp_snr = np.empty((len(params.sp_widths), ndms_pad,
+                       sp_k.DEFAULT_TOPK), np.float32)
+    sp_idx = np.empty_like(sp_snr, dtype=np.int64)
+    if hi_sharded:
+        hi_vals = np.empty((ndms_pad, len(stages_hi),
+                            params.topk_per_stage), np.float32)
+        hi_rbins = np.empty_like(hi_vals, dtype=np.int32)
+        hi_zidx = np.empty_like(hi_rbins)
+
+    for c0 in range(0, ndms_pad, chunk):
+        s0 = min(c0, ndms_pad - chunk)   # clamp: keep one compile
+        out = fn(subb, jnp.asarray(padded[s0:s0 + chunk]), keep_arr,
+                 bank_arr)
+        sl = slice(s0, s0 + chunk)
+        lo_vals[:, sl] = np.asarray(out["lo_vals"])
+        lo_bins[:, sl] = np.asarray(out["lo_bins"])
+        sp_snr[:, sl] = np.asarray(out["sp_snr"])
+        sp_idx[:, sl] = np.asarray(out["sp_idx"])
+        if hi_sharded:
+            hi_vals[sl] = np.asarray(out["hi_vals"])
+            hi_rbins[sl] = np.asarray(out["hi_rbins"])
+            hi_zidx[sl] = np.asarray(out["hi_zidx"])
+
+    lo_res = {h: (lo_vals[si, :ndms], lo_bins[si, :ndms])
+              for si, h in enumerate(stages_lo)}
+    cands = sifting.make_candidates(
+        lo_res, dms, T_s, fr.sigma_from_power,
+        sigma_min=params.sifting.sigma_threshold)
+    if hi_sharded:
+        zs = np.asarray(bank.zs)
+        hi_res = {h: (hi_vals[:ndms, si], hi_rbins[:ndms, si],
+                      zs[hi_zidx[:ndms, si]])
+                  for si, h in enumerate(stages_hi)}
+        cands.extend(sifting.make_candidates(
+            hi_res, dms, T_s, fr.sigma_from_power,
+            sigma_min=params.sifting.sigma_threshold,
+            z_min_abs=accel_k.DZ / 2))
+    elif hi:
+        # Batched-FFT gate failed: run the hi stage through the
+        # single-device route (accel_search_batch -> its own proven
+        # per-DM fallback), re-dedispersing in chunks.  Slower, but
+        # correct on runtimes that reject the batched shapes.
+        for lo in range(0, ndms, params.max_dms_per_chunk):
+            dm_chunk = dms[lo: lo + params.max_dms_per_chunk]
+            series = dd.dedisperse_subbands(
+                subb, jnp.asarray(np.asarray(sub_shifts)
+                                  [lo: lo + len(dm_chunk)]))
+            cspec = fr.complex_spectrum(series)
+            powers, wpow = fr.whitened_powers(
+                cspec, jnp.asarray(keep.astype(np.float32)))
+            wspec = fr.scale_spectrum(cspec, powers, wpow)
+            cands.extend(_hi_accel_pass(wspec, dm_chunk, T_s, params))
+    events = sp_k.events_from_topk(
+        sp_snr[:, :ndms], sp_idx[:, :ndms], dms, dt_ds,
+        threshold=params.sp_threshold, widths=tuple(params.sp_widths))
+    return cands, events
 
 
 def _write_inf_files(resultsdir, basenm, si, dms, dt, nsamp) -> None:
