@@ -25,3 +25,48 @@ import pytest
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(1234)
+
+
+def _find_search_job_pids() -> list[int]:
+    pids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmd = fh.read().decode(errors="replace")
+        except OSError:
+            continue
+        if "tpulsar.cli.search_job" in cmd.replace("\0", " "):
+            pids.append(int(pid))
+    return pids
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _no_leaked_search_jobs():
+    """Every test must reap the search subprocesses it submits (the
+    LocalProcessManager.shutdown() teardown in test_cli does this);
+    a leaked search_job outlived its test by 20+ minutes in round 1.
+    This guard fails the suite if any survive — and still kills them
+    so one failure doesn't poison the machine."""
+    import signal
+    import time
+
+    before = set(_find_search_job_pids())
+    yield
+    leaked = [p for p in _find_search_job_pids() if p not in before]
+    for pid in leaked:
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGTERM)
+        except OSError:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+    deadline = time.time() + 10
+    while time.time() < deadline and any(
+            p in _find_search_job_pids() for p in leaked):
+        time.sleep(0.2)
+    assert not leaked, (
+        f"search_job subprocesses leaked by the suite (killed now): "
+        f"{leaked}")

@@ -87,3 +87,33 @@ def test_plan_cli(tmp_path, capsys):
     assert cli.main(["plan", "--survey", "pdev"]) == 0
     out = capsys.readouterr().out
     assert "total DM trials" in out
+
+
+def test_choose_n_properties():
+    from tpulsar.plan.ddplan import choose_n
+
+    def is_smooth(n, factors=(2, 3, 5, 7)):
+        for f in factors:
+            while n % f == 0:
+                n //= f
+        return n == 1
+
+    for n in (1, 63, 64, 65, 1000, 30000, 123457, 2 ** 20,
+              2 ** 20 + 1, 9999991):
+        N = choose_n(n)
+        assert N >= n
+        assert N % 64 == 0
+        assert is_smooth(N)
+        # padding overhead stays small (<= ~12% for awkward sizes)
+        if n >= 1000:
+            assert N / n < 1.13, (n, N)
+    # already-smooth multiples of 64 are returned unchanged
+    assert choose_n(1 << 15) == 1 << 15
+    assert choose_n(30240 * 64) == 30240 * 64
+
+
+def test_choose_n_exact_examples():
+    from tpulsar.plan.ddplan import choose_n
+    assert choose_n(30000) == 30720          # 64 * 480
+    assert choose_n(100) == 128
+    assert choose_n(0) == 64

@@ -57,3 +57,21 @@ def test_mask_roundtrip(tmp_path):
     back = rfi.RFIMask.load(p)
     np.testing.assert_array_equal(back.cell_mask, mask.cell_mask)
     assert back.block_len == 256
+
+
+def test_short_observation_mask_is_finite():
+    """Observations shorter than one rfifind block must still produce
+    a usable mask with a finite masked_fraction (a NaN fraction broke
+    upload verification: NaN cannot round-trip SQLite)."""
+    import math
+
+    rng = np.random.default_rng(9)
+    data = rng.standard_normal((100, 8)).astype(np.float32)  # T < 2048
+    mask = rfi.find_rfi(data, dt=1e-3, block_len=2048)
+    assert mask.block_len == 100
+    assert mask.cell_mask.shape == (1, 8)
+    assert math.isfinite(mask.masked_fraction)
+    # apply_mask with the clamped block length round-trips the shape
+    out = rfi.apply_mask(jnp.asarray(data),
+                         jnp.asarray(mask.full_mask()), mask.block_len)
+    assert out.shape == data.shape

@@ -122,3 +122,33 @@ def test_upload_is_transactional(results_dir, tmp_path, monkeypatch):
     assert db.fetchone("SELECT COUNT(*) c FROM headers")["c"] == 0
     assert db.fetchone("SELECT COUNT(*) c FROM pdm_candidates")["c"] == 0
     db.close()
+
+
+def test_skipped_beam_goes_terminal_not_failed(tmp_path):
+    """A worker-side clean skip (skipped.txt, no header.json) must move
+    the job to a terminal 'skipped' state — NOT the failed->retry loop
+    the missing header would cause (round-1 advisor finding: the skip
+    defeated itself end-to-end)."""
+    rd = tmp_path / "skip_results"
+    os.makedirs(rd, exist_ok=True)
+    (rd / "skipped.txt").write_text(
+        "observation is 2.0 s < low_T_to_search 3600.0 s\n")
+    t, job_id, sid = _tracked_submit(tmp_path, str(rd))
+    up = JobUploader(t, db_url=str(tmp_path / "results.db"))
+    up.run()
+    assert t.query("SELECT status FROM jobs WHERE id=?", [job_id],
+                   fetchone=True)["status"] == "skipped"
+    srow = t.query("SELECT status, details FROM job_submits WHERE id=?",
+                   [sid], fetchone=True)
+    assert srow["status"] == "skipped"
+    assert "low_T_to_search" in srow["details"]
+
+    # the pool's failure recovery must leave it alone (terminal)
+    from tpulsar.orchestrate.pool import JobPool
+    from tpulsar.orchestrate.queue_managers.local import LocalProcessManager
+
+    pool = JobPool(t, LocalProcessManager(
+        state_dir=str(tmp_path / "q")), str(tmp_path / "res"))
+    pool.recover_failed_jobs()
+    assert t.query("SELECT status FROM jobs WHERE id=?", [job_id],
+                   fetchone=True)["status"] == "skipped"

@@ -23,3 +23,30 @@ Layout (mirrors SURVEY.md section 7):
 """
 
 __version__ = "0.1.0"
+
+
+def apply_platform_env() -> None:
+    """Make JAX honour the JAX_PLATFORMS environment variable even
+    when a sitecustomize registered an accelerator backend at
+    interpreter start (which wins over the env var).  Every process
+    entry point (CLI daemons, search workers) calls this before any
+    jax use; without it a worker told JAX_PLATFORMS=cpu can silently
+    land on the accelerator — and hang forever if the chip is wedged
+    (the round-1 failure mode)."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception as exc:
+            # Do NOT run silently on whatever backend jax picked: on
+            # a host with a wedged accelerator that is a hang, not a
+            # slowdown.
+            import warnings
+
+            warnings.warn(
+                f"could not pin JAX platform to {want!r} ({exc}); "
+                f"this process may run on an unintended backend")

@@ -473,6 +473,10 @@ def cmd_search(args):
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpulsar", description=__doc__)
     p.add_argument("--db", default=None, help="job-tracker DB path")
+    p.add_argument("--config", default=None, metavar="PATH",
+                   help="config file (python or YAML); exported as "
+                        "TPULSAR_CONFIG so worker subprocesses load "
+                        "the same settings")
     debugflags.add_cli_flags(p)
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -549,7 +553,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    import tpulsar
+
+    tpulsar.apply_platform_env()
     args = build_parser().parse_args(argv)
+    if args.config:
+        # load-and-validate now, and export for worker subprocesses
+        # (queue backends pass config by environment, like DATAFILES)
+        from tpulsar.config import load_config, set_settings
+
+        os.environ["TPULSAR_CONFIG"] = os.path.abspath(args.config)
+        set_settings(load_config(args.config))
     debugflags.apply_cli_flags(args)
     return args.fn(args)
 

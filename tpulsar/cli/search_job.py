@@ -117,6 +117,9 @@ def _keep_stderr_clean() -> None:
 
 
 def main(argv=None) -> int:
+    import tpulsar
+
+    tpulsar.apply_platform_env()
     _keep_stderr_clean()
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("files", nargs="*", help="raw data files")

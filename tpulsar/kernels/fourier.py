@@ -25,6 +25,22 @@ from scipy import special as sps
 
 # ----------------------------------------------------------------- rfft
 
+@partial(jax.jit, static_argnames=("nfft",))
+def pad_series(series: jnp.ndarray, nfft: int) -> jnp.ndarray:
+    """Pad (..., T) series to length nfft with each row's mean (the
+    reference pads to PRESTO's choose_N the same way via prepsubband
+    -numout, PALFA2_presto_search.py:518 — mean padding avoids the
+    broadband leakage a zero-pad step discontinuity would inject)."""
+    T = series.shape[-1]
+    if T == nfft:
+        return series
+    if T > nfft:
+        return series[..., :nfft]
+    mean = jnp.mean(series, axis=-1, keepdims=True)
+    pad = jnp.broadcast_to(mean, series.shape[:-1] + (nfft - T,))
+    return jnp.concatenate([series, pad], axis=-1)
+
+
 @jax.jit
 def complex_spectrum(series: jnp.ndarray) -> jnp.ndarray:
     """(ndms, T) real time series -> (ndms, T//2+1) complex spectrum

@@ -38,7 +38,9 @@ class RFIMask:
     def masked_fraction(self) -> float:
         full = (self.cell_mask | self.bad_channels[None, :]
                 | self.bad_blocks[:, None])
-        return float(full.mean())
+        # a degenerate observation can have zero cells; the fraction
+        # must stay finite (NaN cannot round-trip the results DB)
+        return float(full.mean()) if full.size else 0.0
 
     def full_mask(self) -> np.ndarray:
         return (self.cell_mask | self.bad_channels[None, :]
@@ -92,6 +94,10 @@ def find_rfi(data: np.ndarray | jnp.ndarray, dt: float,
     (`block_frac`) bad cells are zapped entirely — the same
     recommended-channel/interval semantics as rfifind's mask.
     """
+    # Observations shorter than one block still get (exactly) one
+    # cell; without the clamp nblocks=0 and every downstream statistic
+    # of the empty mask is NaN.
+    block_len = min(block_len, int(data.shape[0]))
     # Pass the native dtype through; cell_stats casts per cell so a
     # uint8 block never inflates to a full float32 copy.
     mean, std, maxpow = cell_stats(jnp.asarray(data), block_len)

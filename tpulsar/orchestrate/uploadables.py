@@ -36,6 +36,12 @@ def _compare(expected: dict[str, Any], row, context: str) -> None:
     for key, want in expected.items():
         got = row[key]
         if isinstance(want, float):
+            import math
+            if not math.isfinite(want):
+                problems.append(
+                    f"{key}: non-finite value {want!r} (NaN/inf cannot "
+                    f"round-trip SQLite; fix the producing stage)")
+                continue
             ok = (got is not None
                   and abs(got - want) <= 1e-6 * max(1.0, abs(want)))
         else:

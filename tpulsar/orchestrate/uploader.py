@@ -163,6 +163,26 @@ class JobUploader:
         """One-beam upload with the reference's rollback taxonomy
         (JobUploader.py:73-206)."""
         t_start = time.time()
+        # A clean worker-side skip (e.g. observation below the
+        # low_T_to_search threshold) writes skipped.txt and no
+        # header.json.  Move the job to a TERMINAL skipped state
+        # instead of the failed->retry->terminal loop the missing
+        # header would otherwise cause (the skip would be re-searched
+        # max_attempts times just to be skipped again).
+        skip_path = os.path.join(resultsdir, "skipped.txt")
+        if os.path.exists(skip_path):
+            with open(skip_path) as fh:
+                reason = fh.read().strip()
+            self.t.update("job_submits", submit_id, status="skipped",
+                          details=reason[:4000])
+            self.t.update("jobs", job_id, status="skipped",
+                          details=reason[:4000])
+            self.log.info("submit %d skipped: %s", submit_id, reason)
+            # terminal state: reclaim raw data like the other
+            # terminal outcomes (uploaded / terminal_failure) do
+            if self.delete_raw_on_upload:
+                self._delete_raw(job_id)
+            return
         try:
             with _timed("Parsing"):
                 header, diags = self.parse_results(resultsdir)

@@ -130,6 +130,7 @@ class PassSpec:
     """Static configuration of the full sharded per-pass search (the
     production pipeline: dedisperse -> SP boxcars -> whiten -> lo
     harmonic stages -> hi z-template correlation)."""
+    nfft: int                   # FFT-friendly padded series length
     max_numharm: int            # lo-accel harmonic stages
     topk: int
     sp_widths: tuple[int, ...]
@@ -212,7 +213,7 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
         norm = sp_k.normalize_series(series)
         sp_snr, sp_idx = sp_k.boxcar_search(norm, spec.sp_widths,
                                             spec.sp_topk)
-        cspec = fr.complex_spectrum(series)
+        cspec = fr.complex_spectrum(fr.pad_series(series, spec.nfft))
         powers, wpow = fr.whitened_powers(cspec, keep)
         lo_vals, lo_bins = [], []
         for h in fr.harmonic_stages(spec.max_numharm):

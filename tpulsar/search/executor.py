@@ -165,9 +165,11 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                               block_len=params.rfifind_blocklen,
                               threshold=params.rfi_threshold)
         mask.save(os.path.join(resultsdir, f"{basenm}_rfifind.npz"))
+        # mask.block_len, not the configured one: find_rfi clamps it
+        # for observations shorter than a block
         clean = np.asarray(rfi_k.apply_mask(
             jnp.asarray(block), jnp.asarray(mask.full_mask()),
-            params.rfifind_blocklen))
+            mask.block_len))
     # Keep the block's native dtype in HBM (uint8 beams stay 4x
     # smaller; form_subbands casts after its gather).
     data = jnp.asarray(np.ascontiguousarray(clean.T))   # (nchan, T)
@@ -322,7 +324,12 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                             subb,
                             jnp.asarray(sub_shifts[lo: lo + len(dm_chunk)]))
                     num_trials += len(dm_chunk)
-                    T_s = series.shape[1] * dt_ds
+                    # FFT-friendly padded length (reference: PRESTO
+                    # choose_N via prepsubband -numout,
+                    # PALFA2_presto_search.py:518); one length per
+                    # plan step keeps compile signatures bounded.
+                    nfft = ddplan.choose_n(series.shape[1])
+                    T_s = nfft * dt_ds
 
                     with timers.timing("single-pulse"):
                         ev = sp_k.single_pulse_search(
@@ -333,13 +340,14 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                             sp_chunks.append(ev)
 
                     with timers.timing("FFT"):
-                        nbins = series.shape[1] // 2 + 1
+                        nbins = nfft // 2 + 1
                         keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
                             if zaplist is not None else None
                         # One rfft + one whitening estimate per chunk,
                         # shared by the lo (powers) and hi (complex
                         # spectrum) stages.
-                        spec = fr.complex_spectrum(series)
+                        spec = fr.complex_spectrum(
+                            fr.pad_series(series, nfft))
                         powers, wpow = fr.whitened_powers(
                             spec,
                             jnp.asarray(keep) if keep is not None else None)
@@ -562,8 +570,9 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
 
     n_dm = int(mesh.shape["dm"])
     T_ds = int(subb.shape[-1])
-    nbins = T_ds // 2 + 1
-    T_s = T_ds * dt_ds
+    nfft = ddplan.choose_n(T_ds)
+    nbins = nfft // 2 + 1
+    T_s = nfft * dt_ds
     hi = params.run_hi_accel and params.hi_accel_zmax > 0
     hi_sharded = hi and accel_k._batch_path_usable()
     bank = _get_bank(params.hi_accel_zmax) if hi else None
@@ -574,6 +583,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
         smax = int(np.asarray(sub_shifts).max(initial=0))
         stage_s = max(256, 1 << int(np.ceil(np.log2(max(smax, 1)))))
     spec = pmesh.PassSpec(
+        nfft=nfft,
         max_numharm=params.lo_accel_numharm,
         topk=params.topk_per_stage,
         sp_widths=tuple(params.sp_widths), sp_topk=sp_k.DEFAULT_TOPK,
@@ -658,7 +668,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
             series = dd.dedisperse_subbands(
                 subb, jnp.asarray(np.asarray(sub_shifts)
                                   [lo: lo + len(dm_chunk)]))
-            cspec = fr.complex_spectrum(series)
+            cspec = fr.complex_spectrum(fr.pad_series(series, nfft))
             powers, wpow = fr.whitened_powers(
                 cspec, jnp.asarray(keep.astype(np.float32)))
             wspec = fr.scale_spectrum(cspec, powers, wpow)
