@@ -1,0 +1,97 @@
+"""Frozen search scenarios for the golden-file parity tests.
+
+Each scenario deterministically builds (data, freqs, dt, plan, params)
+for executor.search_block; the sifted candidate list is frozen in
+tests/golden/<name>.json and diffed in CI (SURVEY.md section 4: the
+reference suite has no golden files — the BASELINE 'candidate list
+identical to PRESTO' metric demands them).  Regenerate DELIBERATELY
+with `python tests/make_golden.py` after a change that is supposed to
+alter the candidate lists, and justify the diff in the commit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpulsar.constants import dispersion_delay_s
+from tpulsar.plan import ddplan
+from tpulsar.search import executor
+
+GOLDEN_DIR = "golden"
+
+
+def _dispersed_pulses(data, freqs, dt, period_s, dm, amp,
+                      width_frac=0.1, fdot=0.0):
+    t = np.arange(data.shape[1]) * dt
+    delays = dispersion_delay_s(dm, freqs, freqs[-1])
+    for c in range(data.shape[0]):
+        tc = t - delays[c]
+        phase = (tc / period_s + 0.5 * fdot * tc * tc / period_s) % 1.0
+        data[c] += (phase < width_frac) * amp
+
+
+def build_scenarios() -> dict:
+    out = {}
+
+    # --- two_pulsars: slow strong + fast mild at distinct DMs -------
+    rng = np.random.default_rng(2024)
+    nchan, T, dt = 32, 1 << 15, 5e-4
+    freqs = np.linspace(1214.0, 1536.0, nchan)
+    data = rng.standard_normal((nchan, T)).astype(np.float32)
+    _dispersed_pulses(data, freqs, dt, period_s=0.25, dm=60.0, amp=1.2)
+    _dispersed_pulses(data, freqs, dt, period_s=0.021, dm=25.0,
+                      amp=0.7, width_frac=0.25)
+    plan = [ddplan.DedispStep(lodm=10.0, dmstep=5.0, dms_per_pass=12,
+                              numpasses=1, numsub=16, downsamp=1),
+            ddplan.DedispStep(lodm=70.0, dmstep=10.0, dms_per_pass=6,
+                              numpasses=1, numsub=16, downsamp=2)]
+    params = executor.SearchParams(
+        nsub=16, lo_accel_numharm=8, hi_accel_zmax=8, hi_accel_numharm=4,
+        topk_per_stage=16, max_cands_to_fold=4, fold_nbin=32,
+        fold_npart=8, make_plots=False)
+    out["two_pulsars"] = (data, freqs, dt, plan, params)
+
+    # --- accel_binary: drifting tone exercises the z-template path --
+    rng = np.random.default_rng(777)
+    data2 = rng.standard_normal((nchan, T)).astype(np.float32)
+    # fdot such that drift z = fdot_f * T_obs^2 ~ +9 bins
+    T_obs = T * dt
+    f0 = 1.0 / 0.05
+    zdrift = 9.0
+    fdot_f = zdrift / T_obs ** 2
+    _dispersed_pulses(data2, freqs, dt, period_s=0.05, dm=40.0,
+                      amp=0.9, width_frac=0.2, fdot=fdot_f / f0)
+    plan2 = [ddplan.DedispStep(lodm=20.0, dmstep=5.0, dms_per_pass=10,
+                               numpasses=1, numsub=16, downsamp=1)]
+    params2 = executor.SearchParams(
+        nsub=16, lo_accel_numharm=4, hi_accel_zmax=16,
+        hi_accel_numharm=4, topk_per_stage=16, max_cands_to_fold=2,
+        fold_nbin=32, fold_npart=8, make_plots=False)
+    out["accel_binary"] = (data2, freqs, dt, plan2, params2)
+
+    # --- pure_noise: the empty-list regression ----------------------
+    rng = np.random.default_rng(4242)
+    data3 = rng.standard_normal((16, 1 << 14)).astype(np.float32)
+    plan3 = [ddplan.DedispStep(lodm=0.0, dmstep=10.0, dms_per_pass=8,
+                               numpasses=1, numsub=8, downsamp=1)]
+    params3 = executor.SearchParams(
+        nsub=8, lo_accel_numharm=8, hi_accel_zmax=8, hi_accel_numharm=4,
+        topk_per_stage=16, max_cands_to_fold=0, make_plots=False)
+    out["pure_noise"] = (data3, np.linspace(1214.0, 1536.0, 16), dt,
+                         plan3, params3)
+    return out
+
+
+def run_scenario(name: str):
+    """-> list of candidate record dicts for the named scenario."""
+    import jax.numpy as jnp
+
+    data, freqs, dt, plan, params = build_scenarios()[name]
+    final, folded, sp, ntrials = executor.search_block(
+        jnp.asarray(data), np.asarray(freqs), dt, plan, params)
+    return [
+        {"freq_hz": round(c.freq_hz, 6), "dm": round(c.dm, 2),
+         "z": round(c.z, 2), "sigma": round(c.sigma, 2),
+         "numharm": c.numharm, "num_dm_hits": c.num_dm_hits}
+        for c in final
+    ], ntrials

@@ -1,0 +1,36 @@
+"""Regenerate the golden candidate lists (deliberate act only —
+justify the diff in the commit message).
+
+Usage: JAX_PLATFORMS=cpu python tests/make_golden.py [scenario ...]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from golden_scenarios import GOLDEN_DIR, build_scenarios, run_scenario  # noqa: E402
+
+
+def main(argv):
+    names = argv or sorted(build_scenarios())
+    outdir = os.path.join(os.path.dirname(__file__), GOLDEN_DIR)
+    os.makedirs(outdir, exist_ok=True)
+    for name in names:
+        cands, ntrials = run_scenario(name)
+        path = os.path.join(outdir, f"{name}.json")
+        with open(path, "w") as fh:
+            json.dump({"ntrials": ntrials, "candidates": cands}, fh,
+                      indent=1)
+        print(f"{name}: {len(cands)} candidates, {ntrials} trials "
+              f"-> {path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -16,7 +16,7 @@ import numpy as np
 from tpulsar.search.sifting import Candidate
 
 _CAND_RE = re.compile(
-    r"^\s*(?P<num>\d+)\s+(?P<sigma>[\d.]+)\s+(?P<numharm>\d+)\s+"
+    r"^\s*(?P<num>\d+)\s+(?P<sigma>[\deE+.-]+)\s+(?P<numharm>\d+)\s+"
     r"(?P<power>[\deE+.-]+)\s+(?P<dm>[\d.]+)\s+(?P<r>[\deE+.-]+)\s+"
     r"(?P<z>[\deE+.-]+)\s+(?P<period_ms>[\deE+.-]+)\s+(?P<freq>[\deE+.-]+)")
 _HIT_RE = re.compile(r"^\s+DM=\s*(?P<dm>[\d.]+)\s+sigma=\s*(?P<sigma>[\d.]+)")

@@ -278,13 +278,20 @@ def stage_candidates(powers: jnp.ndarray, numharm: int, topk: int):
 
 # ----------------------------------------------------------- significance
 
-def sigma_from_power(summed_power, numharm: int):
+def sigma_from_power(summed_power, numharm: int, numindep: int = 1):
     """Equivalent Gaussian significance of a summed power from
     `numharm` harmonics of unit-mean exponential noise.
 
     P(S > s) for S ~ Gamma(n, 1) is the regularized upper incomplete
     gamma Q(n, s); computed in log space so sigma stays finite for
     very strong signals (PRESTO's candidate_sigma equivalent).
+
+    numindep: number of independent trials searched to find this
+    candidate (PRESTO passes the searched bin count per harmonic
+    stage).  The single-trial p-value is corrected to
+    p_corr = 1 - (1 - p)^numindep before conversion, so sigma means
+    "significance given how hard we looked" and matches the scale the
+    reference's sifting thresholds were tuned for.
     """
     s = np.asarray(summed_power, dtype=np.float64)
     n = int(numharm)
@@ -296,6 +303,24 @@ def sigma_from_power(summed_power, numharm: int):
         # large-s: Q(n,s) ~ s^(n-1) e^(-s) / Gamma(n)
         tail = (n - 1) * np.log(np.maximum(s, 1e-30)) - s - sps.gammaln(n)
         logq = np.where(np.isfinite(logq) & (q > 1e-290), logq, tail)
+    if numindep > 1:
+        # log(1 - (1-p)^M) with p = exp(logq).  Two regimes:
+        #   p tiny (logq < -30): p_corr ~ M*p  =>  logq + log M —
+        #     NEVER through exp(logq) (it underflows for strong
+        #     signals, which would cap sigma and create ties);
+        #   otherwise: exact via log1p/exp (safe: logq >= -30).
+        with np.errstate(invalid="ignore", over="ignore",
+                         divide="ignore"):
+            small = logq < -30.0
+            safe_logq = np.clip(logq, -30.0, -1e-17)
+            m_log1mp = numindep * np.log1p(-np.exp(safe_logq))
+            exact = np.where(
+                m_log1mp > -1e-8,
+                # 1-(1-p)^M ~ -M*log(1-p) when tiny
+                np.log(np.maximum(-m_log1mp, 1e-300)),
+                np.log1p(-np.exp(np.clip(m_log1mp, -745.0, -1e-17))))
+            logq = np.where(small, logq + np.log(numindep), exact)
+        logq = np.minimum(logq, 0.0)
     return -sps.ndtri_exp(logq) if hasattr(sps, "ndtri_exp") else \
         sps.ndtri(1.0 - np.exp(logq))
 

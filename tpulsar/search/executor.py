@@ -70,6 +70,8 @@ class SearchParams:
     fold_nbin: int = 64
     fold_npart: int = 32
     max_dms_per_chunk: int = 128    # device memory blocking
+    refine_cands: bool = True       # sub-bin (r, z) refinement of the
+    #                                 reported candidates (harmpolish)
     make_plots: bool = True         # fold + single-pulse PNGs
     low_T_to_search_s: float = 0.0  # skip observations shorter than
     #                                 this (reference set_up_job guard,
@@ -358,7 +360,7 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                             for h in fr.harmonic_stages(
                                 params.lo_accel_numharm)}
                         all_cands.extend(sifting.make_candidates(
-                            res, dm_chunk, T_s, fr.sigma_from_power,
+                            res, dm_chunk, T_s, _lo_sigma_fn(nbins),
                             sigma_min=params.sifting.sigma_threshold))
 
                     if params.run_hi_accel and params.hi_accel_zmax > 0:
@@ -394,20 +396,101 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
 
     sp_events = (np.concatenate(sp_chunks) if sp_chunks else _EMPTY_SP)
 
+    # One consistent bin scale for the reported r column: candidates
+    # from different plan passes carry pass-local (downsampled,
+    # padded) bin units; normalize everything to the full-resolution
+    # padded scale via the invariant frequency.
+    nfft_full = ddplan.choose_n(data.shape[1])
+    T_s_full = nfft_full * dt
+    for c in final:
+        c.r = c.freq_hz * T_s_full
+
+    # Sub-bin refinement of the reported candidates (PRESTO's
+    # harmpolish stage; round-1 verdict missing #3): each fold-worthy
+    # candidate's (r, z) is optimized on a full-resolution series for
+    # its DM, and its sigma recomputed from the refined power.  The
+    # per-DM series are processed group-by-group and only a few are
+    # cached (a long beam's full-resolution series is ~GBs across 100
+    # candidates' DMs).
+    to_refine = [c for c in final if c.sigma >= params.to_prepfold_sigma]
+    to_refine = to_refine[: params.max_cands_to_fold]
+    series_cache: dict[float, np.ndarray] = {}
+
+    def _series_for(dm: float) -> np.ndarray:
+        if dm not in series_cache:
+            while len(series_cache) >= 4:
+                series_cache.pop(next(iter(series_cache)))
+            series_cache[dm] = _dedisperse_single(data, freqs, nsub,
+                                                  dm, dt)
+        return series_cache[dm]
+
+    if params.refine_cands and to_refine:
+        from tpulsar.search import refine
+
+        with timers.timing("refinement"):
+            # lo/hi identity by DETECTION z — refinement perturbs z
+            # off exact zero, which must not flip a lo candidate onto
+            # the hi search's nz-times-larger trial count
+            was_hi = {id(c): abs(c.z) >= accel_k.DZ / 2
+                      for c in to_refine}
+            keep_full = fr.zap_mask(nfft_full // 2 + 1, T_s_full,
+                                    zaplist, baryv) \
+                if zaplist is not None else None
+            by_dm: dict[float, list] = {}
+            for c in to_refine:
+                by_dm.setdefault(c.dm, []).append(c)
+            for dm, group in by_dm.items():
+                refine.refine_candidates(
+                    group, {dm: _series_for(dm)}, dt, nfft_full,
+                    keep_mask=keep_full)
+            nz_hi = (len(_get_bank(params.hi_accel_zmax).zs)
+                     if params.run_hi_accel and params.hi_accel_zmax > 0
+                     else 1)
+            nbins_full = nfft_full // 2 + 1
+            for c in to_refine:
+                # trial count approximated with the full-res bin count
+                # (pass-local counts differ by <= the downsample
+                # factor: a few 0.1 sigma at most)
+                nind = max(1, (nbins_full
+                               * (nz_hi if was_hi[id(c)] else 1))
+                           // c.numharm)
+                c.sigma = float(fr.sigma_from_power(c.power, c.numharm,
+                                                    numindep=nind))
+            final.sort(key=lambda c: -c.sigma)
+
+    # Fold the top of the (possibly re-ranked) list.  Because final is
+    # sigma-descending and the fold set is its >=threshold prefix,
+    # folded[k] corresponds to final[k] — the _cand{k+1} artifacts and
+    # the .accelcands rows stay in one-to-one order (the uploader
+    # pairs them by index).
+    to_fold = [c for c in final if c.sigma >= params.to_prepfold_sigma]
+    to_fold = to_fold[: params.max_cands_to_fold]
     folded: list[fold_k.FoldResult] = []
     with timers.timing("folding"):
-        to_fold = [c for c in final if c.sigma >= params.to_prepfold_sigma]
-        to_fold = to_fold[: params.max_cands_to_fold]
         for c in to_fold:
-            series = _dedisperse_single(data, freqs, nsub, c.dm, dt)
             folded.append(fold_k.fold_and_optimize(
-                series, dt, c.period_s, dm=c.dm,
+                _series_for(c.dm), dt, c.period_s, dm=c.dm,
                 nbin=params.fold_nbin, npart=params.fold_npart))
 
     return final, folded, sp_events, num_trials
 
 
 # ------------------------------------------------------------------ helpers
+
+def _lo_sigma_fn(nbins: int):
+    """Stage sigma with the zero-accel search's trial count: the
+    search examined ~nbins/h independent summed powers per DM per
+    stage (PRESTO passes the same counts to candidate_sigma)."""
+    return lambda p, h: fr.sigma_from_power(
+        p, h, numindep=max(1, nbins // h))
+
+
+def _hi_sigma_fn(nbins: int, nz: int):
+    """Stage sigma with the accelerated search's (r, z) plane trial
+    count."""
+    return lambda p, h: fr.sigma_from_power(
+        p, h, numindep=max(1, (nbins * nz) // h))
+
 
 _EMPTY_SP = np.empty(0, dtype=sp_k.SP_EVENT_DTYPE)
 
@@ -527,7 +610,8 @@ def _hi_accel_pass(wspec, dm_chunk, T_s, params: SearchParams
     # z~0 rows are the lo search's job (z_min_abs); sub-threshold rows
     # never become Python objects (sigma_min pre-filter).
     return sifting.make_candidates(
-        res, dm_chunk, T_s, fr.sigma_from_power,
+        res, dm_chunk, T_s,
+        _hi_sigma_fn(wspec.shape[-1], len(bank.zs)),
         sigma_min=params.sifting.sigma_threshold,
         z_min_abs=accel_k.DZ / 2)
 
@@ -647,7 +731,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
     lo_res = {h: (lo_vals[si, :ndms], lo_bins[si, :ndms])
               for si, h in enumerate(stages_lo)}
     cands = sifting.make_candidates(
-        lo_res, dms, T_s, fr.sigma_from_power,
+        lo_res, dms, T_s, _lo_sigma_fn(nbins),
         sigma_min=params.sifting.sigma_threshold)
     if hi_sharded:
         zs = np.asarray(bank.zs)
@@ -655,7 +739,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
                       zs[hi_zidx[:ndms, si]])
                   for si, h in enumerate(stages_hi)}
         cands.extend(sifting.make_candidates(
-            hi_res, dms, T_s, fr.sigma_from_power,
+            hi_res, dms, T_s, _hi_sigma_fn(nbins, nz),
             sigma_min=params.sifting.sigma_threshold,
             z_min_abs=accel_k.DZ / 2))
     elif hi:

@@ -1,0 +1,162 @@
+"""Sub-bin candidate refinement — the harmpolish equivalent.
+
+PRESTO's accelsearch optimizes each candidate's (r, z) to sub-bin
+precision before reporting (the -harmpolish stage; the reference
+invokes it for every search, lib/python/PALFA2_presto_search.py:561
+and :579 via config.searching accel flags).  Bin-quantized candidates
+lose up to half a Fourier bin of frequency accuracy and up to ~30% of
+peak power (scalloping), which shifts both the reported frequency and
+the significance ordering — the "candidate list identical to PRESTO"
+goal (BASELINE.md) is unreachable without this stage.
+
+Method: the power of a frequency-drifting tone at CONTINUOUS Fourier
+coordinates (r, z) is evaluated by correlating the complex spectrum
+against an analytically generated fractional-offset z-response
+(the same discrete-chirp construction as the search templates in
+kernels/accel.py, but sampled at non-integer bin offsets), and a
+Nelder-Mead simplex maximizes it within +-1 bin in r and +-DZ in z.
+Each harmonic h is refined at (h*r, h*z) and the summed power is
+re-assembled, mirroring harmpolish's per-harmonic optimization.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpulsar.kernels.accel import DZ
+
+def _response_at(z: float, offsets: np.ndarray) -> np.ndarray:
+    """Complex response values of a unit tone drifting z bins,
+    sampled at (possibly fractional) bin offsets from the tone's MEAN
+    frequency.
+
+    Closed form via Fresnel integrals (the continuous limit of the
+    discrete-chirp DFT that builds the search templates in
+    kernels/accel.py):
+      S(u) = e^{-i pi u^2 / z} / sqrt(2 z) * [F(t2) - F(t1)],
+      t1 = -u sqrt(2/z), t2 = (1 - u/z) sqrt(2 z),
+    with u the offset from the START frequency and F = C + iS the
+    Fresnel integral; z < 0 follows from S_{-z}(u) = conj(S_z(-u)),
+    and z -> 0 degenerates to the Dirichlet kernel
+    e^{-i pi u} sinc(u).  O(width) per call instead of the O(N*width)
+    arbitrary-frequency DFT."""
+    offsets = np.asarray(offsets, np.float64)
+    u = offsets + z / 2.0              # offsets from the START freq
+    if abs(z) < 1e-4:
+        return (np.exp(-1j * np.pi * u) * np.sinc(u)).astype(complex)
+    if z < 0:
+        return np.conj(_response_at(-z, -offsets))
+    s1, c1 = _fresnel(-u * np.sqrt(2.0 / z))
+    s2, c2 = _fresnel((1.0 - u / z) * np.sqrt(2.0 * z))
+    f21 = (c2 - c1) + 1j * (s2 - s1)
+    return np.exp(-1j * np.pi * u * u / z) / np.sqrt(2.0 * z) * f21
+
+
+def _fresnel(x):
+    from scipy import special
+    return special.fresnel(x)
+
+
+def power_at(spec: np.ndarray, r: float, z: float,
+             width: int | None = None) -> float:
+    """Normalized power of the whitened complex spectrum `spec` at
+    continuous coordinates (r, z): |matched filter|^2 with the
+    fractional z-response, so a unit-mean-noise spectrum gives
+    Gamma(1,1)-distributed values, same scale as the on-grid search.
+
+    r is the signal's MEAN Fourier frequency in bins — the convention
+    of the search plane (kernels/accel.py aligns plane index with the
+    response center, which gen_z_response puts at the mean frequency)
+    and therefore of every Candidate's r/freq fields.
+
+    width defaults to the search templates' sizing rule
+    (kernels/accel.py template_width: the drift extent plus Fresnel
+    ringing) — a fixed window would truncate high-|z| responses and
+    deflate the refined power."""
+    from tpulsar.kernels.accel import template_width
+
+    if width is None:
+        width = template_width(abs(z))
+    nbins = spec.shape[-1]
+    center = r
+    k0 = int(round(center)) - width // 2
+    k0 = max(1, min(k0, max(1, nbins - width - 1)))
+    kend = min(k0 + width, nbins)
+    ks = np.arange(k0, kend)
+    resp = _response_at(z, ks - center)
+    seg = np.asarray(spec[k0: kend])
+    norm = float(np.sum(np.abs(resp) ** 2))
+    if norm <= 0:
+        return 0.0
+    return float(np.abs(np.vdot(resp, seg)) ** 2 / norm)
+
+
+def refine_peak(spec: np.ndarray, r0: float, z0: float,
+                numharm: int = 1, width: int | None = None,
+                max_dr: float = 1.0, max_dz: float = DZ
+                ) -> tuple[float, float, float]:
+    """Maximize the harmonic-summed power around (r0, z0).
+
+    Returns (r, z, summed_power) with r the refined FUNDAMENTAL bin
+    (possibly fractional) and summed_power = sum_h P(h*r, h*z) —
+    the quantity PRESTO's harmpolish reports.  The simplex search is
+    bounded to +-max_dr / +-max_dz around the grid detection (the
+    true peak of a detected signal is within half a grid cell).
+    """
+    from scipy import optimize
+
+    def neg_summed(x):
+        r, z = x
+        if abs(r - r0) > max_dr or abs(z - z0) > max_dz:
+            return 0.0        # outside the trust region: no credit
+        return -sum(power_at(spec, h * r, h * z, width=width)
+                    for h in range(1, numharm + 1))
+
+    res = optimize.minimize(
+        neg_summed, x0=[r0, z0], method="Nelder-Mead",
+        options={"xatol": 1e-3, "fatol": 1e-4, "maxfev": 120})
+    r, z = float(res.x[0]), float(res.x[1])
+    best = -float(res.fun)
+    grid = sum(power_at(spec, h * r0, h * z0, width=width)
+               for h in range(1, numharm + 1))
+    if grid > best:           # optimizer wandered; keep the grid point
+        return r0, z0, grid
+    return r, z, best
+
+
+def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
+                      keep_mask=None) -> None:
+    """Refine a list of sifting.Candidate IN PLACE.
+
+    series_by_dm: {dm: (T,) float array} at FULL time resolution —
+    candidates are grouped by DM so each series is FFT'd and whitened
+    once.  A candidate's r is in its detection pass's (downsampled,
+    padded) bin units, so the invariant freq_hz maps it onto this
+    series' scale: r0 = freq_hz * T_s.  Power, r, z, freq and period
+    fields are updated; sigma itself is the caller's to recompute
+    (it owns the trials correction).
+    """
+    import jax.numpy as jnp
+
+    from tpulsar.kernels import fourier as fr
+
+    by_dm: dict[float, list] = {}
+    for c in cands:
+        by_dm.setdefault(c.dm, []).append(c)
+    T_s = nfft * dt
+    for dm, group in by_dm.items():
+        if dm not in series_by_dm:
+            continue
+        series = jnp.asarray(series_by_dm[dm])[None, :]
+        spec = fr.complex_spectrum(fr.pad_series(series, nfft))
+        powers, wpow = fr.whitened_powers(
+            spec, jnp.asarray(keep_mask) if keep_mask is not None
+            else None)
+        wspec = np.asarray(fr.scale_spectrum(spec, powers, wpow))[0]
+        for c in group:
+            r0 = c.freq_hz * T_s
+            r, z, power = refine_peak(wspec, r0, c.z,
+                                      numharm=c.numharm)
+            c.r, c.z, c.power = r, z, power
+            c.freq_hz = r / T_s
+            c.period_s = T_s / r
