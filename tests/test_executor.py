@@ -49,7 +49,17 @@ def test_finds_injected_pulsar(beam_outcome):
 def test_folding_confirms(beam_outcome):
     out = beam_outcome
     assert len(out.folded) >= 1
-    assert out.folded[0].reduced_chi2 > 2.0
+    best = out.folded[0]
+    assert best.reduced_chi2 > 2.0
+    # the rules-based fold searched a DM axis around the sifted DM and
+    # must stay near the injected DM (round-1 verdict missing #4)
+    assert abs(best.dm - DM_TRUE) < 6.0
+    # period refined by the fold stays on the injected value (or a
+    # harmonic of it)
+    ratio = best.period_s / P_TRUE
+    assert min(abs(ratio - r) for r in (1.0, 0.5, 2.0, 1 / 3)) < 0.01
+    # period-tier geometry applied (P~0.075-0.15 s -> the 100-bin tier)
+    assert best.nbin == 100 and best.npart == 30
 
 
 def test_artifacts_written(beam_outcome):

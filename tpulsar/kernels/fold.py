@@ -36,6 +36,7 @@ class FoldResult:
     reduced_chi2: float
     delta_p: float             # offset applied by optimization
     delta_pdot: float
+    delta_dm: float = 0.0      # DM offset found by the fold search
 
     def bestprof_text(self, source: str = "") -> str:
         """Summary block in the spirit of prepfold's .bestprof."""
@@ -49,9 +50,49 @@ class FoldResult:
             f"# Reduced chi-sqr = {self.reduced_chi2:.4f}",
             f"# dP opt (s) = {self.delta_p:.6e}",
             f"# dPdot opt = {self.delta_pdot:.6e}",
+            f"# dDM opt = {self.delta_dm:.4f}",
         ]
         lines += [f"{i:4d} {v:.7g}" for i, v in enumerate(self.profile)]
         return "\n".join(lines) + "\n"
+
+
+@dataclasses.dataclass(frozen=True)
+class FoldRules:
+    """Period-dependent fold-search parameters — the reference's
+    prepfold command rules (PALFA2_presto_search.py:195-211): 24-200
+    profile bins, fewer subints for slow pulsars, no pdot search for
+    the slowest (RFI), and p/pdot/DM factors Mp/Mdm that set the
+    search extent in profile-bin-drift units."""
+    nbin: int
+    npart: int
+    mp: int                 # -npfact: p/pdot extent = +-mp*nbin steps
+    mdm: int                # -ndmfact: DM extent = +-mdm*nbin steps
+    search_pdot: bool
+    pstep: int = 1          # grid strides in bin-drift units
+    pdstep: int = 2
+    dmstep: int = 1
+
+
+def fold_rules(period_s: float, numrows: int | None = None) -> FoldRules:
+    """The reference's period tiers (PALFA2_presto_search.py:195-211).
+    numrows clamps npart like the reference's PSRFITS-row guard
+    (:215-221)."""
+    p = period_s
+    if p < 0.002:
+        r = FoldRules(nbin=24, npart=50, mp=2, mdm=2,
+                      search_pdot=True, dmstep=3)
+    elif p < 0.05:
+        r = FoldRules(nbin=50, npart=40, mp=2, mdm=1,
+                      search_pdot=True, dmstep=3)
+    elif p < 0.5:
+        r = FoldRules(nbin=100, npart=30, mp=1, mdm=1,
+                      search_pdot=True)
+    else:
+        r = FoldRules(nbin=200, npart=30, mp=1, mdm=1,
+                      search_pdot=False)
+    if numrows is not None and r.npart > numrows:
+        r = dataclasses.replace(r, npart=max(1, numrows))
+    return r
 
 
 def phase_bins(T: int, dt: float, period: float, pdot: float,
@@ -124,6 +165,181 @@ def _grid_chi2(subints: jnp.ndarray, counts: jnp.ndarray,
     return jax.vmap(lambda dp: jax.vmap(lambda dd: chi_for(dp, dd))(dpdots))(dps)
 
 
+@partial(jax.jit, static_argnames=("nbin", "npart", "nsub"))
+def _fold_subbands_with_bins(subb: jnp.ndarray, idx: jnp.ndarray,
+                             nbin: int, npart: int, nsub: int):
+    """subb (nsub, T) + per-sample (part*nbin + bin) index -> per
+    (part, sub, bin) profiles and counts."""
+    T = subb.shape[1]
+    sub_off = (jnp.arange(nsub, dtype=jnp.int32) * nbin)[:, None]
+    full = (idx[None, :] // nbin) * (nsub * nbin) \
+        + sub_off + (idx[None, :] % nbin)
+    prof = jnp.zeros(npart * nsub * nbin, subb.dtype).at[
+        full.reshape(-1)].add(subb.reshape(-1))
+    counts = jnp.zeros(npart * nsub * nbin, jnp.float32).at[
+        full.reshape(-1)].add(1.0)
+    return (prof.reshape(npart, nsub, nbin),
+            counts.reshape(npart, nsub, nbin))
+
+
+@partial(jax.jit, static_argnames=("nbin",))
+def _dm_grid_chi2(stack: jnp.ndarray, counts: jnp.ndarray,
+                  part_shifts: jnp.ndarray, all_sub_shifts: jnp.ndarray,
+                  nbin: int):
+    """chi2 for every DM trial's per-subband shift row, vmapped."""
+    def one(sub_sh):
+        prof = _shift_sum_cube(stack, part_shifts, sub_sh, nbin)
+        csum = _shift_sum_cube(counts, part_shifts, sub_sh, nbin)
+        return _profile_chi2(prof, csum)
+
+    return jax.vmap(one)(all_sub_shifts)
+
+
+@partial(jax.jit, static_argnames=("nbin",))
+def _shift_sum_cube(stack: jnp.ndarray, part_shifts: jnp.ndarray,
+                    sub_shifts: jnp.ndarray, nbin: int):
+    """Roll stack[i, s] by part_shifts[i] + sub_shifts[s] bins and sum
+    over both axes -> (nbin,)."""
+    total = (part_shifts[:, None] + sub_shifts[None, :]) % nbin
+    idx = (jnp.arange(nbin)[None, None, :] + total[..., None]) % nbin
+    return jnp.take_along_axis(stack, idx, axis=2).sum(axis=(0, 1))
+
+
+def _pp_shifts(dp, dpd, part_times, period, nbin):
+    """Integer profile-bin shift per subint for a (dp, dpdot) offset
+    (one definition — three call sites fold with it)."""
+    t = np.asarray(part_times, np.float64)
+    dphi = -(dp * t + 0.5 * dpd * t * t) / period ** 2
+    return jnp.asarray(np.round(dphi * nbin).astype(np.int32))
+
+
+def _dm_bin_shifts(ddm, sub_freqs_mhz, ref_mhz, period, nbin):
+    """Profile-bin shift per subband for a DM offset ddm."""
+    from tpulsar.constants import KDM
+
+    dt_s = KDM * ddm * (np.asarray(sub_freqs_mhz, np.float64) ** -2
+                        - ref_mhz ** -2)
+    return np.round(dt_s / period * nbin).astype(np.int32)
+
+
+def fold_subbands_and_optimize(
+        subbands: np.ndarray | jnp.ndarray, sub_freqs_mhz: np.ndarray,
+        dt: float, period: float, dm: float, pdot: float = 0.0,
+        rules: FoldRules | None = None,
+        sub_shifts_dm0: np.ndarray | None = None) -> FoldResult:
+    """Fold subbands and refine the candidate over (p, pdot, DM).
+
+    The reference folds subband files precisely so prepfold can search
+    the DM axis cheaply (PALFA2_presto_search.py:168-175): a DM offset
+    is a per-subband phase rotation of already-folded profiles, not a
+    re-fold.  This is the same scheme on device: profiles are
+    accumulated per (subint, subband, bin) once, then the (p, pdot)
+    and DM axes are searched by rolling the stack — coordinate descent
+    (p/pdot grid, DM grid, p/pdot again) instead of prepfold's full
+    cube; the axes' phase shifts are additive, so the alternating
+    search converges to the same optimum for any real peak.
+
+    subbands: (nsub, T), each internally dedispersed to `dm` but with
+    inter-subband delays intact (form_subbands stage-1 output).
+    sub_shifts_dm0: integer sample shift per subband aligning the
+    subbands at `dm` (plan_pass_shifts stage-2 row); None = already
+    aligned.
+    """
+    rules = rules or fold_rules(period)
+    nbin, npart = rules.nbin, rules.npart
+    subb = jnp.asarray(subbands, jnp.float32)
+    nsub, T = subb.shape
+    if sub_shifts_dm0 is not None:
+        from tpulsar.kernels.dedisperse import _shift_gather
+
+        subb = _shift_gather(subb, jnp.asarray(
+            np.asarray(sub_shifts_dm0, np.int32)))
+    # unit variance per subband so the chi2's variance model holds
+    subb = (subb - subb.mean(axis=1, keepdims=True)) \
+        / jnp.maximum(subb.std(axis=1, keepdims=True), 1e-9)
+
+    T_s = T * dt
+    bins = phase_bins(T, dt, period, pdot, nbin)
+    part = np.minimum(np.arange(T, dtype=np.int64) * npart // T,
+                      npart - 1)
+    idx = jnp.asarray((part * nbin + bins).astype(np.int32))
+    stack, counts = _fold_subbands_with_bins(subb, idx, nbin, npart,
+                                             nsub)
+
+    part_times = (jnp.arange(npart, dtype=jnp.float32) + 0.5) \
+        * (T_s / npart)
+    ref_mhz = float(np.asarray(sub_freqs_mhz)[-1])
+
+    # grid axes in profile-bin-drift units (prepfold's step unit);
+    # grids are built symmetric around 0 (0 MUST be a grid point: the
+    # nominal parameters have to be testable)
+    def _sym_grid(extent: int, step: int) -> np.ndarray:
+        pos = np.arange(0, extent + 1, step)
+        return np.concatenate([-pos[:0:-1], pos]).astype(np.float64)
+
+    dp_unit = period ** 2 / (nbin * T_s)
+    dpd_unit = 2.0 * period ** 2 / (nbin * T_s ** 2)
+    dps = _sym_grid(rules.mp * nbin, rules.pstep) * dp_unit
+    if rules.search_pdot:
+        dpds = _sym_grid(rules.mp * nbin, rules.pdstep) * dpd_unit
+    else:
+        dpds = np.zeros(1)
+    # DM unit: offset smearing one profile bin across the band
+    from tpulsar.constants import KDM
+    band_span = (float(np.asarray(sub_freqs_mhz)[0]) ** -2
+                 - ref_mhz ** -2)
+    ddm_unit = period / (nbin * KDM * max(band_span, 1e-12))
+    ddms = _sym_grid(rules.mdm * nbin, rules.dmstep) * ddm_unit
+
+    zero_sub = jnp.zeros(nsub, jnp.int32)
+
+    def pp_scan(sub_sh):
+        """(p, pdot) grid at fixed per-subband shifts -> best point.
+        Collapses the subband axis once at this DM, then reuses the
+        2D subint machinery."""
+        idxs = (jnp.arange(nbin)[None, :] + sub_sh[:, None]) % nbin
+        coll = jnp.take_along_axis(stack, idxs[None, :, :],
+                                   axis=2).sum(axis=1)
+        ccoll = jnp.take_along_axis(counts, idxs[None, :, :],
+                                    axis=2).sum(axis=1)
+        chi = np.asarray(_grid_chi2(coll, ccoll, part_times,
+                                    jnp.asarray(dps, jnp.float32),
+                                    jnp.asarray(dpds, jnp.float32),
+                                    period, nbin))
+        i, j = np.unravel_index(np.argmax(chi), chi.shape)
+        return float(dps[i]), float(dpds[j]), coll, ccoll
+
+    # round 1: p/pdot at the nominal DM
+    best_dp, best_dpd, _, _ = pp_scan(zero_sub)
+
+    # DM axis at the best (p, pdot) — one batched launch over the
+    # whole ddm grid (a per-point python loop would cost two kernel
+    # launches + a device sync per DM trial)
+    part_sh = _pp_shifts(best_dp, best_dpd, part_times, period, nbin)
+    all_sub_sh = jnp.asarray(np.stack([
+        _dm_bin_shifts(d, sub_freqs_mhz, ref_mhz, period, nbin)
+        for d in ddms]))
+    chis = np.asarray(_dm_grid_chi2(stack, counts, part_sh,
+                                    all_sub_sh, nbin))
+    best_ddm = float(ddms[int(np.argmax(chis))])
+
+    # round 2: p/pdot again at the best DM
+    best_sub_sh = jnp.asarray(_dm_bin_shifts(best_ddm, sub_freqs_mhz,
+                                             ref_mhz, period, nbin))
+    best_dp, best_dpd, coll, ccoll = pp_scan(best_sub_sh)
+
+    shifts = _pp_shifts(best_dp, best_dpd, part_times, period, nbin)
+    prof = np.asarray(_shift_and_sum(coll, shifts, nbin))
+    csum = np.asarray(_shift_and_sum(ccoll, shifts, nbin))
+    red_chi2 = float(np.asarray(_profile_chi2(jnp.asarray(prof),
+                                              jnp.asarray(csum))))
+    return FoldResult(
+        period_s=period - best_dp, pdot=pdot - best_dpd,
+        dm=dm + best_ddm, nbin=nbin, npart=npart, profile=prof,
+        subints=np.asarray(coll), reduced_chi2=red_chi2,
+        delta_p=best_dp, delta_pdot=best_dpd, delta_dm=best_ddm)
+
+
 def fold_and_optimize(series: np.ndarray | jnp.ndarray, dt: float,
                       period: float, pdot: float = 0.0, dm: float = 0.0,
                       nbin: int = 64, npart: int = 32,
@@ -153,9 +369,8 @@ def fold_and_optimize(series: np.ndarray | jnp.ndarray, dt: float,
     best_dp = float(np.asarray(dps)[pi])
     best_dpd = float(np.asarray(dpdots)[pdi])
 
-    dphi = -(best_dp * np.asarray(part_times)
-             + 0.5 * best_dpd * np.asarray(part_times) ** 2) / period ** 2
-    shifts = jnp.asarray(np.round(dphi * nbin).astype(np.int32))
+    shifts = _pp_shifts(best_dp, best_dpd, np.asarray(part_times),
+                        period, nbin)
     prof = np.asarray(_shift_and_sum(subints, shifts, nbin))
     csum = np.asarray(_shift_and_sum(counts, shifts, nbin))
     red_chi2 = float(np.asarray(_profile_chi2(jnp.asarray(prof),

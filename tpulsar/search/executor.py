@@ -67,6 +67,11 @@ class SearchParams:
         default_factory=sifting.SiftParams)
     to_prepfold_sigma: float = 6.0  # :44
     max_cands_to_fold: int = 100    # :45
+    fold_by_rules: bool = True      # period-tier nbin/npart/extents +
+    #                                 subband fold with a DM search
+    #                                 axis (PALFA2_presto_search.py:
+    #                                 195-211); False = fixed-geometry
+    #                                 series fold below
     fold_nbin: int = 64
     fold_npart: int = 32
     max_dms_per_chunk: int = 128    # device memory blocking
@@ -414,15 +419,8 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     # candidates' DMs).
     to_refine = [c for c in final if c.sigma >= params.to_prepfold_sigma]
     to_refine = to_refine[: params.max_cands_to_fold]
-    series_cache: dict[float, np.ndarray] = {}
-
-    def _series_for(dm: float) -> np.ndarray:
-        if dm not in series_cache:
-            while len(series_cache) >= 4:
-                series_cache.pop(next(iter(series_cache)))
-            series_cache[dm] = _dedisperse_single(data, freqs, nsub,
-                                                  dm, dt)
-        return series_cache[dm]
+    _series_for = _BoundedCache(
+        lambda dm: _dedisperse_single(data, freqs, nsub, dm, dt))
 
     if params.refine_cands and to_refine:
         from tpulsar.search import refine
@@ -465,17 +463,64 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     # pairs them by index).
     to_fold = [c for c in final if c.sigma >= params.to_prepfold_sigma]
     to_fold = to_fold[: params.max_cands_to_fold]
-    folded: list[fold_k.FoldResult] = []
+    folded_by_idx: dict[int, fold_k.FoldResult] = {}
+
+    def _subbands_for(dm: float):
+        ch_sh, sub_sh = dd.plan_pass_shifts(freqs, nsub, dm, [dm],
+                                            dt, 1)
+        return (dd.form_subbands(data, jnp.asarray(ch_sh), nsub, 1),
+                sub_sh[0])
+
     with timers.timing("folding"):
-        for c in to_fold:
-            folded.append(fold_k.fold_and_optimize(
-                _series_for(c.dm), dt, c.period_s, dm=c.dm,
-                nbin=params.fold_nbin, npart=params.fold_npart))
+        # group by DM so each DM's subband block is formed once even
+        # when same-DM candidates interleave in the sigma ordering
+        fold_groups: dict[float, list[int]] = {}
+        for k, c in enumerate(to_fold):
+            fold_groups.setdefault(c.dm, []).append(k)
+        for dm, idxs in fold_groups.items():
+            if params.fold_by_rules:
+                # fold from subbands so the DM axis is a per-subband
+                # phase rotation (the reference folds subband files
+                # for the same reason, PALFA2_presto_search.py:168-175)
+                subb_f, sub_sh0 = _subbands_for(dm)
+                subrefs = dd.subband_reference_freqs(freqs, nsub)
+                for k in idxs:
+                    c = to_fold[k]
+                    folded_by_idx[k] = fold_k.fold_subbands_and_optimize(
+                        subb_f, subrefs, dt, c.period_s, dm=dm,
+                        rules=fold_k.fold_rules(c.period_s),
+                        sub_shifts_dm0=sub_sh0)
+                del subb_f
+            else:
+                for k in idxs:
+                    c = to_fold[k]
+                    folded_by_idx[k] = fold_k.fold_and_optimize(
+                        _series_for(c.dm), dt, c.period_s, dm=c.dm,
+                        nbin=params.fold_nbin, npart=params.fold_npart)
+    folded = [folded_by_idx[k] for k in range(len(to_fold))]
 
     return final, folded, sp_events, num_trials
 
 
 # ------------------------------------------------------------------ helpers
+
+class _BoundedCache:
+    """Tiny FIFO-bounded memo for per-DM device arrays (a long
+    beam's full-resolution series is too big to keep one per
+    candidate DM)."""
+
+    def __init__(self, fn, capacity: int = 4):
+        self._fn = fn
+        self._cap = capacity
+        self._d: dict = {}
+
+    def __call__(self, key):
+        if key not in self._d:
+            while len(self._d) >= self._cap:
+                self._d.pop(next(iter(self._d)))
+            self._d[key] = self._fn(key)
+        return self._d[key]
+
 
 def _lo_sigma_fn(nbins: int):
     """Stage sigma with the zero-accel search's trial count: the
