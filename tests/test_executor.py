@@ -30,8 +30,12 @@ def beam_outcome(tmp_path_factory):
     params = executor.SearchParams(
         nsub=24, hi_accel_zmax=8, topk_per_stage=16,
         max_cands_to_fold=5, fold_nbin=32, fold_npart=8)
+    from tpulsar.kernels.fourier import parse_zaplist
+    zap = parse_zaplist(os.path.join(
+        os.path.dirname(executor.__file__), "..", "data",
+        "default.zaplist"))
     out = executor.search_beam(fns, str(root / "work"), str(root / "results"),
-                               params=params, plan=plan)
+                               params=params, plan=plan, zaplist=zap)
     return out
 
 
@@ -123,6 +127,46 @@ def test_diagnostics_include_plots(beam_outcome):
     names = [d.name for d in diags]
     assert sum(1 for n in names if n.startswith("Single-pulse plot")) == 3
     assert any(n.startswith("RFI mask") for n in names)
+
+
+def test_diagnostics_cover_reference_set(beam_outcome):
+    """Every reference diagnostic type (diagnostics.py:667-681, 14
+    entries) has an equivalent here (round-1 verdict missing #6)."""
+    from tpulsar.orchestrate.diagnostics import get_diagnostics
+    diags = get_diagnostics(beam_outcome.resultsdir, beam_outcome.basenm)
+    names = {d.name for d in diags}
+    # reference type -> our diagnostic name (or prefix)
+    required = [
+        "RFI mask percentage",          # RFIPercentageDiagnostic
+        "RFI mask",                     # RFIPlotDiagnostic
+        "Accel cands",                  # AccelCandsDiagnostic
+        "Num cands folded",             # NumFoldedDiagnostic
+        "Num candidates sifted",        # NumCandsDiagnostic
+        "Min sigma folded",             # MinSigmaFoldedDiagnostic
+        "Num cands above threshold",    # NumAboveThreshDiagnostic
+        "Zaplist used",                 # ZaplistUsed
+        "Search parameters",            # SearchParameters
+        "Sigma threshold",              # SigmaThreshold
+        "Max cands allowed to fold",    # MaxCandsToFold
+        "Percent zapped total",         # PercentZappedTotal
+        "Percent zapped below 10 Hz",   # PercentZappedBelow10Hz
+        "Percent zapped below 1 Hz",    # PercentZappedBelow1Hz
+    ]
+    missing = [r for r in required if r not in names]
+    assert not missing, f"missing diagnostics: {missing} (have {names})"
+    assert len(required) == 14
+    # zap percentages are sane fractions
+    zap_pcts = {d.name: d.value for d in diags
+                if d.name.startswith("Percent zapped")}
+    for name, val in zap_pcts.items():
+        assert 0.0 <= val <= 100.0, (name, val)
+    # default zaplist: 0.5 Hz birdie (width 0.05) + half the 1.0 Hz
+    # one inside [1/15, 1] Hz -> 0.075 / 0.9333 Hz
+    assert zap_pcts["Percent zapped below 1 Hz"] == pytest.approx(
+        100.0 * 0.075 / (1.0 - 1.0 / 15.0), rel=1e-3)
+    # the narrow-band birdies cover far less of the full searched band
+    assert (zap_pcts["Percent zapped total"]
+            < zap_pcts["Percent zapped below 1 Hz"])
 
 
 def test_pass_checkpoint_resume(tmp_path):

@@ -410,3 +410,69 @@ def test_http_restore_service_and_transport(tmp_path):
         assert dst.stat().st_size == 100
     finally:
         srv.shutdown()
+
+
+def test_zaplist_refresh_modtime_semantics(tmp_path):
+    """Remote-zaplist refresh: fetch when newer, skip when cached copy
+    is current, force overrides (reference pipeline_utils.py:191-219)."""
+    import tarfile
+    import time
+
+    from tpulsar.orchestrate.zaplists import refresh_zaplists
+
+    remote = tmp_path / "remote"
+    remote.mkdir()
+    (remote / "b1.zaplist").write_text("60.0 0.05\n")
+    (remote / "evil.txt").write_text("not a zaplist\n")
+    tarpath = remote / "zaplists.tar.gz"
+    with tarfile.open(tarpath, "w:gz") as tf:
+        tf.add(remote / "b1.zaplist", arcname="b1.zaplist")
+        tf.add(remote / "evil.txt", arcname="../evil.txt")
+
+    zapdir = str(tmp_path / "zaps")
+    assert refresh_zaplists(zapdir, str(remote)) is True
+    assert os.path.exists(os.path.join(zapdir, "b1.zaplist"))
+    # non-zaplist / path-escaping members are never extracted
+    assert not os.path.exists(os.path.join(zapdir, "..", "evil.txt"))
+    assert not os.path.exists(os.path.join(zapdir, "evil.txt"))
+
+    # cached copy is current -> no refresh
+    assert refresh_zaplists(zapdir, str(remote)) is False
+    # remote becomes newer -> refresh
+    future = time.time() + 60
+    os.utime(tarpath, (future, future))
+    assert refresh_zaplists(zapdir, str(remote)) is True
+    # force always refreshes
+    assert refresh_zaplists(zapdir, str(remote), force=True) is True
+
+
+def test_zaplist_refresh_removes_stale_lists(tmp_path):
+    """Lists deleted from the remote tarball disappear locally on the
+    next refresh; operator-placed local lists survive."""
+    import tarfile
+    import time
+
+    from tpulsar.orchestrate.zaplists import refresh_zaplists
+
+    remote = tmp_path / "remote"
+    remote.mkdir()
+    (remote / "a.zaplist").write_text("60.0 0.05\n")
+    (remote / "b.zaplist").write_text("120.0 0.1\n")
+    tarpath = remote / "zaplists.tar.gz"
+    with tarfile.open(tarpath, "w:gz") as tf:
+        tf.add(remote / "a.zaplist", arcname="a.zaplist")
+        tf.add(remote / "b.zaplist", arcname="b.zaplist")
+
+    zapdir = tmp_path / "zaps"
+    assert refresh_zaplists(str(zapdir), str(remote)) is True
+    (zapdir / "operator.zaplist").write_text("0.5 0.05\n")
+
+    # republished tarball without b.zaplist
+    with tarfile.open(tarpath, "w:gz") as tf:
+        tf.add(remote / "a.zaplist", arcname="a.zaplist")
+    future = time.time() + 60
+    os.utime(tarpath, (future, future))
+    assert refresh_zaplists(str(zapdir), str(remote)) is True
+    assert (zapdir / "a.zaplist").exists()
+    assert not (zapdir / "b.zaplist").exists()      # stale: removed
+    assert (zapdir / "operator.zaplist").exists()   # untouched

@@ -140,9 +140,20 @@ def main(argv=None) -> int:
     try:
         staged = stage_in(fns, workdir)
         ppfns = datafile.preprocess(staged)
+        zapdir = args.zaplist_dir or cfg.processing.zaplistdir or None
+        if zapdir and cfg.processing.zaplist_url:
+            # refresh the custom-zaplist cache when the remote tarball
+            # is newer; a refresh failure must not fail the search —
+            # the cached lists (or the default) still apply
+            from tpulsar.orchestrate.zaplists import refresh_zaplists
+            try:
+                refresh_zaplists(zapdir, cfg.processing.zaplist_url)
+            except Exception as e:
+                warnings.warn(f"zaplist refresh from "
+                              f"{cfg.processing.zaplist_url} failed: {e}")
         zap = choose_zaplist(
             ppfns,
-            args.zaplist_dir or cfg.processing.zaplistdir or None,
+            zapdir,
             args.default_zaplist or cfg.processing.default_zaplist or None)
         params = executor.SearchParams.from_config(cfg.searching)
         if args.no_accel:

@@ -70,6 +70,11 @@ class ProcessingConfig:
     base_results_directory: str = "/tmp/tpulsar/results"
     zaplistdir: str = ""
     default_zaplist: str = ""
+    zaplist_url: str = ""   # remote custom-zaplist tarball location
+    #                         (http(s) base URL or local dir); when
+    #                         set, workers refresh zaplistdir before
+    #                         searching (reference pipeline_utils.py:
+    #                         191-219 FTP-modtime refresh)
     num_cores: int = 1
     use_subbands: bool = True
 

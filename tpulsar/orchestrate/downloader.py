@@ -56,6 +56,9 @@ class LocalTransport:
     def size(self, path: str) -> int:
         return os.path.getsize(os.path.join(self.root, path))
 
+    def modtime(self, path: str) -> float:
+        return os.path.getmtime(os.path.join(self.root, path))
+
     def fetch(self, path: str, dst: str) -> None:
         self._fetches += 1
         if self.fail_every and self._fetches % self.fail_every == 0:
@@ -87,6 +90,20 @@ class HTTPTransport:
                                      method="HEAD")
         with urllib.request.urlopen(req) as resp:
             return int(resp.headers["Content-Length"])
+
+    def modtime(self, path: str) -> float:
+        """Last-Modified of the remote file as a unix timestamp
+        (0.0 when the server does not report one: callers treat that
+        as 'not newer than any local copy')."""
+        import urllib.request
+        from email.utils import parsedate_to_datetime
+        req = urllib.request.Request(f"{self.base_url}/{path}",
+                                     method="HEAD")
+        with urllib.request.urlopen(req) as resp:
+            lm = resp.headers.get("Last-Modified")
+        if not lm:
+            return 0.0
+        return parsedate_to_datetime(lm).timestamp()
 
     def fetch(self, path: str, dst: str) -> None:
         import urllib.request

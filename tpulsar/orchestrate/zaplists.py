@@ -1,0 +1,112 @@
+"""Remote custom-zaplist refresh.
+
+The reference keeps per-beam custom zaplists in a tarball on the
+Cornell FTP server and refreshes the local copy when the remote
+modification time is newer (lib/python/pipeline_utils.py:191-219,
+get_zaplist_tarball).  Same semantics here over the framework's own
+transports: HTTP(S) for production, a plain directory for hermetic
+tests.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+
+from tpulsar.obs.log import get_logger
+
+log = get_logger("zaplists")
+
+_MANIFEST = ".extracted_zaplists"
+
+
+def _transport_for(url: str):
+    from tpulsar.orchestrate.downloader import HTTPTransport, LocalTransport
+
+    if url.startswith(("http://", "https://")):
+        return HTTPTransport(url)
+    return LocalTransport(url.removeprefix("file://"))
+
+
+def refresh_zaplists(zapdir: str, url: str,
+                     remote_path: str = "zaplists.tar.gz",
+                     force: bool = False) -> bool:
+    """Fetch the custom-zaplist tarball when the remote copy is newer
+    than the cached one (or `force`), and extract its *.zaplist
+    members into zapdir.  Returns True when a refresh happened.
+
+    url: base URL (http(s)://...) or a local/file:// directory.
+
+    Staleness is judged by comparing the remote modification time to
+    the cached tarball's mtime, which is SET to the remote time after
+    every fetch — comparing against the local download wall-clock
+    would break under clock skew (a transport reporting no modtime
+    returns 0.0, i.e. 'never newer': such a store only refreshes with
+    force=True).  Extraction happens before the tarball is committed
+    to its final path, so a crash mid-refresh retries from scratch,
+    and zaplists extracted by a previous refresh are removed first so
+    lists deleted from the remote tarball do not persist locally
+    (operator-placed files that never came from the tarball are left
+    alone).
+    """
+    os.makedirs(zapdir, exist_ok=True)
+    local_tar = os.path.join(zapdir, os.path.basename(remote_path))
+    transport = _transport_for(url)
+    if not force and os.path.exists(local_tar):
+        remote_mtime = transport.modtime(remote_path)
+        if remote_mtime <= os.path.getmtime(local_tar):
+            return False
+    tmp = local_tar + ".part"
+    transport.fetch(remote_path, tmp)
+    _remove_previously_extracted(zapdir)
+    names = _extract_zaplists(tmp, zapdir)
+    _write_manifest(zapdir, names)
+    # commit LAST: an interrupted refresh leaves no current-looking
+    # tarball behind, so the next run redoes fetch + extraction
+    os.replace(tmp, local_tar)
+    try:
+        remote_mtime = transport.modtime(remote_path)
+        if remote_mtime > 0:
+            os.utime(local_tar, (remote_mtime, remote_mtime))
+    except (OSError, NotImplementedError, AttributeError):
+        pass
+    log.info("refreshed %d custom zaplists from %s", len(names), url)
+    return True
+
+
+def _remove_previously_extracted(zapdir: str) -> None:
+    path = os.path.join(zapdir, _MANIFEST)
+    if not os.path.exists(path):
+        return
+    with open(path) as fh:
+        for name in fh.read().splitlines():
+            name = os.path.basename(name.strip())
+            if name.endswith(".zaplist"):
+                try:
+                    os.remove(os.path.join(zapdir, name))
+                except OSError:
+                    pass
+    os.remove(path)
+
+
+def _write_manifest(zapdir: str, names: list[str]) -> None:
+    with open(os.path.join(zapdir, _MANIFEST), "w") as fh:
+        fh.write("\n".join(names) + ("\n" if names else ""))
+
+
+def _extract_zaplists(tarpath: str, zapdir: str) -> list[str]:
+    """Extract only flat *.zaplist members (no paths escaping zapdir).
+    Returns the extracted file names."""
+    names: list[str] = []
+    with tarfile.open(tarpath) as tf:
+        for member in tf.getmembers():
+            name = os.path.basename(member.name)
+            if not (member.isfile() and name.endswith(".zaplist")):
+                continue
+            src = tf.extractfile(member)
+            if src is None:
+                continue
+            with open(os.path.join(zapdir, name), "wb") as out:
+                out.write(src.read())
+            names.append(name)
+    return names

@@ -195,6 +195,15 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     accelcands.write_candlist(
         final, os.path.join(resultsdir, f"{basenm}.accelcands"),
         baryv=baryv)
+    if zaplist is not None and len(zaplist):
+        # the zaplist used travels with the results (the reference
+        # keeps it beside the beam for the zap-percentage diagnostics,
+        # diagnostics.py:452-520)
+        with open(os.path.join(resultsdir, f"{basenm}.zaplist"),
+                  "w") as fh:
+            fh.write("# freq_Hz width_Hz (zaplist used)\n")
+            for freq, width in np.atleast_2d(zaplist):
+                fh.write(f"{freq:12.4f} {width:10.4f}\n")
     _write_sp_files(resultsdir, basenm, sp_events)
     for step in plan:
         for ppass in step.passes():
