@@ -186,8 +186,10 @@ def run_measured() -> None:
         plan = [ddplan.DedispStep(s.lodm, s.dmstep, s.dms_per_pass,
                                   max(1, int(s.numpasses * scale)),
                                   s.numsub, s.downsamp) for s in plan]
-    params = executor.SearchParams(run_hi_accel=run_accel,
-                                   max_cands_to_fold=20)
+    params = executor.SearchParams(
+        run_hi_accel=run_accel,
+        max_cands_to_fold=int(os.environ.get("TPULSAR_BENCH_MAXFOLD",
+                                             "20")))
     dev_dtype = jnp.uint8 if dtype == "uint8" else jnp.bfloat16
     npasses = sum(s.numpasses for s in plan)
 
@@ -374,6 +376,29 @@ def main() -> None:
                 except (subprocess.TimeoutExpired, OSError):
                     _log("Pallas smoke probe hung (kernel will use "
                          "XLA fallback via signature disable)")
+                # Same pre-probe for the batched accel-search path:
+                # its failure mode on a sick runtime is a hang only a
+                # subprocess can catch; on success the measured child
+                # reads the disk-cached verdict, on failure it is
+                # pinned to the proven per-DM path.
+                _log("pre-running batched-accel smoke probe")
+                try:
+                    asmoke = subprocess.run(
+                        [sys.executable, "-c",
+                         "import sys; sys.path.insert(0, %r); "
+                         "from tpulsar.kernels.accel import "
+                         "_batch_path_usable; "
+                         "print(_batch_path_usable())" % _REPO],
+                        capture_output=True, text=True,
+                        timeout=probe_timeout + 330)
+                    _log(f"accel batch smoke: "
+                         f"{asmoke.stdout.strip()[-40:]}")
+                    if "True" not in asmoke.stdout:
+                        os.environ["TPULSAR_ACCEL_BATCH"] = "0"
+                except (subprocess.TimeoutExpired, OSError):
+                    _log("accel batch smoke hung — pinning the "
+                         "measured run to the per-DM accel path")
+                    os.environ["TPULSAR_ACCEL_BATCH"] = "0"
             status, result = run_child(deadline)
             if result is None:
                 partial = _read_partial()
@@ -410,6 +435,9 @@ def main() -> None:
                                 os.environ.get(
                                     "TPULSAR_BENCH_CPU_SCALE", "0.0833"),
                             "TPULSAR_BENCH_ACCEL": "0",
+                            # rules-based fold grids are host-heavy on
+                            # CPU; cap the fold set for the evidence run
+                            "TPULSAR_BENCH_MAXFOLD": "3",
                         })
                     if fb is not None:
                         result["cpu_fallback"] = {

@@ -295,7 +295,7 @@ def test_tpu_slice_restart_and_exit_markers(tmp_path):
     qm3 = TPUSliceManager(hosts=["h1"], launcher="sh -c {cmd}",
                           remote_cmd="true", state_file=state)
     qid2 = qm3.submit([], outdir, job_id=2)
-    for _ in range(100):
+    for _ in range(300):
         if not qm3.is_running(qid2):
             break
         time.sleep(0.1)
@@ -304,7 +304,7 @@ def test_tpu_slice_restart_and_exit_markers(tmp_path):
 
     # failing job: nonzero exit code detected even after restart
     qid3 = qm3.submit([], outdir, job_id=3)
-    for _ in range(100):
+    for _ in range(300):
         if not qm3.is_running(qid3):
             break
         time.sleep(0.1)
@@ -312,7 +312,7 @@ def test_tpu_slice_restart_and_exit_markers(tmp_path):
                           remote_cmd="false", state_file=state)
     # qid3 ran "true"; submit a real failure via qm4
     qid4 = qm4.submit([], outdir, job_id=4)
-    for _ in range(100):
+    for _ in range(300):
         if not qm4.is_running(qid4):
             break
         time.sleep(0.1)
@@ -476,3 +476,60 @@ def test_zaplist_refresh_removes_stale_lists(tmp_path):
     assert (zapdir / "a.zaplist").exists()
     assert not (zapdir / "b.zaplist").exists()      # stale: removed
     assert (zapdir / "operator.zaplist").exists()   # untouched
+
+
+def test_tpu_slice_handleless_delete_kills_remote(tmp_path):
+    """A restart-orphaned delete must kill the remote process through
+    the launcher, not just write a local marker while the remote job
+    keeps the TPU busy (round-1 advisor finding); an unreachable host
+    keeps the slot reserved."""
+    import sys
+
+    from tpulsar.orchestrate.queue_managers.tpu_slice import TPUSliceManager
+
+    outdir = str(tmp_path / "out")
+    state = str(tmp_path / "tpu.json")
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os, sys, time\n"
+        "open(os.path.join(%r, 'worker.pid'), 'w')"
+        ".write(str(os.getpid()))\n"
+        "time.sleep(60)\n" % outdir)
+
+    qm = TPUSliceManager(hosts=["h1"], launcher="sh -c {cmd}",
+                         remote_cmd=f"{sys.executable} {worker}",
+                         state_file=state, qid_flag=True)
+    qid = qm.submit([], outdir, job_id=1)
+    pidfile = os.path.join(outdir, "worker.pid")
+    for _ in range(100):
+        if os.path.exists(pidfile):
+            break
+        time.sleep(0.1)
+    pid = int(open(pidfile).read())
+    os.kill(pid, 0)                      # worker is alive
+
+    # "restarted" manager: registry-known, no Popen handle
+    qm2 = TPUSliceManager(hosts=["h1"], launcher="sh -c {cmd}",
+                          state_file=state)
+    assert qm2.is_running(qid)
+    assert qm2.delete(qid) is True
+    assert not qm2.is_running(qid)
+    for _ in range(100):
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("remote worker survived handle-less delete")
+    assert qm2.can_submit()              # slot freed
+
+    # unreachable host: delete fails, slot stays reserved
+    qid2 = qm.submit([], outdir, job_id=2)
+    qm3 = TPUSliceManager(hosts=["h1"],
+                          launcher="definitely-not-a-launcher {host} {cmd}",
+                          state_file=state)
+    assert qm3.delete(qid2) is False
+    assert qm3.is_running(qid2)
+    assert not qm3.can_submit()
+    qm.delete(qid2)                      # clean up via the live handle

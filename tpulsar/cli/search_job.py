@@ -128,6 +128,9 @@ def main(argv=None) -> int:
     p.add_argument("--zaplist-dir", default=None)
     p.add_argument("--default-zaplist", default=None)
     p.add_argument("--no-accel", action="store_true")
+    p.add_argument("--qid", default=None,
+                   help="queue id stamp (identification only: lets a "
+                        "scheduler kill this job by its command line)")
     args = p.parse_args(argv)
 
     from tpulsar.config import settings

@@ -46,6 +46,25 @@ def _read_search_params(resultsdir: str) -> dict:
     return ns
 
 
+def _union_length(lo: np.ndarray, hi: np.ndarray) -> float:
+    """Total length of the union of [lo_i, hi_i] intervals
+    (overlapping birdies must not be double-counted)."""
+    order = np.argsort(lo)
+    total, cur_lo, cur_hi = 0.0, None, None
+    for a, b in zip(lo[order], hi[order]):
+        if b <= a:
+            continue
+        if cur_hi is None or a > cur_hi:
+            if cur_hi is not None:
+                total += cur_hi - cur_lo
+            cur_lo, cur_hi = a, b
+        else:
+            cur_hi = max(cur_hi, b)
+    if cur_hi is not None:
+        total += cur_hi - cur_lo
+    return float(total)
+
+
 def get_diagnostics(resultsdir: str, basenm: str):
     """Compute the per-beam diagnostic set (reference
     diagnostics.py:632-681)."""
@@ -125,8 +144,8 @@ def get_diagnostics(resultsdir: str, basenm: str):
                           ("below 1 Hz", 1.0)):
             lo1 = np.clip(zap[:, 0] - 0.5 * zap[:, 1], lo_f, hi)
             hi1 = np.clip(zap[:, 0] + 0.5 * zap[:, 1], lo_f, hi)
-            pct = 100.0 * float(np.sum(hi1 - lo1)) / max(hi - lo_f,
-                                                         1e-12)
+            covered = _union_length(lo1, hi1)
+            pct = 100.0 * covered / max(hi - lo_f, 1e-12)
             diags.append(FloatDiagnosticUpload(
                 f"Percent zapped {label}", pct))
 

@@ -72,15 +72,19 @@ class LocalTransport:
 
 class HTTPTransport:
     """HTTP(S) remote store: listing via an index endpoint returning
-    one 'name size' per line; fetch via GET."""
+    one 'name size' per line; fetch via GET.  Every request carries a
+    timeout: these run on per-job worker paths where a half-open
+    connection to a sick server must not wedge the search."""
 
-    def __init__(self, base_url: str):
+    def __init__(self, base_url: str, timeout_s: float = 30.0):
         self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
 
     def list_files(self, subdir: str) -> list[str]:
         import urllib.request
         with urllib.request.urlopen(
-                f"{self.base_url}/{subdir}/index.txt") as resp:
+                f"{self.base_url}/{subdir}/index.txt",
+                timeout=self.timeout_s) as resp:
             lines = resp.read().decode().splitlines()
         return [f"{subdir}/{ln.split()[0]}" for ln in lines if ln.strip()]
 
@@ -88,7 +92,7 @@ class HTTPTransport:
         import urllib.request
         req = urllib.request.Request(f"{self.base_url}/{path}",
                                      method="HEAD")
-        with urllib.request.urlopen(req) as resp:
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return int(resp.headers["Content-Length"])
 
     def modtime(self, path: str) -> float:
@@ -99,7 +103,7 @@ class HTTPTransport:
         from email.utils import parsedate_to_datetime
         req = urllib.request.Request(f"{self.base_url}/{path}",
                                      method="HEAD")
-        with urllib.request.urlopen(req) as resp:
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             lm = resp.headers.get("Last-Modified")
         if not lm:
             return 0.0
@@ -107,7 +111,8 @@ class HTTPTransport:
 
     def fetch(self, path: str, dst: str) -> None:
         import urllib.request
-        with urllib.request.urlopen(f"{self.base_url}/{path}") as resp, \
+        with urllib.request.urlopen(f"{self.base_url}/{path}",
+                                    timeout=self.timeout_s) as resp, \
                 open(dst, "wb") as out:
             shutil.copyfileobj(resp, out)
 

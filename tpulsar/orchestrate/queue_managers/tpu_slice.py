@@ -39,13 +39,20 @@ class TPUSliceManager:
                  remote_cmd: str = "python -m tpulsar.cli.search_job",
                  env_extra: dict | None = None,
                  state_file: str | None = None,
-                 lost_job_timeout_s: float = 24 * 3600.0):
+                 lost_job_timeout_s: float = 24 * 3600.0,
+                 qid_flag: bool | None = None):
         """hosts: TPU host addresses, one concurrent beam each.
         launcher: template with {host} and {cmd} placeholders.
         lost_job_timeout_s: a restart-orphaned job whose exit marker
         never appears is declared lost (and its slot freed) after this
         long — the guard against a host that died before the wrapper
-        could write the marker."""
+        could write the marker.
+        qid_flag: append `--qid <qid>` to remote_cmd so the WORKER's
+        command line carries the qid (lets delete() pkill the whole
+        remote job, not just the launcher wrapper).  None = auto:
+        enabled for the framework's own search_job worker, which
+        accepts the flag; a custom remote_cmd gets the qid via the
+        TPULSAR_QID environment variable instead unless it opts in."""
         if not hosts:
             raise ValueError("TPUSliceManager needs at least one host")
         self.hosts = list(hosts)
@@ -53,6 +60,8 @@ class TPUSliceManager:
         self.remote_cmd = remote_cmd
         self.env_extra = env_extra or {}
         self.lost_job_timeout_s = lost_job_timeout_s
+        self.qid_flag = (qid_flag if qid_flag is not None
+                         else "tpulsar.cli.search_job" in remote_cmd)
         self._lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
         self._done: set[str] = set()   # qids observed finished (cache)
@@ -93,7 +102,16 @@ class TPUSliceManager:
                 **self.env_extra}
         env_prefix = " ".join(f"{k}={shlex.quote(v)}"
                               for k, v in envs.items())
-        inner = (f"{env_prefix} {self.remote_cmd}; "
+        # --qid stamps the qid into the WORKER's command line (not
+        # just the wrapper's), so delete() can kill the whole remote
+        # job with pkill -f <qid>; only the framework's own worker is
+        # known to accept the flag — a custom remote_cmd gets it via
+        # env instead (kill then only reaches the wrapper)
+        if self.qid_flag:
+            cmd = f"{self.remote_cmd} --qid {qid}"
+        else:
+            cmd = f"TPULSAR_QID={qid} {self.remote_cmd}"
+        inner = (f"{env_prefix} {cmd}; "
                  f"echo $? > {shlex.quote(exitpath)}")
         full = self.launcher.format(host=host, cmd=shlex.quote(inner))
         with open(errpath, "wb") as errfh:
@@ -166,11 +184,49 @@ class TPUSliceManager:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
-        if proc is None and not self._registry.known(queue_id):
-            return False
-        # the killed (or unreachable) wrapper never writes its marker
+            # terminating the LOCAL launcher does not reliably kill
+            # the REMOTE command (ssh without a pty leaves it
+            # running); chase it down like the handle-less case
+            if self._exit_code(queue_id) is None:
+                self._remote_kill(queue_id)
+        elif proc is None:
+            if not self._registry.known(queue_id):
+                return False
+            if self._exit_code(queue_id) is None:
+                # Restart-orphaned job (registry-known, no Popen): the
+                # local launcher is gone but the REMOTE search may
+                # still be running.  Kill it through the launcher —
+                # writing only a local marker would free the slot
+                # while the remote process keeps the TPU busy
+                # (double-booking; round-1 advisor finding).  If the
+                # host is unreachable, keep the slot reserved: the
+                # exit marker or the lost-job timeout converges it.
+                if not self._remote_kill(queue_id):
+                    return False
+        # the killed (or already-dead) wrapper never writes its marker
         self._mark_done(queue_id, code="143")
         return True
+
+    def _remote_kill(self, queue_id: str) -> bool:
+        """Best-effort pkill of the remote job by its qid stamp.
+        True when the kill command ran (rc 0 = killed, rc 1 = no such
+        process, i.e. already dead); False when the host could not be
+        reached."""
+        host = self._registry.get(queue_id, "host")
+        if not host:
+            return False
+        # bracket the first character so the kill command's own
+        # cmdline (which contains the qid) does not match the pattern
+        # and pkill its own launcher shell
+        pattern = f"[{queue_id[0]}]{queue_id[1:]}"
+        cmd = self.launcher.format(
+            host=host, cmd=shlex.quote(f"pkill -TERM -f {pattern}"))
+        try:
+            res = subprocess.run(shlex.split(cmd), timeout=30,
+                                 capture_output=True)
+            return res.returncode in (0, 1)
+        except (subprocess.TimeoutExpired, OSError):
+            return False
 
     def status(self) -> tuple[int, int]:
         return 0, len(self._live_qids())
