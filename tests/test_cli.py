@@ -1,14 +1,12 @@
 """CLI tests (hermetic, via the Python entry points)."""
 
 import os
-import warnings
 
 import pytest
 
 from tpulsar.cli.main import main
 from tpulsar.io import synth
 
-warnings.filterwarnings("ignore", message="low channel changes")
 
 
 @pytest.fixture(autouse=True)

@@ -3,7 +3,6 @@
 import glob
 import os
 import tarfile
-import warnings
 
 import numpy as np
 import pytest
@@ -12,7 +11,6 @@ from tpulsar.io import accelcands, synth
 from tpulsar.plan import ddplan
 from tpulsar.search import executor
 
-warnings.filterwarnings("ignore", message="low channel changes")
 
 P_TRUE, DM_TRUE = 0.15, 60.0
 

