@@ -32,6 +32,12 @@ Environment knobs:
                           config 5, the 8-beam batch)
   TPULSAR_BENCH_PROBE_TIMEOUT  health-probe timeout, s (default 180)
   TPULSAR_BENCH_DEADLINE  measured-run hard deadline, s (default 900)
+  TPULSAR_BENCH_TOTAL_BUDGET   target ceiling on the parent's TOTAL
+                          wall-clock, s (default 1800): every phase's
+                          timeout is clamped to the remaining budget
+                          so the one JSON line appears within roughly
+                          the budget (kill/drain slop can add ~30 s;
+                          set an outer driver timeout with margin)
   TPULSAR_BENCH_CPU_FALLBACK   "0" to skip the reduced-scale CPU run
                           when the TPU is unhealthy (default on)
 """
@@ -313,7 +319,7 @@ def run_child(deadline: float, extra_env: dict | None = None
         _log(f"measured run exceeded deadline {deadline:.0f} s — killing")
         proc.kill()
         try:
-            proc.communicate(timeout=30)
+            proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
             pass
         return "timeout", None
@@ -341,12 +347,20 @@ def main() -> None:
     probe_timeout = float(os.environ.get("TPULSAR_BENCH_PROBE_TIMEOUT",
                                          "180"))
     deadline = float(os.environ.get("TPULSAR_BENCH_DEADLINE", "900"))
+    total_budget = float(os.environ.get("TPULSAR_BENCH_TOTAL_BUDGET",
+                                        "1800"))
 
     result: dict | None = None
     t_start = time.time()
+
+    def remaining(reserve: float = 60.0) -> float:
+        """Seconds left in the total budget, keeping `reserve` for
+        kill/drain slop and the final JSON emission."""
+        return max(5.0, total_budget - (time.time() - t_start) - reserve)
+
     try:
         _log(f"health-probing accelerator (timeout {probe_timeout:.0f} s)")
-        probe = probe_device(probe_timeout)
+        probe = probe_device(min(probe_timeout, remaining()))
         want_cpu = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
         if probe is not None and not want_cpu \
                 and probe.get("platform") == "cpu":
@@ -371,7 +385,7 @@ def main() -> None:
                          "smoke_test_ok; print(smoke_test_ok())"
                          % _REPO],
                         capture_output=True, text=True,
-                        timeout=probe_timeout + 330)
+                        timeout=min(probe_timeout + 330, remaining()))
                     _log(f"Pallas smoke: {smoke.stdout.strip()[-40:]}")
                 except (subprocess.TimeoutExpired, OSError):
                     _log("Pallas smoke probe hung (kernel will use "
@@ -390,7 +404,7 @@ def main() -> None:
                          "_batch_path_usable; "
                          "print(_batch_path_usable())" % _REPO],
                         capture_output=True, text=True,
-                        timeout=probe_timeout + 330)
+                        timeout=min(probe_timeout + 330, remaining()))
                     _log(f"accel batch smoke: "
                          f"{asmoke.stdout.strip()[-40:]}")
                     if "True" not in asmoke.stdout:
@@ -399,11 +413,12 @@ def main() -> None:
                     _log("accel batch smoke hung — pinning the "
                          "measured run to the per-DM accel path")
                     os.environ["TPULSAR_ACCEL_BATCH"] = "0"
-            status, result = run_child(deadline)
+            eff_deadline = min(deadline, remaining())
+            status, result = run_child(eff_deadline)
             if result is None:
                 partial = _read_partial()
                 elapsed = round(time.time() - t_start, 2)
-                err = (f"timed_out_after_{deadline:.0f}s"
+                err = (f"timed_out_after_{eff_deadline:.0f}s"
                        if status == "timeout" else "measured_run_crashed")
                 result = {
                     "metric": "mock_beam_full_plan_search_wallclock",
@@ -425,10 +440,11 @@ def main() -> None:
             }
             if os.environ.get("TPULSAR_BENCH_CPU_FALLBACK", "1") != "0":
                 _log("running reduced-scale CPU fallback for evidence")
-                cpu_probe = probe_device(probe_timeout, force_cpu=True)
+                cpu_probe = probe_device(min(probe_timeout, remaining()),
+                                         force_cpu=True)
                 if cpu_probe is not None:
                     _, fb = run_child(
-                        min(deadline, 600.0),
+                        min(deadline, 600.0, remaining()),
                         extra_env={
                             "JAX_PLATFORMS": "cpu",
                             "TPULSAR_BENCH_SCALE":

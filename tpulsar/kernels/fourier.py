@@ -276,6 +276,18 @@ def stage_candidates(powers: jnp.ndarray, numharm: int, topk: int):
     return blockmax_topk(summed, topk)
 
 
+@partial(jax.jit, static_argnames=("stages", "topk"))
+def all_stage_candidates(powers: jnp.ndarray, stages: tuple[int, ...],
+                         topk: int) -> dict:
+    """Every harmonic stage's top-k in ONE compiled program.
+
+    Per-stage jit calls compile once per (shape, numharm) pair — 5
+    stages x 6 plan steps = 30 XLA compilations per beam; fusing the
+    static stage loop cuts that to one per plan step (cold-cache
+    compile time is a real slice of the <60 s beam budget)."""
+    return {h: stage_candidates(powers, h, topk) for h in stages}
+
+
 # ----------------------------------------------------------- significance
 
 def sigma_from_power(summed_power, numharm: int, numindep: int = 1):

@@ -368,11 +368,11 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                             spec,
                             jnp.asarray(keep) if keep is not None else None)
                     with timers.timing("lo-accelsearch"):
-                        res = {
-                            h: fr.stage_candidates(wpow, h,
-                                                   params.topk_per_stage)
-                            for h in fr.harmonic_stages(
-                                params.lo_accel_numharm)}
+                        res = fr.all_stage_candidates(
+                            wpow,
+                            tuple(fr.harmonic_stages(
+                                params.lo_accel_numharm)),
+                            params.topk_per_stage)
                         all_cands.extend(sifting.make_candidates(
                             res, dm_chunk, T_s, _lo_sigma_fn(nbins),
                             sigma_min=params.sifting.sigma_threshold))
