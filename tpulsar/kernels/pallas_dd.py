@@ -99,7 +99,9 @@ def dedisperse_subbands_pallas(subbands, sub_shifts,
     shift table and the VMEM output block.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # interpret mode on a real chip would be a catastrophic
+        # slowdown
+        interpret = not is_tpu_backend()
     subbands = jnp.asarray(subbands, jnp.float32)
     shifts_np = np.asarray(sub_shifts, np.int32)
     nsub, T = subbands.shape
@@ -128,6 +130,14 @@ def dedisperse_subbands_pallas(subbands, sub_shifts,
 
 _DISABLED_SIGS: dict[tuple, str] = {}
 _SMOKE_OK: bool | None = None
+
+#: PJRT platform names that are real TPU runtimes (the axon plugin
+#: reports "axon", not "tpu") — the single source every gate uses
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    return jax.default_backend() in TPU_BACKENDS
 
 
 def forced() -> bool:
@@ -232,7 +242,7 @@ def use_pallas() -> bool:
         return False
     if env in ("1", "on", "true"):
         return True
-    return jax.default_backend() == "tpu" and smoke_test_ok()
+    return is_tpu_backend() and smoke_test_ok()
 
 
 def signature_enabled(sig: tuple) -> bool:

@@ -731,8 +731,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
         hi_width=bank.width if hi_sharded else 0,
         hi_nz=nz if hi_sharded else 0,
         pallas_dd=use_pallas, dd_stage_s=stage_s,
-        dd_interpret=use_pallas
-        and jax.default_backend() not in ("tpu", "axon"))
+        dd_interpret=use_pallas and not pallas_dd.is_tpu_backend())
     key = (mesh, spec)
     if key not in _SHARDED_FN_CACHE:
         _SHARDED_FN_CACHE[key] = pmesh.sharded_pass_fn(mesh, spec)
