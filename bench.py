@@ -40,6 +40,13 @@ Environment knobs:
                           set an outer driver timeout with margin)
   TPULSAR_BENCH_CPU_FALLBACK   "0" to skip the reduced-scale CPU run
                           when the TPU is unhealthy (default on)
+  TPULSAR_BENCH_CONFIG    focused BASELINE.json config instead of the
+                          headline full search:
+                            1  rfifind + dedispersion only, 128 DM trials
+                            3  accelsearch zmax=200 numharm=16
+                            4  single-pulse boxcar search only
+                          (config 2 IS the headline with ACCEL=0;
+                           config 5 is NBEAMS=8)
 """
 
 import json
@@ -155,10 +162,107 @@ def make_block_device(nsamp: int, seed: int = 42, chan_chunk: int = 120):
     return jnp.concatenate(parts, axis=0)
 
 
+def run_focused_config(cfg: int) -> None:
+    """Focused BASELINE.json configs 1/3/4: time one stage on the
+    full-length beam (config 2 is the headline with the accel stage
+    off; config 5 is the headline with TPULSAR_BENCH_NBEAMS=8)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if want:
+        jax.config.update("jax_platforms", want)
+
+    from tpulsar.kernels import dedisperse as dd
+    from tpulsar.kernels import fourier as fr
+    from tpulsar.kernels import rfi as rfi_k
+    from tpulsar.kernels import singlepulse as sp_k
+
+    scale = float(os.environ.get("TPULSAR_BENCH_SCALE", "1.0"))
+    nsamp = int(T_FULL * scale)
+    nsamp -= nsamp % 30720
+    freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
+    # reset the partial-evidence file so a timed-out focused run's
+    # error record cannot absorb a previous headline run's passes
+    with open(PARTIAL_PATH, "w") as fh:
+        fh.write(json.dumps({"event": "start", "config": cfg,
+                             "nsamp": nsamp, "t": time.time()}) + "\n")
+    data = make_block_device(nsamp)
+    data.block_until_ready()
+    dms = np.arange(128) * 2.0
+    t0 = time.time()
+    if cfg == 1:
+        # rfifind + two-stage dedispersion, 128 DM trials
+        mask = rfi_k.find_rfi(data.T, TSAMP, block_len=2048)
+        data = rfi_k.apply_mask(data.T, jnp.asarray(mask.full_mask()),
+                                2048).T   # rebind: one block on HBM
+        ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 96, 140.0, dms,
+                                            TSAMP, 1)
+        subb = dd.form_subbands(data, jnp.asarray(ch_sh), 96, 1)
+        out = dd.dedisperse_subbands(subb, jnp.asarray(sub_sh))
+        jax.block_until_ready(out)
+        metric, extra = "rfifind_dedisperse_128dm_wallclock", {
+            "dm_trials": 128}
+    elif cfg == 3:
+        from tpulsar.kernels import accel as ak
+        ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 96, 140.0, dms[:32],
+                                            TSAMP, 1)
+        subb = dd.form_subbands(data, jnp.asarray(ch_sh), 96, 1)
+        series = dd.dedisperse_subbands(subb, jnp.asarray(sub_sh))
+        spec = fr.complex_spectrum(series)
+        powers, wpow = fr.whitened_powers(spec)
+        wspec = fr.scale_spectrum(spec, powers, wpow)
+        jax.block_until_ready(wspec)   # upstream work must not leak
+        t0 = time.time()               # into the accel-only timing
+        bank = ak.build_template_bank(200.0)
+        res = ak.accel_search_batch(wspec, bank, max_numharm=16,
+                                    topk=64)
+        jax.block_until_ready(jnp.asarray(res[1][0]))
+        metric, extra = "accelsearch_z200_h16_32dm_wallclock", {
+            "dm_trials": 32, "nz": len(bank.zs)}
+    elif cfg == 4:
+        ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 96, 140.0, dms,
+                                            TSAMP, 1)
+        subb = dd.form_subbands(data, jnp.asarray(ch_sh), 96, 1)
+        series = dd.dedisperse_subbands(subb, jnp.asarray(sub_sh))
+        series.block_until_ready()
+        t0 = time.time()            # SP stage only
+        ev = sp_k.single_pulse_search(series, dms, TSAMP)
+        metric, extra = "single_pulse_128dm_wallclock", {
+            "dm_trials": 128, "events": int(len(ev))}
+    else:
+        raise SystemExit(f"unknown TPULSAR_BENCH_CONFIG {cfg}")
+    elapsed = time.time() - t0
+    print(json.dumps({
+        "metric": metric, "value": round(elapsed, 2), "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / max(elapsed, 1e-9), 3),
+        "nsamp": nsamp, "device": str(jax.devices()[0]), **extra,
+    }), flush=True)
+
+
 def run_measured() -> None:
     """The measured search (runs inside the deadline-guarded child).
     Prints progress to stderr, appends per-pass records to
     bench_partial.jsonl, and prints the result JSON to stdout."""
+    cfg_raw = os.environ.get("TPULSAR_BENCH_CONFIG", "").strip()
+    if cfg_raw:
+        try:
+            cfg = int(cfg_raw)
+        except ValueError:
+            raise SystemExit(
+                f"TPULSAR_BENCH_CONFIG must be 1-5, got {cfg_raw!r}")
+        if cfg == 2:
+            os.environ["TPULSAR_BENCH_ACCEL"] = "0"   # zero-accel search
+        elif cfg == 5:
+            os.environ.setdefault("TPULSAR_BENCH_NBEAMS", "8")
+        elif cfg in (1, 3, 4):
+            run_focused_config(cfg)
+            return
+        else:
+            raise SystemExit(
+                f"TPULSAR_BENCH_CONFIG must be 1-5, got {cfg_raw!r}")
     import numpy as np
 
     import jax

@@ -701,8 +701,6 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
     the hi stage drops to the single-device accel_search_batch, which
     has its own proven per-DM fallback.
     """
-    import jax
-
     from tpulsar.kernels import pallas_dd
     from tpulsar.parallel import mesh as pmesh
 
