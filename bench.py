@@ -195,9 +195,10 @@ def run_focused_config(cfg: int) -> None:
     t0 = time.time()
     if cfg == 1:
         # rfifind + two-stage dedispersion, 128 DM trials
-        mask = rfi_k.find_rfi(data.T, TSAMP, block_len=2048)
-        data = rfi_k.apply_mask(data.T, jnp.asarray(mask.full_mask()),
-                                2048).T   # rebind: one block on HBM
+        mask = rfi_k.find_rfi_chan(data, TSAMP, block_len=2048)
+        data = rfi_k.apply_mask_chan(
+            data, jnp.asarray(mask.full_mask()),
+            jnp.asarray(mask.chan_fill), mask.block_len)
         ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 96, 140.0, dms,
                                             TSAMP, 1)
         subb = dd.form_subbands(data, jnp.asarray(ch_sh), 96, 1)
@@ -319,9 +320,10 @@ def run_measured() -> None:
         _log(f"beam {b}: block ready in {time.time()-t_gen:.1f} s")
 
         t0 = time.time()
-        mask = rfi_k.find_rfi(data.T, TSAMP, block_len=2048)
-        data = rfi_k.apply_mask(data.T, jnp.asarray(mask.full_mask()),
-                                2048).T
+        mask = rfi_k.find_rfi_chan(data, TSAMP, block_len=2048)
+        data = rfi_k.apply_mask_chan(
+            data, jnp.asarray(mask.full_mask()),
+            jnp.asarray(mask.chan_fill), mask.block_len)
         data.block_until_ready()
         _log(f"beam {b}: rfifind done at +{time.time()-t0:.1f} s")
 

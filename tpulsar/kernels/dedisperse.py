@@ -33,15 +33,44 @@ def shift_samples(dm, freqs_mhz, ref_mhz, dt) -> np.ndarray:
     return np.round(delays_s(dm, freqs_mhz, ref_mhz) / dt).astype(np.int32)
 
 
-def _shift_gather(data: jnp.ndarray, shifts: jnp.ndarray) -> jnp.ndarray:
+def _pad_bucket(maxshift: int) -> int:
+    """Round a maximum shift up to a power-of-two bucket (>=256) so the
+    static pad width takes few distinct values across a survey plan's
+    passes and compile signatures stay bounded."""
+    p = 256
+    while p < maxshift:
+        p *= 2
+    return p
+
+
+@partial(jax.jit, static_argnames=("pad",))
+def _shift_rows(data: jnp.ndarray, shifts: jnp.ndarray,
+                pad: int) -> jnp.ndarray:
+    """out[i, t] = data[i, min(t + shifts[i], T-1)] for shifts <= pad.
+
+    The shift is one edge-value pad plus a vmapped dynamic slice, so
+    the gather indices are one scalar per row.  (A materialized
+    (nrows, T) int32 index matrix — the obvious take_along_axis
+    formulation — is 15 GB at full Mock-beam scale, ~4x the raw block.)
+    """
+    nrows, T = data.shape
+    tail = jnp.broadcast_to(data[:, -1:], (nrows, pad)).astype(data.dtype)
+    padded = jnp.concatenate([data, tail], axis=1)
+    starts = jnp.minimum(shifts.astype(jnp.int32), pad)
+    return jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice_in_dim(row, s, T)
+    )(padded, starts)
+
+
+def _shift_gather(data: jnp.ndarray, shifts) -> jnp.ndarray:
     """Shift row i of (nrows, T) left by shifts[i] (clamped at the end).
 
-    out[i, t] = data[i, min(t + shifts[i], T-1)]
+    Host entry point: `shifts` must be concrete (NumPy or device
+    array), never a tracer — the pad width is derived from its max.
     """
-    T = data.shape[-1]
-    idx = jnp.arange(T, dtype=jnp.int32)[None, :] + shifts[:, None]
-    idx = jnp.minimum(idx, T - 1)
-    return jnp.take_along_axis(data, idx, axis=-1)
+    shifts_np = np.asarray(shifts)
+    pad = _pad_bucket(int(shifts_np.max(initial=0)))
+    return _shift_rows(data, jnp.asarray(shifts_np), pad)
 
 
 def downsample(x: jnp.ndarray, factor: int, axis: int = -1) -> jnp.ndarray:
@@ -57,37 +86,85 @@ def downsample(x: jnp.ndarray, factor: int, axis: int = -1) -> jnp.ndarray:
     return x.reshape(newshape).sum(axis=axis + 1)
 
 
-@partial(jax.jit, static_argnames=("nsub", "downsamp"))
-def form_subbands(data: jnp.ndarray, chan_shifts: jnp.ndarray,
-                  nsub: int, downsamp: int) -> jnp.ndarray:
-    """Stage 1: (nchan, T) float32 -> (nsub, T // downsamp).
+@partial(jax.jit, static_argnames=("nsub", "downsamp", "pad"))
+def _form_subbands_jit(data: jnp.ndarray, chan_shifts: jnp.ndarray,
+                       nsub: int, downsamp: int, pad: int) -> jnp.ndarray:
+    nchan, T = data.shape
+    cps = nchan // nsub
+    tail = jnp.broadcast_to(data[:, -1:], (nchan, pad)).astype(data.dtype)
+    padded = jnp.concatenate([data, tail], axis=1)     # native dtype
+    grouped = padded.reshape(nsub, cps, T + pad)
+    starts = jnp.minimum(chan_shifts.astype(jnp.int32),
+                         pad).reshape(nsub, cps)
+    n_ds = (T // downsamp) * downsamp
+
+    def one_sub(args):
+        rows, s = args      # (cps, T+pad) native dtype, (cps,) int32
+        sl = jax.vmap(
+            lambda r, st: jax.lax.dynamic_slice_in_dim(r, st, T)
+        )(rows, s)
+        # Cast after the slice: only one subband group is ever float32
+        # (a whole-beam float32 copy is ~4x HBM at full Mock scale).
+        acc = sl.astype(jnp.float32).sum(axis=0)
+        if downsamp > 1:
+            acc = acc[:n_ds].reshape(-1, downsamp).sum(axis=-1)
+        return acc
+
+    return jax.lax.map(one_sub, (grouped, starts))
+
+
+def form_subbands(data: jnp.ndarray, chan_shifts, nsub: int,
+                  downsamp: int) -> jnp.ndarray:
+    """Stage 1: (nchan, T) -> (nsub, T // downsamp) float32.
 
     chan_shifts: per-channel integer shifts at the pass sub-DM,
     *relative to the reference frequency of the channel's own subband*
     (so each subband is internally dedispersed to the sub-DM but keeps
-    its inter-subband delay for stage 2).
+    its inter-subband delay for stage 2).  Must be concrete (the pad
+    width is derived host-side from its max).
     """
-    nchan, T = data.shape
+    nchan = data.shape[0]
     if nchan % nsub:
         raise ValueError(f"nchan {nchan} not divisible by nsub {nsub}")
-    shifted = _shift_gather(data, chan_shifts)
-    # Cast after the gather: lets the raw block live in HBM as uint8 /
-    # bf16 (a full Mock beam is 4x smaller that way); XLA fuses the
-    # gather + convert + reduce without materializing the f32 block.
-    subbands = shifted.astype(jnp.float32).reshape(
-        nsub, nchan // nsub, T).sum(axis=1)
-    return downsample(subbands, downsamp, axis=-1)
+    shifts_np = np.asarray(chan_shifts)
+    pad = _pad_bucket(int(shifts_np.max(initial=0)))
+    return _form_subbands_jit(data, jnp.asarray(shifts_np), nsub,
+                              downsamp, pad)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("pad",))
+def _dedisperse_subbands_scan(subbands: jnp.ndarray,
+                              sub_shifts: jnp.ndarray,
+                              pad: int) -> jnp.ndarray:
+    """Shift-and-sum over the DM-trial axis as a scan over subbands.
+
+    Each scan step slices one edge-padded subband row at every trial's
+    shift (a batched dynamic slice — scalar gather indices) and adds it
+    to the (ndms, T) accumulator, so peak HBM is the accumulator plus
+    one padded copy of the subband block, never the (ndms, nsub, T)
+    gather product (~114 GB at full beam scale)."""
+    nsub, T = subbands.shape
+    tail = jnp.broadcast_to(subbands[:, -1:], (nsub, pad))
+    padded = jnp.concatenate([subbands, tail], axis=1)
+    starts = jnp.minimum(sub_shifts.astype(jnp.int32), pad)  # (ndms, nsub)
+
+    def body(acc, inp):
+        row, s = inp   # row (T+pad,), s (ndms,)
+        sl = jax.vmap(
+            lambda st: jax.lax.dynamic_slice_in_dim(row, st, T))(s)
+        return acc + sl, None
+
+    acc0 = jnp.zeros((starts.shape[0], T), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (padded, starts.T))
+    return acc
+
+
 def _dedisperse_subbands_xla(subbands: jnp.ndarray,
-                             sub_shifts: jnp.ndarray) -> jnp.ndarray:
-    """vmapped shift-and-sum over the DM-trial axis (gather
-    formulation; re-reads the subband array once per trial)."""
-    def one_dm(shifts):
-        return _shift_gather(subbands, shifts).sum(axis=0)
-
-    return jax.vmap(one_dm)(sub_shifts)
+                             sub_shifts) -> jnp.ndarray:
+    """XLA (non-Pallas) stage 2.  `sub_shifts` must be concrete."""
+    shifts_np = np.asarray(sub_shifts)
+    pad = _pad_bucket(int(shifts_np.max(initial=0)))
+    return _dedisperse_subbands_scan(subbands, jnp.asarray(shifts_np), pad)
 
 
 def dedisperse_subbands(subbands: jnp.ndarray,

@@ -7,10 +7,19 @@ standard deviation, max Fourier power) are computed in one jitted
 pass, robust z-scores flag outlier cells, and rows/columns whose bad
 fraction exceeds a threshold are zapped entirely.  The result is an
 RFIMask the dedispersion kernel consumes by replacing masked cells
-with their channel's median level.
+with their channel's mean unmasked level.
 
 The block length mirrors rfifind's `-time` parameter (reference
 config: lib/python/config/searching_example.py rfifind_chunk_time).
+
+Memory discipline: a full Mock beam is (960, 3.9M) samples — 3.8 GB
+at uint8 and ~4x the chip's HBM once cast to float32 with a complex
+spectrum alongside.  All whole-beam work here therefore (a) runs in
+the pipeline's native channel-major (nchan, T) orientation so no
+full-block transpose is ever materialized, (b) streams the float32
+cast + per-cell rfft a few channels at a time through `lax.map`, and
+(c) applies the mask as a fused elementwise select in the input's
+dtype using a per-channel fill level precomputed at detection time.
 """
 
 from __future__ import annotations
@@ -33,6 +42,8 @@ class RFIMask:
     cell_mask: np.ndarray        # (nblocks, nchan) bool — True = bad
     bad_channels: np.ndarray     # (nchan,) bool
     bad_blocks: np.ndarray       # (nblocks,) bool
+    chan_fill: np.ndarray | None = None   # (nchan,) float32 — mean
+    #                              unmasked level, the apply-time fill
 
     @property
     def masked_fraction(self) -> float:
@@ -47,33 +58,61 @@ class RFIMask:
                 | self.bad_blocks[:, None])
 
     def save(self, path: str) -> None:
-        np.savez_compressed(path, block_len=self.block_len, dt=self.dt,
-                            cell_mask=self.cell_mask,
-                            bad_channels=self.bad_channels,
-                            bad_blocks=self.bad_blocks)
+        np.savez_compressed(
+            path, block_len=self.block_len, dt=self.dt,
+            cell_mask=self.cell_mask, bad_channels=self.bad_channels,
+            bad_blocks=self.bad_blocks,
+            chan_fill=(self.chan_fill if self.chan_fill is not None
+                       else np.zeros(0, np.float32)))
 
     @classmethod
     def load(cls, path: str) -> "RFIMask":
         z = np.load(path)
+        fill = z["chan_fill"] if "chan_fill" in z.files else None
+        if fill is not None and fill.size == 0:
+            fill = None
         return cls(block_len=int(z["block_len"]), dt=float(z["dt"]),
                    cell_mask=z["cell_mask"], bad_channels=z["bad_channels"],
-                   bad_blocks=z["bad_blocks"])
+                   bad_blocks=z["bad_blocks"], chan_fill=fill)
 
 
-@partial(jax.jit, static_argnames=("block_len",))
-def cell_stats(data: jnp.ndarray, block_len: int):
-    """(T, nchan) -> per-cell (mean, std, max FFT power) with cells of
-    block_len samples: each output is (nblocks, nchan)."""
-    T, nchan = data.shape
+@partial(jax.jit, static_argnames=("block_len", "chunk"))
+def _cell_stats_chan(data: jnp.ndarray, block_len: int, chunk: int = 16):
+    """(nchan, T) -> per-cell (mean, std, max FFT power), each
+    (nblocks, nchan), streaming `chunk` channels at a time through the
+    float32 cast and the per-cell rfft (a whole-beam float32 copy plus
+    its complex spectrum is ~4x HBM at full Mock-beam scale)."""
+    nchan, T = data.shape
     nblocks = T // block_len
-    cells = data[: nblocks * block_len].astype(jnp.float32).reshape(
-        nblocks, block_len, nchan)
-    mean = cells.mean(axis=1)
-    std = cells.std(axis=1)
-    spec = jnp.fft.rfft(cells - mean[:, None, :], axis=1)
-    maxpow = (jnp.abs(spec[:, 1:, :]) ** 2).max(axis=1) / jnp.maximum(
-        block_len * cells.var(axis=1), 1e-9)
-    return mean, std, maxpow
+    x = data[:, : nblocks * block_len].reshape(nchan, nblocks, block_len)
+    chunk = min(chunk, nchan)
+    n_outer = -(-nchan // chunk)
+    pad = n_outer * chunk - nchan
+    if pad:
+        # zero-padded channels yield garbage stats rows that are
+        # sliced off below; they never reach the mask
+        x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+    x = x.reshape(n_outer, chunk, nblocks, block_len)
+
+    def one_chunk(c):
+        c = c.astype(jnp.float32)
+        mean = c.mean(axis=-1)
+        var = c.var(axis=-1)
+        spec = jnp.fft.rfft(c - mean[..., None], axis=-1)
+        maxpow = (jnp.abs(spec[..., 1:]) ** 2).max(axis=-1) / jnp.maximum(
+            block_len * var, 1e-9)
+        return mean, jnp.sqrt(var), maxpow      # each (chunk, nblocks)
+
+    mean, std, maxpow = jax.lax.map(one_chunk, x)
+    return tuple(s.reshape(n_outer * chunk, nblocks)[:nchan].T
+                 for s in (mean, std, maxpow))
+
+
+def cell_stats(data: jnp.ndarray, block_len: int):
+    """(T, nchan) row-major entry point -> (mean, std, maxpow), each
+    (nblocks, nchan).  Small-array convenience; whole-beam callers use
+    the channel-major path (`find_rfi_chan`) to avoid the transpose."""
+    return _cell_stats_chan(jnp.asarray(data).T, block_len)
 
 
 def _robust_z(x: np.ndarray, axis: int = 0) -> np.ndarray:
@@ -83,10 +122,12 @@ def _robust_z(x: np.ndarray, axis: int = 0) -> np.ndarray:
     return (x - med) / np.maximum(1.4826 * mad, 1e-9)
 
 
-def find_rfi(data: np.ndarray | jnp.ndarray, dt: float,
-             block_len: int = 2048, threshold: float = 4.0,
-             chan_frac: float = 0.3, block_frac: float = 0.3) -> RFIMask:
-    """Compute an RFIMask for a (T, nchan) dynamic spectrum.
+def find_rfi_chan(data, dt: float, block_len: int = 2048,
+                  threshold: float = 4.0, chan_frac: float = 0.3,
+                  block_frac: float = 0.3) -> RFIMask:
+    """Compute an RFIMask from a channel-major (nchan, T) dynamic
+    spectrum (the pipeline's native block orientation — no transpose
+    is materialized on device).
 
     A cell is bad if any of its robust z-scores (mean / std / max
     Fourier power, each standardized per-channel across time) exceeds
@@ -97,10 +138,8 @@ def find_rfi(data: np.ndarray | jnp.ndarray, dt: float,
     # Observations shorter than one block still get (exactly) one
     # cell; without the clamp nblocks=0 and every downstream statistic
     # of the empty mask is NaN.
-    block_len = min(block_len, int(data.shape[0]))
-    # Pass the native dtype through; cell_stats casts per cell so a
-    # uint8 block never inflates to a full float32 copy.
-    mean, std, maxpow = cell_stats(jnp.asarray(data), block_len)
+    block_len = min(block_len, int(data.shape[1]))
+    mean, std, maxpow = _cell_stats_chan(jnp.asarray(data), block_len)
     mean, std, maxpow = (np.asarray(x) for x in (mean, std, maxpow))
 
     # Standardize each statistic both across time (catches bursts: a
@@ -113,24 +152,81 @@ def find_rfi(data: np.ndarray | jnp.ndarray, dt: float,
 
     bad_channels = cell_mask.mean(axis=0) > chan_frac
     bad_blocks = cell_mask.mean(axis=1) > block_frac
-    return RFIMask(block_len=block_len, dt=dt, cell_mask=cell_mask,
+    mask = RFIMask(block_len=block_len, dt=dt, cell_mask=cell_mask,
                    bad_channels=bad_channels, bad_blocks=bad_blocks)
+    full = mask.full_mask()
+    good = ~full
+    denom = np.maximum(good.sum(axis=0), 1)
+    mask.chan_fill = (np.where(good, mean, 0.0).sum(axis=0)
+                      / denom).astype(np.float32)
+    return mask
+
+
+def find_rfi(data, dt: float, block_len: int = 2048,
+             threshold: float = 4.0, chan_frac: float = 0.3,
+             block_frac: float = 0.3) -> RFIMask:
+    """Row-major (T, nchan) entry point (see find_rfi_chan)."""
+    return find_rfi_chan(data.T, dt, block_len=block_len,
+                         threshold=threshold, chan_frac=chan_frac,
+                         block_frac=block_frac)
+
+
+def mask_fill_or_default(mask: RFIMask) -> np.ndarray:
+    """The mask's per-channel fill level; masks saved before the
+    chan_fill field existed fall back to zeros (the pre-change
+    apply_mask derived the level from the data — callers that still
+    have the data can recompute via find_rfi_chan instead)."""
+    if mask.chan_fill is not None:
+        return mask.chan_fill
+    return np.zeros(mask.cell_mask.shape[1], np.float32)
 
 
 @partial(jax.jit, static_argnames=("block_len",))
-def apply_mask(data: jnp.ndarray, cell_mask: jnp.ndarray,
-               block_len: int) -> jnp.ndarray:
-    """Replace masked cells of (T, nchan) data with the per-channel
-    mean of unmasked samples (computed over block means for cost).
+def apply_mask_chan(data: jnp.ndarray, cell_mask: jnp.ndarray,
+                    fill: jnp.ndarray, block_len: int) -> jnp.ndarray:
+    """Replace masked cells of channel-major (nchan, T) data with the
+    mask's per-channel fill level.
 
-    Output keeps the input dtype (uint8 blocks stay uint8 — the fill
-    is rounded), so a full-beam block never inflates to float32 in HBM.
+    A fused elementwise select in the input's dtype: peak HBM is the
+    input plus the output (uint8 beams stay uint8; nothing inflates to
+    float32 and no transpose or index matrix is materialized).
+    """
+    nchan, T = data.shape
+    nblocks = cell_mask.shape[0]
+    usable = nblocks * block_len
+    cells = data[:, :usable].reshape(nchan, nblocks, block_len)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        fill = jnp.round(fill)
+    fillv = fill.astype(data.dtype)
+    out = jnp.where(cell_mask.T[:, :, None], fillv[:, None, None],
+                    cells).reshape(nchan, usable)
+    if usable < T:
+        out = jnp.concatenate([out, data[:, usable:]], axis=1)
+    return out
+
+
+@partial(jax.jit, static_argnames=("block_len", "chunk"))
+def apply_mask(data: jnp.ndarray, cell_mask: jnp.ndarray,
+               block_len: int, chunk: int = 64) -> jnp.ndarray:
+    """Row-major (T, nchan) masking that derives the fill level from
+    the data itself (mean of unmasked samples per channel, computed
+    over streamed block means).  Small-array convenience; whole-beam
+    callers use apply_mask_chan with the mask's precomputed fill.
     """
     T, nchan = data.shape
     nblocks = cell_mask.shape[0]
     usable = nblocks * block_len
     cells = data[:usable].reshape(nblocks, block_len, nchan)
-    cmeans = cells.astype(jnp.float32).mean(axis=1)
+
+    chunk = min(chunk, nblocks)
+    n_outer = -(-nblocks // chunk)
+    pad = n_outer * chunk - nblocks
+    padded = jnp.pad(cells, ((0, pad), (0, 0), (0, 0))) if pad else cells
+    cmeans = jax.lax.map(
+        lambda c: c.astype(jnp.float32).mean(axis=1),
+        padded.reshape(n_outer, chunk, block_len, nchan),
+    ).reshape(n_outer * chunk, nchan)[:nblocks]
+
     good = ~cell_mask
     denom = jnp.maximum(good.sum(axis=0), 1)
     fill = (jnp.where(good, cmeans, 0.0).sum(axis=0) / denom)  # (nchan,)

@@ -51,19 +51,20 @@ class SearchStepSpec:
     max_numharm: int
     topk: int
     whiten_edges: tuple[int, ...]
+    dd_pad: int = 0    # static stage-2 shift bound (>= max sub_shift);
+    #                    0 = pad by the full series length (always
+    #                    correct, 2x subband HBM — fine for demos)
 
 
 def _local_search(subbands, sub_shifts, keep_mask, spec: SearchStepSpec):
     """Per-device body: dedisperse local DM chunk -> rfft -> whiten ->
     harmonic top-k.  Returns dict of stage -> (vals, bins)."""
-    from tpulsar.kernels.dedisperse import _shift_gather
+    from tpulsar.kernels.dedisperse import _dedisperse_subbands_scan
     from tpulsar.kernels.fourier import (blockmax_topk, harmonic_stages,
                                          harmonic_sum, whiten_powers)
 
-    def one_dm(shifts):
-        return _shift_gather(subbands, shifts).sum(axis=0)
-
-    series = jax.vmap(one_dm)(sub_shifts)              # (ndms_loc, T')
+    pad = spec.dd_pad or subbands.shape[-1]
+    series = _dedisperse_subbands_scan(subbands, sub_shifts, pad)
     series = series - series.mean(axis=-1, keepdims=True)
     nfft = spec.nfft
     T = series.shape[-1]
@@ -149,6 +150,9 @@ class PassSpec:
     #                             shift, power of 2) for the Pallas
     #                             kernel's sliding window
     dd_interpret: bool = False  # Pallas interpret mode (CPU testing)
+    dd_pad: int = 0             # static stage-2 shift bound for the
+    #                             XLA scan path (>= max sub_shift);
+    #                             0 = pad by the full series length
 
 
 def _pallas_dd_local(subb, shifts, stage_s: int, interpret: bool,
@@ -201,15 +205,15 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
     from tpulsar.kernels import accel as ak
     from tpulsar.kernels import fourier as fr
     from tpulsar.kernels import singlepulse as sp_k
-    from tpulsar.kernels.dedisperse import _shift_gather
+    from tpulsar.kernels.dedisperse import _dedisperse_subbands_scan
 
     def body(subb, shifts, keep, bank):
         if spec.pallas_dd:
             series = _pallas_dd_local(subb, shifts, spec.dd_stage_s,
                                       spec.dd_interpret)
         else:
-            series = jax.vmap(
-                lambda s: _shift_gather(subb, s).sum(axis=0))(shifts)
+            series = _dedisperse_subbands_scan(
+                subb, shifts, spec.dd_pad or subb.shape[-1])
         norm = sp_k.normalize_series(series)
         sp_snr, sp_idx = sp_k.boxcar_search(norm, spec.sp_widths,
                                             spec.sp_topk)

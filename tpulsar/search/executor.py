@@ -168,19 +168,20 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     # ---------------------------------------------------------- read + RFI
     block = si.read_all()                     # (T, nchan) ascending freq
     with timers.timing("rfifind"):
-        mask = rfi_k.find_rfi(block, si.dt,
-                              block_len=params.rfifind_blocklen,
-                              threshold=params.rfi_threshold)
+        # One host transpose, one transfer: the block lives on device
+        # channel-major in its native dtype (uint8 beams stay 4x
+        # smaller) and never transposes there again.
+        data = jnp.asarray(np.ascontiguousarray(block.T))  # (nchan, T)
+        del block
+        mask = rfi_k.find_rfi_chan(data, si.dt,
+                                   block_len=params.rfifind_blocklen,
+                                   threshold=params.rfi_threshold)
         mask.save(os.path.join(resultsdir, f"{basenm}_rfifind.npz"))
         # mask.block_len, not the configured one: find_rfi clamps it
         # for observations shorter than a block
-        clean = np.asarray(rfi_k.apply_mask(
-            jnp.asarray(block), jnp.asarray(mask.full_mask()),
-            mask.block_len))
-    # Keep the block's native dtype in HBM (uint8 beams stay 4x
-    # smaller; form_subbands casts after its gather).
-    data = jnp.asarray(np.ascontiguousarray(clean.T))   # (nchan, T)
-    del block, clean
+        data = rfi_k.apply_mask_chan(
+            data, jnp.asarray(mask.full_mask()),
+            jnp.asarray(mask.chan_fill), mask.block_len)
 
     data_id = ";".join(
         f"{os.path.basename(fn)}:{os.path.getsize(fn)}" for fn in
@@ -714,9 +715,9 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
     bank = _get_bank(params.hi_accel_zmax) if hi else None
     nz = len(bank.zs) if hi else 0
     use_pallas = pallas_dd.use_pallas()
+    smax = int(np.asarray(sub_shifts).max(initial=0))
     stage_s = 0
     if use_pallas:
-        smax = int(np.asarray(sub_shifts).max(initial=0))
         stage_s = max(256, 1 << int(np.ceil(np.log2(max(smax, 1)))))
     spec = pmesh.PassSpec(
         nfft=nfft,
@@ -729,7 +730,8 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
         hi_width=bank.width if hi_sharded else 0,
         hi_nz=nz if hi_sharded else 0,
         pallas_dd=use_pallas, dd_stage_s=stage_s,
-        dd_interpret=use_pallas and not pallas_dd.is_tpu_backend())
+        dd_interpret=use_pallas and not pallas_dd.is_tpu_backend(),
+        dd_pad=dd._pad_bucket(smax))
     key = (mesh, spec)
     if key not in _SHARDED_FN_CACHE:
         _SHARDED_FN_CACHE[key] = pmesh.sharded_pass_fn(mesh, spec)
