@@ -147,16 +147,7 @@ def _dedisperse_subbands_scan(subbands: jnp.ndarray,
     tail = jnp.broadcast_to(subbands[:, -1:], (nsub, pad))
     padded = jnp.concatenate([subbands, tail], axis=1)
     starts = jnp.minimum(sub_shifts.astype(jnp.int32), pad)  # (ndms, nsub)
-
-    def body(acc, inp):
-        row, s = inp   # row (T+pad,), s (ndms,)
-        sl = jax.vmap(
-            lambda st: jax.lax.dynamic_slice_in_dim(row, st, T))(s)
-        return acc + sl, None
-
-    acc0 = jnp.zeros((starts.shape[0], T), jnp.float32)
-    acc, _ = jax.lax.scan(body, acc0, (padded, starts.T))
-    return acc
+    return dedisperse_window_scan(padded, starts, T)
 
 
 def _dedisperse_subbands_xla(subbands: jnp.ndarray,
@@ -165,6 +156,29 @@ def _dedisperse_subbands_xla(subbands: jnp.ndarray,
     shifts_np = np.asarray(sub_shifts)
     pad = _pad_bucket(int(shifts_np.max(initial=0)))
     return _dedisperse_subbands_scan(subbands, jnp.asarray(shifts_np), pad)
+
+
+@partial(jax.jit, static_argnames=("out_len",))
+def dedisperse_window_scan(ext: jnp.ndarray, sub_shifts: jnp.ndarray,
+                           out_len: int) -> jnp.ndarray:
+    """Shift-and-sum over a pre-extended window (no edge handling):
+
+        out[d, t] = sum_s ext[s, t + sub_shifts[d, s]],  t < out_len
+
+    Callers guarantee max(sub_shifts) + out_len <= ext.shape[1] (e.g.
+    a time shard with its halo already attached).  Same scan-over-
+    subbands accumulation as _dedisperse_subbands_scan: scalar gather
+    indices, peak HBM = accumulator + the window."""
+    def body(acc, inp):
+        row, s = inp   # row (L,), s (ndms,)
+        sl = jax.vmap(
+            lambda st: jax.lax.dynamic_slice_in_dim(row, st, out_len))(s)
+        return acc + sl, None
+
+    starts = sub_shifts.astype(jnp.int32)
+    acc0 = jnp.zeros((starts.shape[0], out_len), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (ext, starts.T))
+    return acc
 
 
 def dedisperse_subbands(subbands: jnp.ndarray,

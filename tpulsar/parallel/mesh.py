@@ -153,6 +153,15 @@ class PassSpec:
     dd_pad: int = 0             # static stage-2 shift bound for the
     #                             XLA scan path (>= max sub_shift);
     #                             0 = pad by the full series length
+    seq_sharded: bool = False   # sequence-parallel front end: subbands
+    #                             arrive TIME-sharded over the dm axis,
+    #                             dedispersion runs on the local time
+    #                             chunk with a ring halo exchange, and
+    #                             one tiled all_to_all reshards the
+    #                             series to DM-sharded full length for
+    #                             the (unchanged) spectral tail.
+    #                             Requires dd_pad >= max shift and
+    #                             dd_pad <= T'/n_dm.
 
 
 def _pallas_dd_local(subb, shifts, stage_s: int, interpret: bool,
@@ -205,10 +214,34 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
     from tpulsar.kernels import accel as ak
     from tpulsar.kernels import fourier as fr
     from tpulsar.kernels import singlepulse as sp_k
-    from tpulsar.kernels.dedisperse import _dedisperse_subbands_scan
+    from tpulsar.kernels.dedisperse import (_dedisperse_subbands_scan,
+                                            dedisperse_window_scan)
+
+    n_dev = int(mesh.shape["dm"])
+
+    def seq_dedisperse_a2a(subb_loc, shifts):
+        """Sequence-parallel dedispersion: (nsub, chunk) local time
+        shard + replicated (ndms, nsub) shifts -> (ndms/n_dev, T) DM
+        shard.  The halo is the first dd_pad samples of the right
+        neighbour (ring ppermute over ICI); the last device clamps by
+        replicating its final sample, matching the single-device edge
+        semantics.  One tiled all_to_all then switches the sharded
+        axis from time to DM — the Ulysses-style reshard (SURVEY.md
+        section 5.7: the DM axis is this pipeline's 'heads')."""
+        from tpulsar.parallel.seq_dedisperse import halo_extend
+
+        chunk = subb_loc.shape[1]
+        S = spec.dd_pad
+        ext = halo_extend(subb_loc, S, "dm", n_dev)
+        series_loc = dedisperse_window_scan(
+            ext, jnp.minimum(shifts, S), chunk)     # (ndms, chunk)
+        return jax.lax.all_to_all(series_loc, "dm", split_axis=0,
+                                  concat_axis=1, tiled=True)
 
     def body(subb, shifts, keep, bank):
-        if spec.pallas_dd:
+        if spec.seq_sharded:
+            series = seq_dedisperse_a2a(subb, shifts)
+        elif spec.pallas_dd:
             series = _pallas_dd_local(subb, shifts, spec.dd_stage_s,
                                       spec.dd_interpret)
         else:
@@ -248,9 +281,11 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
                  (("lo_vals", "lo_bins", "sp_snr", "sp_idx")
                   + (("hi_vals", "hi_rbins", "hi_zidx")
                      if spec.hi else ()))}
+    in_specs = ((P(None, "dm"), P(), P(), P()) if spec.seq_sharded
+                else (P(), P("dm", None), P(), P()))
     return jax.jit(shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P("dm", None), P(), P()),
+        in_specs=in_specs,
         out_specs=out_specs,
         check_vma=False,
     ))

@@ -25,6 +25,22 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def halo_extend(subb_loc: jnp.ndarray, S: int, axis_name: str,
+                n_dev: int) -> jnp.ndarray:
+    """Extend a per-device (nsub, chunk) time shard with an S-sample
+    halo: the first S columns of the RIGHT neighbour over a ring
+    ppermute; the last device clamps by replicating its final sample
+    (matching the single-device edge semantics).  Shared by the
+    standalone seq_dedisperse and the production sharded pass."""
+    nsub = subb_loc.shape[0]
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, i - 1) for i in range(1, n_dev)]
+    halo = jax.lax.ppermute(subb_loc[:, :S], axis_name, perm)
+    edge = jnp.broadcast_to(subb_loc[:, -1:], (nsub, S))
+    halo = jnp.where(idx == n_dev - 1, edge, halo.astype(subb_loc.dtype))
+    return jnp.concatenate([subb_loc, halo], axis=1)   # (nsub, chunk+S)
+
+
 def seq_dedisperse(subbands, sub_shifts: np.ndarray, mesh: Mesh,
                    axis_name: str = "dm", max_shift: int | None = None):
     """(nsub, T) time-sharded over `axis_name` + (ndms, nsub) shifts
@@ -51,20 +67,10 @@ def seq_dedisperse(subbands, sub_shifts: np.ndarray, mesh: Mesh,
 
     def body(subb_loc, shifts):
         # subb_loc: (nsub, chunk) — this device's time chunk
-        idx = jax.lax.axis_index(axis_name)
-        # halo: first S columns of the RIGHT neighbour (device i+1);
-        # the last device clamps by replicating its final sample
-        perm = [(i, i - 1) for i in range(1, n_dev)]
-        halo = jax.lax.ppermute(subb_loc[:, :S], axis_name, perm)
-        edge = jnp.repeat(subb_loc[:, -1:], S, axis=1)
-        halo = jnp.where(idx == n_dev - 1, edge, halo)
-        ext = jnp.concatenate([subb_loc, halo], axis=1)  # (nsub, chunk+S)
+        from tpulsar.kernels.dedisperse import dedisperse_window_scan
 
-        def one_dm(sh):
-            col = jnp.arange(chunk, dtype=jnp.int32)[None, :] + sh[:, None]
-            return jnp.take_along_axis(ext, col, axis=1).sum(axis=0)
-
-        return jax.vmap(one_dm)(shifts)                 # (ndms, chunk)
+        ext = halo_extend(subb_loc, S, axis_name, n_dev)
+        return dedisperse_window_scan(ext, shifts, chunk)  # (ndms, chunk)
 
     fn = shard_map(
         body, mesh=mesh,

@@ -75,6 +75,14 @@ class SearchParams:
     fold_nbin: int = 64
     fold_npart: int = 32
     max_dms_per_chunk: int = 128    # device memory blocking
+    seq_shard: str = "auto"         # sequence-parallel dedispersion on
+    #                                 a multi-chip mesh: "on" forces it,
+    #                                 "off" disables, "auto" switches
+    #                                 when replicating the subband block
+    #                                 per device would cost more than
+    #                                 seq_shard_min_bytes (SURVEY.md
+    #                                 section 5.7 long-sequence mapping)
+    seq_shard_min_bytes: int = 2 << 30
     refine_cands: bool = True       # sub-bin (r, z) refinement of the
     #                                 reported candidates (harmpolish)
     make_plots: bool = True         # fold + single-pulse PNGs
@@ -716,6 +724,27 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
     nz = len(bank.zs) if hi else 0
     use_pallas = pallas_dd.use_pallas()
     smax = int(np.asarray(sub_shifts).max(initial=0))
+    dd_pad = dd._pad_bucket(smax)
+    # Sequence-parallel front end: shard the subband block's TIME axis
+    # instead of replicating it per device, when the mesh and the halo
+    # geometry allow it (halo depth <= per-device chunk).  Takes
+    # precedence over the Pallas stage-2 (which needs the replicated
+    # block) — it exists for exactly the case where replication is
+    # what must be avoided.
+    seq = (params.seq_shard == "on"
+           or (params.seq_shard == "auto"
+               and subb.nbytes > params.seq_shard_min_bytes))
+    seq_ok = (n_dm > 1 and T_ds % n_dm == 0
+              and dd_pad <= T_ds // n_dm)
+    if seq and not seq_ok and params.seq_shard == "on":
+        import warnings
+        warnings.warn(
+            f"seq_shard='on' cannot be honoured for this pass "
+            f"(n_dm={n_dm}, T'={T_ds}, halo={dd_pad} vs chunk="
+            f"{T_ds // max(n_dm, 1)}); falling back to per-device "
+            f"subband replication", stacklevel=2)
+    seq = seq and seq_ok
+    use_pallas = use_pallas and not seq
     stage_s = 0
     if use_pallas:
         stage_s = max(256, 1 << int(np.ceil(np.log2(max(smax, 1)))))
@@ -731,7 +760,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
         hi_nz=nz if hi_sharded else 0,
         pallas_dd=use_pallas, dd_stage_s=stage_s,
         dd_interpret=use_pallas and not pallas_dd.is_tpu_backend(),
-        dd_pad=dd._pad_bucket(smax))
+        dd_pad=dd_pad, seq_sharded=seq)
     key = (mesh, spec)
     if key not in _SHARDED_FN_CACHE:
         _SHARDED_FN_CACHE[key] = pmesh.sharded_pass_fn(mesh, spec)
