@@ -108,7 +108,11 @@ def probe_device(timeout: float, force_cpu: bool = False) -> dict | None:
     (hang, crash, or nonsense output)."""
     env = dict(os.environ)
     if force_cpu:
-        env["JAX_PLATFORMS"] = "cpu"
+        # CPU probes must not dial the accelerator runtime at all: on
+        # a wedged chip the sitecustomize PJRT registration hangs
+        # `import jax` itself, before JAX_PLATFORMS is consulted.
+        from tpulsar import cpu_subprocess_env
+        env = cpu_subprocess_env(env)
     try:
         out = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC], env=env,
@@ -416,6 +420,12 @@ def run_child(deadline: float, extra_env: dict | None = None
     env = dict(os.environ)
     if extra_env:
         env.update(extra_env)
+    if env.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # CPU children must not dial the accelerator runtime (a
+        # wedged chip hangs `import jax` via the sitecustomize
+        # plugin registration, before the env var is consulted).
+        from tpulsar import cpu_subprocess_env
+        env = cpu_subprocess_env(env)
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--measured"],
         env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True)

@@ -34,6 +34,16 @@ def _iso_config(tmp_path, monkeypatch):
     set_settings(TpulsarConfig())
 
 
+def test_doctor_healthy_environment(tmp_path, capsys):
+    """`tpulsar doctor` (the reference's install_test.py + worker-node
+    probe as one command) passes in the hermetic test environment."""
+    assert main(["doctor", "--device-timeout", "60"]) == 0
+    out = capsys.readouterr().out
+    assert "all checks passed" in out
+    assert "7-method contract" in out
+    assert "device probe" in out
+
+
 def test_init_db_and_status(tmp_path, capsys):
     db = str(tmp_path / "t.db")
     assert main(["--db", db, "init-db"]) == 0

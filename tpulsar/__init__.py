@@ -25,6 +25,23 @@ Layout (mirrors SURVEY.md section 7):
 __version__ = "0.1.0"
 
 
+def cpu_subprocess_env(base: dict | None = None) -> dict:
+    """Environment for a subprocess that must run CPU-only and never
+    touch the accelerator.  Besides JAX_PLATFORMS=cpu, this strips the
+    variables that make the container's sitecustomize register the
+    accelerator PJRT plugin at interpreter start — that registration
+    dials the device runtime during `import jax`, which on a wedged
+    chip hangs BEFORE the env var or apply_platform_env() can take
+    effect (observed live: `JAX_PLATFORMS=cpu python -c "import jax"`
+    hanging on a sick tunnel)."""
+    import os
+
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
 def apply_platform_env() -> None:
     """Make JAX honour the JAX_PLATFORMS environment variable even
     when a sitecustomize registered an accelerator backend at

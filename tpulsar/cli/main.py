@@ -103,9 +103,9 @@ def cmd_add_files(args):
     return 0
 
 
-def _make_pool(args, cfg):
-    from tpulsar.orchestrate.pool import JobPool
-    from tpulsar.orchestrate.queue_managers import get_queue_manager
+def _queue_manager_kwargs(cfg) -> dict:
+    """Per-backend constructor kwargs from config (shared by the job
+    pool and the doctor probe)."""
     state_dir = os.path.join(cfg.processing.base_working_directory,
                              ".queue_state")
     qm_kw = {}
@@ -130,7 +130,14 @@ def _make_pool(args, cfg):
         qm_kw = {"hosts": hosts,
                  "launcher": cfg.jobpooler.tpu_launcher,
                  "state_file": os.path.join(state_dir, "tpu_slice.json")}
-    qm = get_queue_manager(cfg.jobpooler.queue_manager, **qm_kw)
+    return qm_kw
+
+
+def _make_pool(args, cfg):
+    from tpulsar.orchestrate.pool import JobPool
+    from tpulsar.orchestrate.queue_managers import get_queue_manager
+    qm = get_queue_manager(cfg.jobpooler.queue_manager,
+                           **_queue_manager_kwargs(cfg))
     return JobPool(_tracker(args), qm,
                    cfg.processing.base_results_directory,
                    max_attempts=cfg.jobpooler.max_attempts,
@@ -470,6 +477,135 @@ def cmd_search(args):
     return search_job.main(argv)
 
 
+def cmd_doctor(args):
+    """Environment probe: the reference's install_test.py dependency
+    check and test_job.py worker-node probe (imports, directories
+    writable, job tracker reachable, queue-manager contract, and an
+    accelerator health probe in a subprocess under a timeout) rolled
+    into one operator command.  Exit 0 = healthy."""
+    import importlib
+    import json
+    import subprocess
+    import tempfile
+
+    from tpulsar.config import settings
+
+    failures = []
+
+    def report(name: str, ok: bool, detail: str = "") -> None:
+        print(f"  [{'ok' if ok else 'FAIL'}] {name}"
+              + (f" — {detail}" if detail else ""))
+        if not ok:
+            failures.append(name)
+
+    print("dependencies:")
+    for mod, hint in [("numpy", "pip install numpy"),
+                      ("jax", "pip install jax (TPU: jax[tpu])"),
+                      ("matplotlib", "pip install matplotlib "
+                                     "(plots/stats dashboards)"),
+                      ("yaml", "pip install pyyaml (YAML configs; "
+                               "python configs work without it)")]:
+        try:
+            importlib.import_module(mod)
+            report(f"import {mod}", True)
+        except ImportError as e:
+            report(f"import {mod}", False, f"{e}; hint: {hint}")
+
+    cfg = settings()
+    print("config:")
+    try:
+        # create_dirs: a fresh install's missing directories are not a
+        # health problem — the writability probes below verify them
+        cfg.check_sanity(create_dirs=True)
+        report("check_sanity", True)
+    except Exception as e:
+        report("check_sanity", False, str(e)[:200])
+
+    print("directories writable:")
+    for name, path in [
+            ("basic.log_dir", cfg.basic.log_dir),
+            ("download.datadir", cfg.download.datadir),
+            ("processing.base_working_directory",
+             cfg.processing.base_working_directory),
+            ("processing.base_results_directory",
+             cfg.processing.base_results_directory)]:
+        try:
+            os.makedirs(path, exist_ok=True)
+            with tempfile.TemporaryFile(dir=path):
+                pass
+            report(f"{name} = {path}", True)
+        except OSError as e:
+            report(f"{name} = {path}", False, str(e))
+
+    print("job tracker:")
+    try:
+        from tpulsar.orchestrate import jobtracker
+
+        db = jobtracker.JobTracker(args.db or cfg.background.jobtracker_db)
+        n = db.query("SELECT count(*) FROM jobs", fetchone=True)
+        report("query jobs table", True, f"{n[0]} jobs")
+    except Exception as e:
+        report("query jobs table", False,
+               f"{e}; hint: run `tpulsar init-db` first")
+
+    print("queue manager:")
+    try:
+        from tpulsar.orchestrate.queue_managers import get_queue_manager
+
+        qm = get_queue_manager(cfg.jobpooler.queue_manager,
+                               **_queue_manager_kwargs(cfg))
+        missing = [m for m in ("submit", "can_submit", "is_running",
+                               "delete", "status", "had_errors",
+                               "get_errors")
+                   if not callable(getattr(qm, m, None))]
+        report(f"{cfg.jobpooler.queue_manager} implements the 7-method "
+               f"contract", not missing, ",".join(missing))
+    except Exception as e:
+        report("instantiate queue manager", False, str(e)[:200])
+
+    print("accelerator:")
+    probe_src = ("import json, jax; d = jax.devices(); "
+                 "import jax.numpy as jnp; "
+                 "(jnp.ones((64, 64)) @ jnp.ones((64, 64)))"
+                 ".block_until_ready(); "
+                 "print(json.dumps({'platform': d[0].platform, "
+                 "'ndev': len(d)}))")
+    probe_env = dict(os.environ)
+    if probe_env.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # This process is pinned to CPU: the probe must not dial the
+        # accelerator runtime at all (a wedged chip hangs `import
+        # jax` itself via the sitecustomize plugin registration).
+        import tpulsar
+
+        probe_env = tpulsar.cpu_subprocess_env()
+    try:
+        out = subprocess.run([sys.executable, "-c", probe_src],
+                             capture_output=True, text=True,
+                             env=probe_env,
+                             timeout=args.device_timeout)
+        rec = None
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if out.returncode == 0 and rec:
+            report("device probe", True,
+                   f"{rec['ndev']}x {rec['platform']}")
+        else:
+            report("device probe", False,
+                   out.stderr.strip()[-200:] or "no output")
+    except subprocess.TimeoutExpired:
+        report("device probe", False,
+               f"hung > {args.device_timeout:.0f} s (wedged chip?)")
+
+    print(("all checks passed" if not failures
+           else f"{len(failures)} check(s) FAILED: "
+                + ", ".join(failures)))
+    return 0 if not failures else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpulsar", description=__doc__)
     p.add_argument("--db", default=None, help="job-tracker DB path")
@@ -549,6 +685,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--outdir", required=True)
     sp.add_argument("--no-accel", action="store_true")
     sp.set_defaults(fn=cmd_search)
+
+    sp = sub.add_parser(
+        "doctor",
+        help="probe the environment: imports, config, directories, "
+             "job tracker, queue manager, accelerator")
+    sp.add_argument("--device-timeout", type=float, default=60.0,
+                    help="accelerator probe timeout, seconds")
+    sp.set_defaults(fn=cmd_doctor)
     return p
 
 

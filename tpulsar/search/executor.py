@@ -74,7 +74,14 @@ class SearchParams:
     #                                 series fold below
     fold_nbin: int = 64
     fold_npart: int = 32
-    max_dms_per_chunk: int = 128    # device memory blocking
+    max_dms_per_chunk: int = 128    # device memory blocking; the
+    #                                 effective chunk is additionally
+    #                                 capped so the per-chunk series +
+    #                                 spectrum + whitening buffers fit
+    #                                 spectral_hbm_budget (a full Mock
+    #                                 beam at 128 trials would need
+    #                                 ~11 GB of transients)
+    spectral_hbm_budget: int = 6 << 30
     seq_shard: str = "auto"         # sequence-parallel dedispersion on
     #                                 a multi-chip mesh: "on" forces it,
     #                                 "off" disables, "auto" switches
@@ -255,6 +262,16 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                          num_dm_trials=num_trials, timers=timers)
 
 
+def _budget_dm_chunk(nfft: int, hi: bool, budget: int) -> int:
+    """Largest DM chunk whose per-trial spectral working set fits the
+    spectral HBM budget: series (f32, nfft) + padded copy (f32, nfft)
+    + complex spectrum (c64, ~nfft/2 bins = 4*nfft bytes) + powers and
+    whitening scale (2x f32, ~nfft/2 = 2*nfft each) + the scaled
+    spectrum for the hi stage (c64, ~nfft/2 = 4*nfft)."""
+    per_trial = (4 + 4 + 4 + 2 + 2 + (4 if hi else 0)) * nfft
+    return max(4, int(budget // per_trial))
+
+
 def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                  plan: list[ddplan.DedispStep],
                  params: SearchParams | None = None,
@@ -342,8 +359,19 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                     sp_chunks.append(events)
                 num_trials += len(dms)
             else:
-                for lo in range(0, len(dms), params.max_dms_per_chunk):
-                    dm_chunk = dms[lo: lo + params.max_dms_per_chunk]
+                chunk_sz = min(params.max_dms_per_chunk,
+                               _budget_dm_chunk(
+                                   ddplan.choose_n(subb.shape[1]),
+                                   hi=params.run_hi_accel
+                                   and params.hi_accel_zmax > 0,
+                                   budget=params.spectral_hbm_budget))
+                # Split the pass evenly so every chunk shares one
+                # compile signature (76 trials at a 51-trial budget
+                # run as 38+38, not 51+25).
+                n_chunks = -(-len(dms) // chunk_sz)
+                chunk_sz = -(-len(dms) // n_chunks)
+                for lo in range(0, len(dms), chunk_sz):
+                    dm_chunk = dms[lo: lo + chunk_sz]
                     with timers.timing("dedispersing"):
                         series = dd.dedisperse_subbands(
                             subb,
