@@ -33,11 +33,13 @@ Environment knobs:
   TPULSAR_BENCH_PROBE_TIMEOUT  health-probe timeout, s (default 180)
   TPULSAR_BENCH_DEADLINE  measured-run hard deadline, s (default 900)
   TPULSAR_BENCH_TOTAL_BUDGET   target ceiling on the parent's TOTAL
-                          wall-clock, s (default 1800): every phase's
+                          wall-clock, s (default 900): every phase's
                           timeout is clamped to the remaining budget
                           so the one JSON line appears within roughly
                           the budget (kill/drain slop can add ~30 s;
-                          set an outer driver timeout with margin)
+                          set an outer driver timeout with margin —
+                          round 1 was killed by an outer timeout
+                          before it could print anything)
   TPULSAR_BENCH_CPU_FALLBACK   "0" to skip the reduced-scale CPU run
                           when the TPU is unhealthy (default on)
   TPULSAR_BENCH_CONFIG    focused BASELINE.json config instead of the
@@ -68,7 +70,12 @@ TSAMP = 65.476e-6
 T_FULL = 3_932_160      # ~257 s observation
 FCTR, BW = 1375.5, 322.617
 
-P_TRUE, DM_TRUE = 0.012345, 250.0
+# DM 220 sits in the FIRST pass of the survey plan's second step, so
+# the injected pulsar stays inside the searched DM range even when
+# TPULSAR_BENCH_SCALE shrinks each step's pass count (the reduced-scale
+# CPU fallback run was missing it at DM 250: its truncated step only
+# reached DM ~236).
+P_TRUE, DM_TRUE = 0.012345, 220.0
 
 PARTIAL_PATH = os.path.join(_REPO, "bench_partial.jsonl")
 
@@ -464,7 +471,7 @@ def main() -> None:
                                          "180"))
     deadline = float(os.environ.get("TPULSAR_BENCH_DEADLINE", "900"))
     total_budget = float(os.environ.get("TPULSAR_BENCH_TOTAL_BUDGET",
-                                        "1800"))
+                                        "900"))
 
     result: dict | None = None
     t_start = time.time()
@@ -492,6 +499,13 @@ def main() -> None:
                 # process holds the chip; on success the measured
                 # child reads the cached verdict instead of probing
                 # mid-run (device contention).
+                # Each smoke probe is capped at a FRACTION of the
+                # remaining budget: two hung probes at a fixed cap
+                # would otherwise starve the measured run to the 5 s
+                # floor and guarantee a timeout record.
+                def smoke_cap() -> float:
+                    return min(probe_timeout + 330, remaining() * 0.3)
+
                 _log("pre-running Pallas smoke probe")
                 try:
                     smoke = subprocess.run(
@@ -501,7 +515,7 @@ def main() -> None:
                          "smoke_test_ok; print(smoke_test_ok())"
                          % _REPO],
                         capture_output=True, text=True,
-                        timeout=min(probe_timeout + 330, remaining()))
+                        timeout=smoke_cap())
                     _log(f"Pallas smoke: {smoke.stdout.strip()[-40:]}")
                 except (subprocess.TimeoutExpired, OSError):
                     _log("Pallas smoke probe hung (kernel will use "
@@ -520,7 +534,7 @@ def main() -> None:
                          "_batch_path_usable; "
                          "print(_batch_path_usable())" % _REPO],
                         capture_output=True, text=True,
-                        timeout=min(probe_timeout + 330, remaining()))
+                        timeout=smoke_cap())
                     _log(f"accel batch smoke: "
                          f"{asmoke.stdout.strip()[-40:]}")
                     if "True" not in asmoke.stdout:

@@ -134,6 +134,37 @@ def test_two_stage_error_bounded_across_pass():
         assert c > 0.90, f"DM {dms[i]}: corr {c}"
 
 
+def test_window_scan_matches_subband_scan():
+    """dedisperse_window_scan on a pre-extended window equals the
+    edge-padded stage-2 scan (they share the accumulation; the window
+    variant is the halo-exchange building block)."""
+    rng = np.random.default_rng(11)
+    nsub, T, ndms = 8, 1024, 5
+    subb = rng.standard_normal((nsub, T)).astype(np.float32)
+    shifts = (rng.integers(0, 64, size=(ndms, nsub))).astype(np.int32)
+    want = np.asarray(dd._dedisperse_subbands_xla(jnp.asarray(subb),
+                                                  shifts))
+    # window = subbands + 64-sample edge-replicated halo
+    ext = np.concatenate([subb, np.repeat(subb[:, -1:], 64, axis=1)],
+                         axis=1)
+    got = np.asarray(dd.dedisperse_window_scan(
+        jnp.asarray(ext), jnp.asarray(shifts), T))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_shift_rows_clamps_and_matches_reference():
+    """_shift_rows (edge-pad + dynamic slice) == the index formula
+    out[i,t] = data[i, min(t+s, T-1)], including shifts at/above pad."""
+    rng = np.random.default_rng(12)
+    data = rng.standard_normal((4, 257)).astype(np.float32)
+    shifts = np.array([0, 3, 255, 256], dtype=np.int32)
+    got = np.asarray(dd._shift_gather(jnp.asarray(data), shifts))
+    T = data.shape[1]
+    idx = np.minimum(np.arange(T)[None, :] + shifts[:, None], T - 1)
+    want = np.take_along_axis(data, idx, axis=1)
+    np.testing.assert_allclose(got, want)
+
+
 def test_pallas_dedisperse_matches_gather():
     """The Pallas sliding-window kernel must agree exactly with the
     XLA gather formulation (interpret mode off-TPU)."""

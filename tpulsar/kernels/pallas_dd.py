@@ -211,6 +211,7 @@ def smoke_test_ok(timeout: float = 300.0) -> bool:
         return True
     import subprocess
     import sys
+    detail = ""
     try:
         res = subprocess.run(
             [sys.executable, "-c", _SMOKE_SRC],
@@ -218,8 +219,15 @@ def smoke_test_ok(timeout: float = 300.0) -> bool:
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))))
         ok = res.returncode == 0 and "PALLAS_SMOKE_OK" in res.stdout
-    except (subprocess.TimeoutExpired, OSError):
+        if not ok:
+            detail = (f"rc={res.returncode}: "
+                      + (res.stderr or "").strip()[-500:])
+    except subprocess.TimeoutExpired:
         ok = False
+        detail = f"hung > {timeout:.0f} s"
+    except OSError as e:
+        ok = False
+        detail = str(e)
     _SMOKE_OK = ok
     if ok:
         try:
@@ -230,7 +238,8 @@ def smoke_test_ok(timeout: float = 300.0) -> bool:
     else:
         import warnings
         warnings.warn("Pallas smoke test failed/hung in subprocess; "
-                      "using XLA dedispersion fallback this process")
+                      "using XLA dedispersion fallback this process "
+                      f"({detail})")
     return ok
 
 
