@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""AOT-compile the full-scale search programs and report their HBM
+footprints WITHOUT executing anything on the device.
+
+Why this exists: on the axon runtime a runtime HBM OOM can wedge the
+chip for hours (see docs/architecture.md memory discipline), while a
+compile-stage error is a clean HTTP error.  This tool lowers and
+compiles every whole-beam program at headline benchmark shapes
+(960 x 3.93M Mock beam, the survey plan's pass geometries) and prints
+each executable's compiler-reported memory so an over-budget program
+is caught before it ever runs.
+
+Usage:
+    python tools/aot_check.py [--scale 1.0] [--accel]
+
+Exit 0 = every program compiled; nonzero lists the failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, ".jax_cache"))
+
+NCHAN, TSAMP = 960, 65.476e-6
+T_FULL = 3_932_160
+FCTR, BW = 1375.5, 322.617
+
+
+def _mem_stats(compiled) -> str:
+    try:
+        an = compiled.memory_analysis()
+        tot = (an.temp_size_in_bytes + an.argument_size_in_bytes
+               + an.output_size_in_bytes)
+        return (f"temp {an.temp_size_in_bytes / 2**30:.2f} GiB, "
+                f"args {an.argument_size_in_bytes / 2**30:.2f} GiB, "
+                f"out {an.output_size_in_bytes / 2**30:.2f} GiB, "
+                f"total {tot / 2**30:.2f} GiB")
+    except Exception:
+        return "(memory analysis unavailable)"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--accel", action="store_true",
+                    help="also compile the hi-accel correlation block")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import tpulsar
+
+    tpulsar.apply_platform_env()
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    from tpulsar.kernels import dedisperse as dd
+    from tpulsar.kernels import fourier as fr
+    from tpulsar.kernels import rfi as rfi_k
+    from tpulsar.kernels import singlepulse as sp_k
+    from tpulsar.plan import ddplan
+
+    nsamp = int(T_FULL * args.scale)
+    nsamp -= nsamp % 30720
+    freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
+    plan = ddplan.survey_plan("pdev")
+
+    failures: list[str] = []
+
+    def check(name: str, fn, *shaped_args, **kw):
+        try:
+            compiled = jax.jit(fn, **kw).lower(*shaped_args).compile()
+            print(f"  [ok] {name}: {_mem_stats(compiled)}", flush=True)
+        except Exception as e:
+            failures.append(name)
+            msg = str(e).splitlines()
+            print(f"  [FAIL] {name}: {msg[0] if msg else e!r}",
+                  flush=True)
+            if os.environ.get("AOT_CHECK_VERBOSE"):
+                traceback.print_exc()
+
+    S = jax.ShapeDtypeStruct
+    blk = S((NCHAN, nsamp), jnp.uint8)
+    nblocks = nsamp // 2048
+
+    print("rfi:", flush=True)
+    check("cell_stats_chan", lambda d: rfi_k._cell_stats_chan(d, 2048),
+          blk)
+    check("apply_mask_chan",
+          lambda d, m, f: rfi_k.apply_mask_chan(d, m, f, 2048),
+          blk, S((nblocks, NCHAN), jnp.bool_), S((NCHAN,), jnp.float32))
+
+    # one representative pass per plan step
+    for step in plan:
+        T_ds = nsamp // step.downsamp
+        ppass = next(iter(step.passes()))
+        ch_sh, sub_sh = dd.plan_pass_shifts(
+            freqs, step.numsub, ppass.subdm, np.asarray(ppass.dms),
+            TSAMP, step.downsamp)
+        pad1 = dd._pad_bucket(int(ch_sh.max(initial=0)))
+        pad2 = dd._pad_bucket(int(sub_sh.max(initial=0)))
+        ndms = sub_sh.shape[0]
+        print(f"step downsamp={step.downsamp} (T'={T_ds}, "
+              f"ndms={ndms}):", flush=True)
+        check(f"form_subbands ds={step.downsamp}",
+              lambda d, s, _n=step.numsub, _ds=step.downsamp, _p=pad1:
+              dd._form_subbands_jit(d, s, _n, _ds, _p),
+              blk, S((NCHAN,), jnp.int32))
+        check(f"dedisperse_scan ds={step.downsamp}",
+              lambda sb, sh, _p=pad2:
+              dd._dedisperse_subbands_scan(sb, sh, _p),
+              S((step.numsub, T_ds), jnp.float32),
+              S((ndms, step.numsub), jnp.int32))
+        nfft = ddplan.choose_n(T_ds)
+        from tpulsar.search.executor import _budget_dm_chunk
+        chunk = min(ndms, _budget_dm_chunk(nfft, True, 6 << 30))
+        check(f"sp_boxcars ds={step.downsamp}",
+              lambda s: sp_k.boxcar_search(sp_k.normalize_series(s)),
+              S((chunk, T_ds), jnp.float32))
+        check(f"spectrum+whiten ds={step.downsamp}",
+              lambda s, _n=nfft: fr.whitened_powers(
+                  fr.complex_spectrum(fr.pad_series(s, _n))),
+              S((chunk, T_ds), jnp.float32))
+
+    if args.accel:
+        from tpulsar.kernels import accel as ak
+        bank = ak.build_template_bank(50.0)
+        nz = len(bank.zs)
+        nfft = ddplan.choose_n(nsamp)
+        nbins = nfft // 2 + 1
+        dmc = ak.plane_dm_chunk(nbins, nz)
+        print(f"accel (nz={nz}, nbins={nbins}, dm_chunk={dmc}):",
+              flush=True)
+        check("accel_block_topk",
+              lambda sp, bf: ak._accel_block_topk(
+                  sp, bf, bank.seg, bank.step, bank.width, nz, 8, 32),
+              S((dmc, nbins), jnp.complex64),
+              S(bank.bank_fft.shape, jnp.complex64))
+
+    if failures:
+        print(f"{len(failures)} FAILED: {', '.join(failures)}")
+        return 1
+    print("all programs compiled")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -292,7 +292,19 @@ def cmd_stats(args):
     """Pipeline statistics dashboard (reference
     bin/show_pipeline_stats.py:12-99): cumulative job counts, restore
     history, and raw-data disk usage — rendered to a PNG (and printed
-    as text)."""
+    as text).  --follow re-renders every --interval seconds, the
+    reference's self-updating figure."""
+    if getattr(args, "follow", False):
+        import time as _time
+        args.follow = False
+        try:
+            while True:
+                cmd_stats(args)
+                print(f"-- refreshing every {args.interval:.0f} s "
+                      f"(Ctrl-C to stop) --", flush=True)
+                _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
     t = _tracker(args)
     jobs = t.query("SELECT status, COUNT(*) c FROM jobs GROUP BY status")
     files = t.query("SELECT status, COUNT(*) c, COALESCE(SUM(size),0) s "
@@ -346,6 +358,7 @@ def cmd_stats(args):
         fig.suptitle("tpulsar pipeline stats")
         fig.tight_layout()
         fig.savefig(args.png, dpi=100)
+        plt.close(fig)
         print(f"wrote {args.png}")
     return 0
 
@@ -673,6 +686,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("stats")
     sp.add_argument("--png", default=None,
                     help="also render the dashboard to this PNG")
+    sp.add_argument("--follow", action="store_true",
+                    help="re-render every --interval seconds")
+    sp.add_argument("--interval", type=float, default=30.0)
     sp.set_defaults(fn=cmd_stats)
 
     sp = sub.add_parser("monitor")
