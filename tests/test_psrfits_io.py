@@ -52,6 +52,43 @@ def test_synth_roundtrip_recovers_data(tmp_path):
     assert np.corrcoef(got.ravel(), data.ravel())[0, 1] > 0.999
 
 
+def test_read_all_uint8_affine_roundtrip(tmp_path):
+    """The quantized whole-beam read maps back to the calibrated
+    float32 block through its per-channel affine (scale, offset) to
+    within the quantization step, and clips rather than wraps."""
+    from tpulsar.io.psrfits import SpectraInfo
+
+    spec = synth.BeamSpec(nchan=16, nsamp=2048, nbits=4, seed=5)
+    psr = synth.PulsarSpec(period_s=0.05, dm=20.0, snr_per_sample=1.0)
+    fns = synth.synth_beam(str(tmp_path / "q"), spec, pulsars=[psr],
+                           merged=True)
+    si = SpectraInfo(fns)
+    want = si.read_all()
+    got, scale, offset = si.read_all_uint8()
+    assert got.dtype == np.uint8 and got.shape == want.shape
+    recon = got.astype(np.float32) * scale + offset
+    # interior (non-clipped) samples reconstruct to within one step
+    interior = (got > 0) & (got < 255)
+    assert interior.mean() > 0.95
+    err = np.abs(recon - want)[interior]
+    assert float(err.max()) <= float(scale.max()) * 0.51 + 1e-6
+    # per-channel noise spans ~the target number of steps
+    assert 10 < np.median(np.std(got.astype(np.float32), axis=0)) < 60
+    # the scale is SHARED (cross-channel weighting preserved)
+    assert np.all(scale == scale[0])
+
+
+def test_search_params_rejects_bad_mode_values():
+    import pytest
+
+    from tpulsar.search import executor
+
+    with pytest.raises(ValueError, match="block_quantize"):
+        executor.SearchParams(block_quantize="always")
+    with pytest.raises(ValueError, match="seq_shard"):
+        executor.SearchParams(seq_shard="true")
+
+
 def test_band_flip(tmp_path):
     spec = small_spec(nbits=8, descending_band=True)
     data = synth.make_dynamic_spectrum(spec)

@@ -345,6 +345,84 @@ class SpectraInfo:
                     med.astype(np.float32), (finfo.num_pad, block.shape[1])).copy())
         return np.concatenate(pieces, axis=0)
 
+    def _quantize_affine(self, target_std_lsb: float,
+                         chunk_subints: int
+                         ) -> tuple[np.ndarray, np.ndarray]:
+        """(scale, offset) for read_all_uint8, from subint chunks
+        sampled across the WHOLE observation (first/middle/last of
+        each file) so time-varying calibration (per-row DAT_SCL/OFFS/
+        WTS, channels dead early but alive later) is represented.
+
+        One SHARED scale for every channel — chosen so the 98th-
+        percentile channel noise spans `target_std_lsb` steps — keeps
+        the cross-channel weighting of the dedispersion sum identical
+        to the float32 path (a per-channel scale would silently
+        whiten the bandpass); quieter channels just use fewer steps
+        (quantization noise ~(sigma/target)^2/12, well under 1%).
+        Only the offset is per channel (median centered at 128)."""
+        samples = []
+        for ii, finfo in enumerate(self._files):
+            picks = {0, finfo.num_subint // 2,
+                     max(0, finfo.num_subint - chunk_subints)}
+            for r0 in sorted(picks):
+                hi = min(r0 + chunk_subints, finfo.num_subint)
+                if hi > r0:
+                    samples.append(self.read_subints(ii, r0, hi))
+        pool = np.concatenate(samples, axis=0)
+        med = np.median(pool, axis=0)
+        mad = np.median(np.abs(pool - med), axis=0)
+        sigma = 1.4826 * mad
+        ref = float(np.percentile(sigma, 98))
+        scale = np.float32(max(ref / target_std_lsb, 1e-9))
+        offset = (med - 128.0 * scale).astype(np.float32)
+        return np.full(self.num_channels, scale, np.float32), offset
+
+    def read_all_uint8(self, target_std_lsb: float = 18.0,
+                       chunk_subints: int = 16
+                       ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Decode the whole observation into one (N, nchan) uint8
+        block plus the per-channel affine map back to calibrated
+        units: calibrated ~= block * scale + offset.
+
+        Why: a full Mock beam decoded to float32 is ~15 GB — as large
+        as the device HBM — while the search is sigma-based and
+        invariant under one global rescale.  The shared scale puts the
+        98th-percentile channel noise at `target_std_lsb` steps with
+        each channel's median at 128 (+-7 sigma of headroom before
+        clipping); see _quantize_affine for why the scale is NOT per
+        channel.  Decoding is streamed `chunk_subints` at a time so
+        the float32 transient stays bounded; inter-file padding gets
+        each channel's quantized median from that file's own tail,
+        matching read_all's padding semantics.
+        """
+        nchan = self.num_channels
+        nsblk = self.spectra_per_subint
+        total = int(sum(f.num_subint * nsblk + f.num_pad
+                        for f in self._files))
+        out = np.empty((total, nchan), np.uint8)
+        scale, offset = self._quantize_affine(target_std_lsb,
+                                              chunk_subints)
+        pos = 0
+        for ii, finfo in enumerate(self._files):
+            file_start = pos
+            for r0 in range(0, finfo.num_subint, chunk_subints):
+                hi = min(r0 + chunk_subints, finfo.num_subint)
+                blockf = self.read_subints(ii, r0, hi)
+                q = np.rint((blockf - offset) / scale)
+                out[pos: pos + len(blockf)] = np.clip(
+                    q, 0, 255).astype(np.uint8)
+                pos += len(blockf)
+            if finfo.num_pad:
+                # pad fill from THIS file's own tail (never the
+                # previous file's pad rows); empty file -> mid-level
+                tail = out[max(file_start, pos - 1024): pos]
+                medq = (np.median(tail, axis=0).astype(np.uint8)
+                        if len(tail) else
+                        np.full(nchan, 128, np.uint8))
+                out[pos: pos + finfo.num_pad] = medq[None, :]
+                pos += finfo.num_pad
+        return out[:pos], scale, offset
+
 
 def unpack_samples(raw: np.ndarray, nbits: int, signed: bool = False) -> np.ndarray:
     """Unpack packed sample bytes to integer samples.

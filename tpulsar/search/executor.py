@@ -90,6 +90,14 @@ class SearchParams:
     #                                 seq_shard_min_bytes (SURVEY.md
     #                                 section 5.7 long-sequence mapping)
     seq_shard_min_bytes: int = 2 << 30
+    block_quantize: str = "auto"    # read beams as uint8 with a
+    #                                 per-channel affine map: "on"
+    #                                 always, "off" never (float32),
+    #                                 "auto" when the float32 block
+    #                                 would exceed block_quantize_min
+    #                                 (a full Mock beam is ~15 GB as
+    #                                 float32 — the device's HBM)
+    block_quantize_min: int = 1 << 30
     refine_cands: bool = True       # sub-bin (r, z) refinement of the
     #                                 reported candidates (harmpolish)
     make_plots: bool = True         # fold + single-pulse PNGs
@@ -97,6 +105,13 @@ class SearchParams:
     #                                 this (reference set_up_job guard,
     #                                 PALFA2_presto_search.py:450);
     #                                 0 = search everything
+
+    def __post_init__(self):
+        for field in ("seq_shard", "block_quantize"):
+            v = getattr(self, field)
+            if v not in ("on", "off", "auto"):
+                raise ValueError(
+                    f"{field} must be 'on'/'off'/'auto', got {v!r}")
 
     def provenance(self) -> dict:
         d = dataclasses.asdict(self)
@@ -181,7 +196,14 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
         plan, _obs, nsub = ddplan.plan_for(si, numsub=params.nsub)
 
     # ---------------------------------------------------------- read + RFI
-    block = si.read_all()                     # (T, nchan) ascending freq
+    f32_bytes = int(si.N) * si.num_channels * 4
+    quantize = (params.block_quantize == "on"
+                or (params.block_quantize == "auto"
+                    and f32_bytes > params.block_quantize_min))
+    if quantize:
+        block, _qscale, _qoff = si.read_all_uint8()
+    else:
+        block = si.read_all()                 # (T, nchan) ascending freq
     with timers.timing("rfifind"):
         # One host transpose, one transfer: the block lives on device
         # channel-major in its native dtype (uint8 beams stay 4x
