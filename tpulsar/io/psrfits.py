@@ -291,20 +291,45 @@ class SpectraInfo:
             data = data[:, ::-1]
         return np.ascontiguousarray(data)
 
+    def _fast4_applicable(self) -> bool:
+        """Shared guard for the native 4-bit fast paths."""
+        if (self.bits_per_sample != 4 or self.signed_ints
+                or self.num_polns != 1 or self.num_channels % 2):
+            return False
+        from tpulsar import native
+        return native.load() is not None
+
+    def _row_effective_affine(self, rows, r: int, nchan: int
+                              ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-subint-row calibration folded to one (eff_scl,
+        eff_off) per channel, FILE channel order:
+        (x - z)*scl*wts + offs*wts = x*(scl*wts) + (offs - z*scl)*wts.
+        The single home of this algebra — both native fast paths
+        (float32 calibrate and uint8 requantize) fold through it."""
+        scl = (np.asarray(rows["DAT_SCL"][r], np.float32)
+               .reshape(nchan) if self.need_scale
+               else np.ones(nchan, np.float32))
+        offs = (np.asarray(rows["DAT_OFFS"][r], np.float32)
+                .reshape(nchan) if self.need_offset
+                else np.zeros(nchan, np.float32))
+        eff_off = offs - self.zero_off * scl
+        eff_scl = scl
+        if self.need_weight:
+            wts = np.asarray(rows["DAT_WTS"][r],
+                             np.float32).reshape(nchan)
+            eff_scl = eff_scl * wts
+            eff_off = eff_off * wts
+        return eff_scl, eff_off
+
     def _read_fused_4bit(self, rows, raw, nrows, nsblk, nchan,
                          apply_calibration):
         """Single-poln 4-bit fast path: the native fused unpack +
-        calibrate kernel (tpulsar/native/unpack.cpp), with zero-off
-        and weights folded into per-row effective scale/offset:
-        (x - z)*scl*wts + offs*wts = x*(scl*wts) + (offs - z*scl)*wts.
+        calibrate kernel (tpulsar/native/unpack.cpp).
         Returns (nrows*nsblk, nchan) float32 or None if inapplicable.
         """
-        if (self.bits_per_sample != 4 or self.signed_ints
-                or self.num_polns != 1 or nchan % 2):
+        if not self._fast4_applicable():
             return None
         from tpulsar import native
-        if native.load() is None:
-            return None
         packed = np.ascontiguousarray(
             np.asarray(raw).reshape(nrows, nsblk, nchan // 2))
         ones = np.ones(nchan, dtype=np.float32)
@@ -312,17 +337,8 @@ class SpectraInfo:
         out = np.empty((nrows * nsblk, nchan), dtype=np.float32)
         for r in range(nrows):
             if apply_calibration:
-                scl = (np.asarray(rows["DAT_SCL"][r], np.float32)
-                       .reshape(nchan) if self.need_scale else ones)
-                offs = (np.asarray(rows["DAT_OFFS"][r], np.float32)
-                        .reshape(nchan) if self.need_offset else zeros)
-                eff_off = offs - self.zero_off * scl
-                eff_scl = scl
-                if self.need_weight:
-                    wts = np.asarray(rows["DAT_WTS"][r],
-                                     np.float32).reshape(nchan)
-                    eff_scl = eff_scl * wts
-                    eff_off = eff_off * wts
+                eff_scl, eff_off = self._row_effective_affine(
+                    rows, r, nchan)
             else:
                 eff_scl, eff_off = ones, zeros
             res = native.unpack4_calibrate(packed[r], eff_scl, eff_off)
@@ -377,6 +393,42 @@ class SpectraInfo:
         offset = (med - 128.0 * scale).astype(np.float32)
         return np.full(self.num_channels, scale, np.float32), offset
 
+    def _read_quantized_4bit(self, ii: int, lo: int, hi: int,
+                             qscale: np.ndarray, qoffset: np.ndarray,
+                             out_slice: np.ndarray) -> bool:
+        """Single-poln 4-bit fast path for read_all_uint8: the native
+        fused unpack + requantize kernel (unpack.cpp), with per-row
+        calibration and the block affine folded into one per-channel
+        (a, b): q = clip(round(x*a + b)).  Writes into out_slice
+        (ascending-frequency channel order) and returns True, or
+        False if inapplicable (caller uses the NumPy path)."""
+        if not self._fast4_applicable():
+            return False
+        from tpulsar import native
+        finfo = self._files[ii]
+        subint_hdu = fitscore.get_hdu(finfo.hdus, "SUBINT")
+        rows = subint_hdu.data[lo:hi]
+        raw = np.asarray(rows["DATA"])
+        nrows = hi - lo
+        nsblk = self.spectra_per_subint
+        nchan = self.num_channels
+        packed = np.ascontiguousarray(
+            raw.reshape(nrows, nsblk, nchan // 2))
+        qs = float(qscale[0])
+        # qoffset is in ascending-frequency order; calibration arrays
+        # are in file order
+        qoff_file = qoffset[::-1] if self.need_flipband else qoffset
+        for r in range(nrows):
+            eff_scl, eff_off = self._row_effective_affine(rows, r, nchan)
+            a = eff_scl / qs
+            b = (eff_off - qoff_file) / qs
+            res = native.unpack4_quantize(packed[r], a, b)
+            if res is None:
+                return False
+            out_slice[r * nsblk:(r + 1) * nsblk] = \
+                res[:, ::-1] if self.need_flipband else res
+        return True
+
     def read_all_uint8(self, target_std_lsb: float = 18.0,
                        chunk_subints: int = 16
                        ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -407,6 +459,11 @@ class SpectraInfo:
             file_start = pos
             for r0 in range(0, finfo.num_subint, chunk_subints):
                 hi = min(r0 + chunk_subints, finfo.num_subint)
+                n = (hi - r0) * nsblk
+                if self._read_quantized_4bit(ii, r0, hi, scale, offset,
+                                             out[pos: pos + n]):
+                    pos += n
+                    continue
                 blockf = self.read_subints(ii, r0, hi)
                 q = np.rint((blockf - offset) / scale)
                 out[pos: pos + len(blockf)] = np.clip(

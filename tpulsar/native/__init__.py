@@ -63,6 +63,9 @@ def load() -> ctypes.CDLL | None:
         lib.tpulsar_unpack4_cal.argtypes = [
             u8p, f32p, ctypes.c_size_t, ctypes.c_size_t, f32p, f32p]
         lib.tpulsar_unpack4_cal.restype = None
+        lib.tpulsar_unpack4_q8.argtypes = [
+            u8p, u8p, ctypes.c_size_t, ctypes.c_size_t, f32p, f32p]
+        lib.tpulsar_unpack4_q8.restype = None
         _lib = lib
         return _lib
 
@@ -80,6 +83,26 @@ def unpack_bits(raw: np.ndarray, nbits: int) -> np.ndarray | None:
     fn = {4: lib.tpulsar_unpack4, 2: lib.tpulsar_unpack2,
           1: lib.tpulsar_unpack1}[nbits]
     fn(raw.reshape(-1), out.reshape(-1), raw.size)
+    return out
+
+
+def unpack4_quantize(raw: np.ndarray, a: np.ndarray,
+                     b: np.ndarray) -> np.ndarray | None:
+    """Fused 4-bit unpack + affine requantization: (nspec, nchan/2)
+    uint8 packed -> (nspec, nchan) uint8, out = clip(round(x*a+b)).
+    None if the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    nspec, nb = raw.shape
+    nchan = nb * 2
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    b = np.ascontiguousarray(b, dtype=np.float32)
+    if a.shape != (nchan,) or b.shape != (nchan,):
+        return None
+    out = np.empty((nspec, nchan), dtype=np.uint8)
+    lib.tpulsar_unpack4_q8(raw, out, nspec, nchan, a, b)
     return out
 
 

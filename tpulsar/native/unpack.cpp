@@ -12,8 +12,10 @@
 // Built as a plain shared library; bound with ctypes
 // (tpulsar/native/__init__.py).  No Python.h dependency.
 
+#include <cmath>
 #include <cstdint>
 #include <cstddef>
+#include <vector>
 
 namespace {
 
@@ -92,6 +94,34 @@ void tpulsar_unpack4_cal(const uint8_t* in, float* out, size_t nspec,
                           + offsets[2 * i];
             orow[2 * i + 1] = LUT4.t[row[i]][1] * scales[2 * i + 1]
                               + offsets[2 * i + 1];
+        }
+    }
+}
+
+// Fused unpack4 + affine requantization to uint8:
+// out[s, c] = clip(round(samples[s, c] * a[c] + b[c]), 0, 255).
+// Callers fold calibration and the block quantization map into (a, b)
+// per subint row; with only 16 possible sample values the whole map
+// collapses into a per-channel 16-entry uint8 LUT, so the inner loop
+// is two table reads and two stores per packed byte.
+void tpulsar_unpack4_q8(const uint8_t* in, uint8_t* out, size_t nspec,
+                        size_t nchan, const float* a, const float* b) {
+    const size_t nb = nchan / 2;
+    std::vector<uint8_t> lut(nchan * 16);
+    for (size_t c = 0; c < nchan; ++c) {
+        for (int x = 0; x < 16; ++x) {
+            const long r = lroundf(static_cast<float>(x) * a[c] + b[c]);
+            lut[c * 16 + x] =
+                r < 0 ? 0 : (r > 255 ? 255 : static_cast<uint8_t>(r));
+        }
+    }
+    for (size_t s = 0; s < nspec; ++s) {
+        const uint8_t* row = in + s * nb;
+        uint8_t* orow = out + s * nchan;
+        for (size_t i = 0; i < nb; ++i) {
+            const uint8_t byte = row[i];
+            orow[2 * i] = lut[(2 * i) * 16 + ((byte >> 4) & 0x0F)];
+            orow[2 * i + 1] = lut[(2 * i + 1) * 16 + (byte & 0x0F)];
         }
     }
 }
