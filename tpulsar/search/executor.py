@@ -332,6 +332,26 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     """
     params = params or SearchParams()
     timers = timers or StageTimers()
+    # TPULSAR_PROFILE=<dir>: capture a JAX profiler trace of the whole
+    # block search (the TPU-era equivalent of the reference's stage
+    # timers, SURVEY.md 5.1 — view with TensorBoard/xprof)
+    import contextlib
+
+    profile_dir = os.environ.get("TPULSAR_PROFILE", "").strip()
+    if profile_dir:
+        import jax.profiler as _prof
+        _trace = _prof.trace(profile_dir)
+    else:
+        _trace = contextlib.nullcontext()
+    with _trace:
+        return _search_block_inner(
+            data, freqs, dt, plan, params, zaplist, baryv, nsub,
+            timers, checkpoint_dir, data_id, progress_cb, mesh)
+
+
+def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
+                        nsub, timers, checkpoint_dir, data_id,
+                        progress_cb, mesh):
     nchan = data.shape[0]
     nsub = nsub or (params.nsub if nchan % params.nsub == 0
                     else _largest_divisor_leq(nchan, params.nsub))
