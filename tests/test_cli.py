@@ -90,6 +90,56 @@ def test_jobpool_once_with_added_files(tmp_path, capsys, _iso_config):
     assert "job_id" in out or "nothing processing" in out
 
 
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("TPULSAR_FAST_TESTS") == "1",
+                    reason="TPULSAR_FAST_TESTS=1 skips the ~3 min "
+                           "real-worker cycle")
+def test_full_pipeline_cycle(tmp_path, capsys, _iso_config):
+    """The whole pipeline through the real CLI entry points: manual
+    ingest -> job pool submits a REAL search worker through the local
+    queue -> pool polls it to 'processed' -> uploader parses the
+    results dir and commits to the results DB -> job 'uploaded'.
+    This is the reference's end-to-end flow (SURVEY.md section 1
+    control flow) with no stubs in the data path."""
+    import sqlite3
+    import time
+
+    from tpulsar.orchestrate.jobtracker import JobTracker
+
+    db = str(tmp_path / "t.db")
+    spec = synth.BeamSpec(nchan=16, nsamp=4096, nsblk=64, nbits=4)
+    psr = synth.PulsarSpec(period_s=0.05, dm=20.0, snr_per_sample=1.5)
+    fns = synth.synth_beam(str(tmp_path / "data"), spec, pulsars=[psr],
+                           merged=True)
+    main(["--db", db, "add-files"] + fns)
+
+    t = JobTracker(db)
+    deadline = time.time() + 300
+    status = None
+    while time.time() < deadline:
+        assert main(["--db", db, "jobpool", "--once"]) == 0
+        row = t.query("SELECT status FROM jobs", fetchone=True)
+        status = row["status"] if row else None
+        if status in ("processed", "terminal_failure", "failed"):
+            break
+        time.sleep(2.0)
+    assert status == "processed", f"job ended as {status!r}"
+
+    assert main(["--db", db, "uploader", "--once"]) == 0
+    row = t.query("SELECT status FROM jobs", fetchone=True)
+    assert row["status"] == "uploaded"
+
+    conn = sqlite3.connect(_iso_config.resultsdb.url)
+    n_hdr = conn.execute("SELECT COUNT(*) FROM headers").fetchone()[0]
+    n_cand = conn.execute(
+        "SELECT COUNT(*) FROM pdm_candidates").fetchone()[0]
+    n_diag = conn.execute(
+        "SELECT COUNT(*) FROM diagnostics").fetchone()[0]
+    conn.close()
+    assert n_hdr == 1 and n_cand >= 1 and n_diag >= 10
+    capsys.readouterr()
+
+
 def test_stats_and_monitor(tmp_path, capsys):
     from tpulsar.cli import main as cli
     db = str(tmp_path / "t.db")
