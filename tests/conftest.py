@@ -29,6 +29,18 @@ def pytest_configure(config):
         "TPULSAR_FAST_TESTS=1 or -m 'not slow')")
 
 
+def pytest_collection_modifyitems(config, items):
+    """TPULSAR_FAST_TESTS=1 skips every slow-marked test — the env-var
+    contract lives here once, not as per-test skipifs."""
+    if os.environ.get("TPULSAR_FAST_TESTS") != "1":
+        return
+    skip = pytest.mark.skip(reason="TPULSAR_FAST_TESTS=1 skips "
+                                   "slow integration tests")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(1234)

@@ -91,9 +91,6 @@ def test_jobpool_once_with_added_files(tmp_path, capsys, _iso_config):
 
 
 @pytest.mark.slow
-@pytest.mark.skipif(os.environ.get("TPULSAR_FAST_TESTS") == "1",
-                    reason="TPULSAR_FAST_TESTS=1 skips the ~3 min "
-                           "real-worker cycle")
 def test_full_pipeline_cycle(tmp_path, capsys, _iso_config):
     """The whole pipeline through the real CLI entry points: manual
     ingest -> job pool submits a REAL search worker through the local

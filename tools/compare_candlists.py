@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Tolerance-based candidate-list comparison — the measurement tool
+for the BASELINE "candidate list identical to PRESTO" metric.
+
+Matches two sifted candidate lists (ours, or PRESTO ACCEL_sift output
+converted to the .accelcands format) by frequency/DM proximity, with
+harmonic awareness: a candidate found at 2f or f/2 of a reference
+candidate counts as a harmonic match, since sifting keeps whichever
+harmonic scored highest and that choice is threshold-sensitive.
+
+Usage:
+    python tools/compare_candlists.py REF.accelcands GOT.accelcands \
+        [--freq-tol 1e-4] [--dm-tol 0.5] [--sigma-floor 6.0]
+
+Prints a summary plus per-candidate match lines, and exits 0 iff
+every reference candidate above --sigma-floor is matched (exactly or
+harmonically).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+HARMONIC_RATIOS = (1.0, 2.0, 0.5, 3.0, 1 / 3.0, 4.0, 0.25,
+                   1.5, 2 / 3.0)
+
+
+def match(ref, got, freq_tol: float, dm_tol: float):
+    """For each ref candidate: (kind, partner) with kind in
+    'exact' | 'harmonic' | 'missed'.
+
+    Matching is ONE-TO-ONE (each got candidate satisfies at most one
+    reference candidate — otherwise one strong harmonic could mask a
+    genuinely missing detection and false-pass the comparison), with
+    exact matches assigned first and stronger reference candidates
+    given priority within each round."""
+    order = sorted(range(len(ref)), key=lambda i: -ref[i].sigma)
+    used: set[int] = set()
+    kinds: list = [("missed", None)] * len(ref)
+
+    def _try(i, exact_only: bool) -> bool:
+        rc = ref[i]
+        for j, gc in enumerate(got):
+            if j in used or abs(gc.dm - rc.dm) > dm_tol:
+                continue
+            for ratio in HARMONIC_RATIOS:
+                if exact_only and ratio != 1.0:
+                    continue
+                if abs(gc.freq_hz / rc.freq_hz - ratio) \
+                        <= freq_tol * ratio:
+                    used.add(j)
+                    kinds[i] = ("exact" if ratio == 1.0
+                                else "harmonic", gc)
+                    return True
+        return False
+
+    for i in order:
+        _try(i, exact_only=True)
+    for i in order:
+        if kinds[i][0] == "missed":
+            _try(i, exact_only=False)
+    return [(rc, *kinds[i]) for i, rc in enumerate(ref)]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ref")
+    ap.add_argument("got")
+    ap.add_argument("--freq-tol", type=float, default=1e-4,
+                    help="relative frequency tolerance")
+    ap.add_argument("--dm-tol", type=float, default=0.5)
+    ap.add_argument("--sigma-floor", type=float, default=6.0,
+                    help="reference candidates below this sigma are "
+                         "reported but do not fail the comparison")
+    args = ap.parse_args()
+
+    from tpulsar.io.accelcands import parse_candlist
+
+    ref = parse_candlist(args.ref)
+    got = parse_candlist(args.got)
+    results = match(ref, got, args.freq_tol, args.dm_tol)
+
+    matched_ref = {id(r[2]) for r in results if r[2] is not None}
+    extras = [g for g in got if id(g) not in matched_ref]
+
+    n_exact = sum(1 for r in results if r[1] == "exact")
+    n_harm = sum(1 for r in results if r[1] == "harmonic")
+    hard_miss = [rc for rc, kind, _ in results
+                 if kind == "missed" and rc.sigma >= args.sigma_floor]
+
+    for rc, kind, gc in results:
+        line = (f"{kind:8s} ref f={rc.freq_hz:12.6f} Hz dm={rc.dm:7.2f} "
+                f"sigma={rc.sigma:6.2f}")
+        if gc is not None:
+            line += (f"  -> got f={gc.freq_hz:12.6f} "
+                     f"sigma={gc.sigma:6.2f}")
+        print(line)
+    for gc in extras:
+        print(f"extra    got f={gc.freq_hz:12.6f} Hz dm={gc.dm:7.2f} "
+              f"sigma={gc.sigma:6.2f}")
+
+    print(f"\n{len(ref)} reference candidates: {n_exact} exact, "
+          f"{n_harm} harmonic, {len(results) - n_exact - n_harm} "
+          f"missed ({len(hard_miss)} at sigma>={args.sigma_floor}); "
+          f"{len(extras)} extra in the compared list")
+    return 1 if hard_miss else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
