@@ -37,7 +37,7 @@ class BasicConfig:
     survey: str = "PALFA2.0"
     pipelinedir: str = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    log_dir: str = "/tmp/tpulsar/logs"
+    log_dir: str = "/tmp/tpulsar_data/logs"
     coords_table: str = ""                 # optional WAPP coord fix table
     delete_rawdata: bool = False
 
@@ -45,13 +45,13 @@ class BasicConfig:
 @dataclasses.dataclass
 class BackgroundConfig:
     screen_output: bool = True
-    jobtracker_db: str = "/tmp/tpulsar/jobtracker.db"
+    jobtracker_db: str = "/tmp/tpulsar_data/jobtracker.db"
     sleep: float = 60.0                    # daemon loop sleep seconds
 
 
 @dataclasses.dataclass
 class DownloadConfig:
-    datadir: str = "/tmp/tpulsar/rawdata"
+    datadir: str = "/tmp/tpulsar_data/rawdata"
     space_to_use: int = 60 * 2 ** 30       # 60 GB quota
     min_free_space: int = 10 * 2 ** 30
     numdownloads: int = 2                  # concurrent transfers
@@ -66,8 +66,8 @@ class DownloadConfig:
 
 @dataclasses.dataclass
 class ProcessingConfig:
-    base_working_directory: str = "/tmp/tpulsar/work"
-    base_results_directory: str = "/tmp/tpulsar/results"
+    base_working_directory: str = "/tmp/tpulsar_data/work"
+    base_results_directory: str = "/tmp/tpulsar_data/results"
     zaplistdir: str = ""
     default_zaplist: str = ""
     zaplist_url: str = ""   # remote custom-zaplist tarball location
@@ -130,7 +130,7 @@ class EmailConfig:
 class ResultsDBConfig:
     """Replaces the reference's commondb (MSSQL) settings with a
     pluggable results database (database.py:15-37)."""
-    url: str = "/tmp/tpulsar/results.db"   # sqlite path (round 1)
+    url: str = "/tmp/tpulsar_data/results.db"   # sqlite path (round 1)
     backend: str = "sqlite"
 
 

@@ -174,6 +174,16 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     (PALFA2_presto_search.py:43-57,269); pass 0.0 explicitly to
     disable barycentric correction.
     """
+    import tpulsar
+
+    # JAX_PLATFORMS must win over a sitecustomize-registered
+    # accelerator plugin, and it must win BEFORE the first jnp use
+    # below initializes the backend — a library caller pinned to CPU
+    # would otherwise initialize the accelerator (and hang forever on
+    # a wedged chip).  search_block callers hold device arrays
+    # already, so this is the earliest library point where the pin
+    # can still take effect.
+    tpulsar.apply_platform_env()
     params = params or SearchParams()
     os.makedirs(workdir, exist_ok=True)
     os.makedirs(resultsdir, exist_ok=True)
