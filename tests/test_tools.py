@@ -1,0 +1,74 @@
+"""Tests for the repo tools (tools/ is not a package; load by path)."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _C:
+    def __init__(self, freq_hz, dm, sigma):
+        self.freq_hz, self.dm, self.sigma = freq_hz, dm, sigma
+
+
+def test_compare_match_is_one_to_one():
+    """A single got-candidate must not satisfy two reference
+    candidates: a strong harmonic cannot mask a missing detection."""
+    cmp_mod = _load("compare_candlists")
+    ref = [_C(1.0, 20.0, 10.0), _C(2.0, 20.0, 9.0)]
+    got = [_C(2.0, 20.0, 9.0)]
+    res = cmp_mod.match(ref, got, freq_tol=1e-4, dm_tol=0.5)
+    kinds = {rc.freq_hz: kind for rc, kind, _ in res}
+    assert kinds[2.0] == "exact"
+    assert kinds[1.0] == "missed"
+
+
+def test_compare_harmonic_and_dm_tolerance():
+    cmp_mod = _load("compare_candlists")
+    ref = [_C(1.0, 20.0, 8.0), _C(5.0, 100.0, 7.0)]
+    got = [_C(2.00001, 20.2, 8.0),    # 2nd harmonic of ref[0]
+           _C(5.0, 103.0, 7.0)]       # DM too far from ref[1]
+    res = cmp_mod.match(ref, got, freq_tol=1e-4, dm_tol=0.5)
+    kinds = {rc.freq_hz: kind for rc, kind, _ in res}
+    assert kinds[1.0] == "harmonic"
+    assert kinds[5.0] == "missed"
+
+
+def test_compare_exact_preferred_over_harmonic():
+    cmp_mod = _load("compare_candlists")
+    ref = [_C(2.0, 20.0, 9.0)]
+    got = [_C(1.0, 20.0, 5.0), _C(2.0, 20.0, 9.0)]
+    res = cmp_mod.match(ref, got, freq_tol=1e-4, dm_tol=0.5)
+    assert res[0][1] == "exact"
+    assert res[0][2].freq_hz == 2.0
+
+
+@pytest.mark.slow
+def test_aot_check_cli_smoke():
+    """The AOT memory checker compiles a tiny-scale program set and
+    exits 0 (CPU; the tool's purpose is pre-validating full-scale
+    programs without executing on the device)."""
+    import tpulsar
+
+    # not just JAX_PLATFORMS=cpu: on a wedged accelerator the plugin
+    # registration hangs `import jax` itself (see cpu_subprocess_env)
+    env = tpulsar.cpu_subprocess_env()
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "aot_check.py"),
+         "--scale", "0.02"],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert out.returncode == 0, out.stdout[-800:] + out.stderr[-400:]
+    assert "all programs compiled" in out.stdout
