@@ -293,6 +293,7 @@ def run_measured() -> None:
     from tpulsar.kernels import rfi as rfi_k
     from tpulsar.plan import ddplan
     from tpulsar.search import executor
+    from tpulsar.search.report import StageTimers
 
     scale = float(os.environ.get("TPULSAR_BENCH_SCALE", "1.0"))
     run_accel = os.environ.get("TPULSAR_BENCH_ACCEL", "1") != "0"
@@ -331,11 +332,15 @@ def run_measured() -> None:
         _log(f"beam {b}: block ready in {time.time()-t_gen:.1f} s")
 
         t0 = time.time()
-        mask = rfi_k.find_rfi_chan(data, TSAMP, block_len=2048)
-        data = rfi_k.apply_mask_chan(
-            data, jnp.asarray(mask.full_mask()),
-            jnp.asarray(mask.chan_fill), mask.block_len)
-        data.block_until_ready()
+        timers = StageTimers()
+        if b == 0:
+            timers0 = timers
+        with timers.timing("rfifind"):
+            mask = rfi_k.find_rfi_chan(data, TSAMP, block_len=2048)
+            data = rfi_k.apply_mask_chan(
+                data, jnp.asarray(mask.full_mask()),
+                jnp.asarray(mask.chan_fill), mask.block_len)
+            data.block_until_ready()
         _log(f"beam {b}: rfifind done at +{time.time()-t0:.1f} s")
 
         def progress(rec, _b=b, _t0=t0):
@@ -350,7 +355,8 @@ def run_measured() -> None:
                  f"+{rec['elapsed_s']} s")
 
         cands, folded, sp_events, ntrials = executor.search_block(
-            data, freqs, TSAMP, plan, params, progress_cb=progress)
+            data, freqs, TSAMP, plan, params, progress_cb=progress,
+            timers=timers)
         per_beam_s.append(time.time() - t0)
         _log(f"beam {b}: search done in {per_beam_s[-1]:.1f} s, "
              f"{len(cands)} candidates")
@@ -376,6 +382,11 @@ def run_measured() -> None:
         "accel_stage": run_accel,
         "nsamp": nsamp,
         "device": str(jax.devices()[0]),
+        # beam-0 per-stage wall-clock (the .report breakdown,
+        # reference PALFA2_presto_search.py:336-372) so the headline
+        # number is decomposable from the one JSON line
+        "stage_s": {k: round(v, 2) for k, v in timers0.times.items()
+                    if v >= 0.005},
     }
     if nbeams > 1:
         steady = sum(per_beam_s[1:]) / (nbeams - 1)
