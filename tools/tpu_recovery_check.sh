@@ -1,0 +1,66 @@
+#!/bin/bash
+# Ordered TPU validation after a chip recovery (a runtime HBM OOM can
+# wedge the chip for hours, so everything here escalates from
+# harmless to heavy; see docs/architecture.md "Memory discipline").
+#
+#   1. subprocess health probe (hang-proof, must land on the TPU —
+#      a CPU fallback is NOT healthy)
+#   2. Pallas + batched-accel smoke probes (subprocess, capture error)
+#   3. AOT compile-only pass of every full-scale program
+#   4. focused bench configs (dedispersion-only first)
+#   5. the full headline bench (also warms .jax_cache for the driver)
+#
+# Stops at the first failure.  Usage: bash tools/tpu_recovery_check.sh
+
+set -u
+cd "$(dirname "$0")/.." || exit 1
+# same compilation/smoke cache the benches use, so step-2 verdicts
+# are reused instead of re-probed
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
+check_bench_json() {
+    # bench.py always exits 0 (failures live inside its one JSON
+    # line); gate on the line's content
+    python - "$1" <<'EOF'
+import json, sys
+rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+if rec.get("error") or rec.get("value", -1) <= 0:
+    print(f"bench FAILED: {rec}")
+    sys.exit(1)
+print(f"bench ok: {rec.get('metric')} = {rec.get('value')} "
+      f"{rec.get('unit')}")
+EOF
+}
+
+echo "==== 1. health probe ===="
+python bench.py --probe | tee /tmp/probe.json
+python - <<'EOF' || { echo "chip unhealthy (or CPU fallback)"; exit 1; }
+import json
+rec = json.loads(open("/tmp/probe.json").read().strip().splitlines()[-1])
+assert rec.get("ok") and rec.get("platform") not in (None, "cpu"), rec
+EOF
+
+echo "==== 2. kernel smoke probes (errors are diagnostic, not fatal) ===="
+timeout 400 python -c "
+from tpulsar.kernels.pallas_dd import smoke_test_ok
+print('pallas smoke:', smoke_test_ok())" || true
+timeout 400 python -c "
+from tpulsar.kernels.accel import _batch_path_usable
+print('accel batch smoke:', _batch_path_usable())" || true
+
+echo "==== 3. AOT compile-only, full scale ===="
+timeout 580 python tools/aot_check.py --scale 1.0 --accel \
+    || { echo "FAILED: aot_check"; exit 1; }
+
+echo "==== 4. focused benches ===="
+TPULSAR_BENCH_CONFIG=1 TPULSAR_BENCH_TOTAL_BUDGET=600 \
+    python bench.py | tee /tmp/bench_cfg1.json
+check_bench_json /tmp/bench_cfg1.json || exit 1
+TPULSAR_BENCH_CONFIG=4 TPULSAR_BENCH_TOTAL_BUDGET=600 \
+    python bench.py | tee /tmp/bench_cfg4.json
+check_bench_json /tmp/bench_cfg4.json || exit 1
+
+echo "==== 5. full headline bench ===="
+python bench.py | tee /tmp/bench_full.json
+check_bench_json /tmp/bench_full.json || exit 1
+echo "ALL RECOVERY CHECKS PASSED"
