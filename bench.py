@@ -87,56 +87,19 @@ def _log(msg: str) -> None:
 
 # --------------------------------------------------------------- child: probe
 
-_PROBE_SRC = r"""
-import json, os, sys, time
-t0 = time.time()
-import jax
-# sitecustomize registers the axon TPU backend at interpreter start,
-# which beats the JAX_PLATFORMS env var — re-apply through the config.
-want = os.environ.get("JAX_PLATFORMS", "").strip()
-if want:
-    jax.config.update("jax_platforms", want)
-devs = jax.devices()
-t_dev = time.time() - t0
-import jax.numpy as jnp
-t1 = time.time()
-y = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
-t_mm = time.time() - t1
-print(json.dumps({
-    "ok": True, "platform": devs[0].platform, "ndev": len(devs),
-    "device": str(devs[0]),
-    "devices_s": round(t_dev, 1), "matmul_s": round(t_mm, 1)}))
-"""
-
-
 def probe_device(timeout: float, force_cpu: bool = False) -> dict | None:
     """Run jax.devices() + a tiny matmul in a subprocess under a hard
-    timeout.  Returns the probe record, or None if the chip is wedged
-    (hang, crash, or nonsense output)."""
-    env = dict(os.environ)
-    if force_cpu:
-        # CPU probes must not dial the accelerator runtime at all: on
-        # a wedged chip the sitecustomize PJRT registration hangs
-        # `import jax` itself, before JAX_PLATFORMS is consulted.
-        from tpulsar import cpu_subprocess_env
-        env = cpu_subprocess_env(env)
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC], env=env,
-            capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
+    timeout (the shared tpulsar.probe_device_subprocess — one probe
+    implementation for the bench and the driver entry points).
+    Returns the probe record, or None if the chip is wedged (hang,
+    crash, or nonsense output)."""
+    from tpulsar import probe_device_subprocess
+
+    rec = probe_device_subprocess(timeout=timeout, force_cpu=force_cpu)
+    if not rec.get("ok"):
+        _log(f"probe failed: {rec.get('detail')}")
         return None
-    if out.returncode != 0:
-        _log(f"probe rc={out.returncode}: {out.stderr.strip()[-300:]}")
-        return None
-    for line in reversed(out.stdout.strip().splitlines()):
-        try:
-            rec = json.loads(line)
-            if rec.get("ok"):
-                return rec
-        except json.JSONDecodeError:
-            continue
-    return None
+    return rec
 
 
 # ---------------------------------------------------------- child: measured run

@@ -42,6 +42,62 @@ def cpu_subprocess_env(base: dict | None = None) -> dict:
     return env
 
 
+def probe_device_subprocess(timeout: float = 120.0,
+                            force_cpu: bool = False) -> dict:
+    """Probe the JAX backend in a SUBPROCESS under a hard timeout —
+    never importing jax in the calling process (on a wedged chip the
+    sitecustomize PJRT registration can hang `import jax` itself).
+
+    Returns {'ok': True, 'platform', 'ndev', 'device', 'devices_s',
+    'matmul_s'} on success, else {'ok': False, 'detail': ...}.  The
+    single probe implementation behind bench.py and
+    __graft_entry__'s health gates.
+    """
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = cpu_subprocess_env() if force_cpu else dict(os.environ)
+    src = (
+        "import json, os, time\n"
+        "t0 = time.time()\n"
+        "import jax\n"
+        "want = os.environ.get('JAX_PLATFORMS', '').strip()\n"
+        "if want:\n"
+        "    jax.config.update('jax_platforms', want)\n"
+        "d = jax.devices()\n"
+        "t_dev = time.time() - t0\n"
+        "import jax.numpy as jnp\n"
+        "t1 = time.time()\n"
+        "(jnp.ones((256, 256)) @ jnp.ones((256, 256)))"
+        ".block_until_ready()\n"
+        "print(json.dumps({'ok': True, 'platform': d[0].platform,"
+        " 'ndev': len(d), 'device': str(d[0]),"
+        " 'devices_s': round(t_dev, 1),"
+        " 'matmul_s': round(time.time() - t1, 1)}))\n")
+    try:
+        out = subprocess.run([sys.executable, "-c", src], env=env,
+                             capture_output=True, text=True,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "detail": f"probe hung > {timeout:.0f} s (wedged chip?)"}
+    except OSError as e:
+        return {"ok": False, "detail": str(e)}
+    if out.returncode == 0:
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                if rec.get("ok"):
+                    return rec
+            except json.JSONDecodeError:
+                continue
+    return {"ok": False,
+            "detail": f"rc={out.returncode}: "
+                      + (out.stderr or "").strip()[-300:]}
+
+
 def apply_platform_env() -> None:
     """Make JAX honour the JAX_PLATFORMS environment variable even
     when a sitecustomize registered an accelerator backend at
