@@ -64,6 +64,52 @@ def test_bintable_roundtrip(tmp_path):
     assert got["NAME"][2].startswith(b"gamma")
 
 
+def test_malformed_inputs_raise_cleanly(tmp_path):
+    """Hostile/broken files must raise FitsError/OSError — never
+    hang, loop, or crash the interpreter (the reader is from-scratch;
+    a survey pipeline sees truncated transfers and junk)."""
+    import os
+
+    import numpy as np
+    import pytest
+
+    from tpulsar.io import fitscore
+
+    # nonexistent path
+    with pytest.raises(OSError):
+        fitscore.read_fits(str(tmp_path / "nope.fits"))
+
+    # random bytes (multiple sizes incl. a whole FITS block)
+    rng = np.random.default_rng(0)
+    for n in (0, 17, 2880, 8192):
+        p = str(tmp_path / f"junk{n}.fits")
+        with open(p, "wb") as fh:
+            fh.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+        with pytest.raises((fitscore.FitsError, OSError, ValueError)):
+            fitscore.read_fits(p)
+
+    # a real file truncated mid-header and mid-data
+    from tpulsar.io import synth
+
+    spec = synth.BeamSpec(nchan=8, nsamp=256, nsblk=64)
+    fns = synth.synth_beam(str(tmp_path / "t"), spec, merged=True)
+    raw = open(fns[0], "rb").read()
+    for cut in (100, 2880 + 37, len(raw) // 2):
+        p = str(tmp_path / f"trunc{cut}.fits")
+        with open(p, "wb") as fh:
+            fh.write(raw[:cut])
+        with pytest.raises((fitscore.FitsError, OSError, ValueError,
+                            KeyError, EOFError)):
+            hdus = fitscore.read_fits(p)
+            # data sections are lazy: force them
+            for h in hdus:
+                if h.data is not None:
+                    np.asarray(h.data)
+            # a truncated tail may parse as fewer HDUs; demanding the
+            # SUBINT table must then fail
+            fitscore.get_hdu(hdus, "SUBINT").data["DATA"]
+
+
 def test_lazy_memmap(tmp_path):
     rowdt = np.dtype([("DATA", ">u1", (64,))])
     rows = np.zeros(100, dtype=rowdt)
