@@ -68,8 +68,6 @@ def test_malformed_inputs_raise_cleanly(tmp_path):
     """Hostile/broken files must raise FitsError/OSError — never
     hang, loop, or crash the interpreter (the reader is from-scratch;
     a survey pipeline sees truncated transfers and junk)."""
-    import os
-
     import numpy as np
     import pytest
 
@@ -93,7 +91,8 @@ def test_malformed_inputs_raise_cleanly(tmp_path):
 
     spec = synth.BeamSpec(nchan=8, nsamp=256, nsblk=64)
     fns = synth.synth_beam(str(tmp_path / "t"), spec, merged=True)
-    raw = open(fns[0], "rb").read()
+    with open(fns[0], "rb") as fh:
+        raw = fh.read()
     for cut in (100, 2880 + 37, len(raw) // 2):
         p = str(tmp_path / f"trunc{cut}.fits")
         with open(p, "wb") as fh:

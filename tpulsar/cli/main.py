@@ -349,7 +349,8 @@ def cmd_stats(args):
                              where="post", label=label)
         axes[0].set_ylabel("cumulative jobs")
         axes[0].tick_params(axis="x", rotation=30, labelsize=7)
-        axes[0].legend(loc="upper left", fontsize=8)
+        if axes[0].get_legend_handles_labels()[0]:
+            axes[0].legend(loc="upper left", fontsize=8)
         labels = [r["status"] for r in files]
         sizes = [r["s"] / 2**30 for r in files]
         axes[1].bar(labels, sizes, color="0.5")
