@@ -78,6 +78,33 @@ def test_read_all_uint8_affine_roundtrip(tmp_path):
     assert np.all(scale == scale[0])
 
 
+def test_structurally_broken_psrfits_rejected(tmp_path):
+    """Valid FITS that is not valid PSRFITS (no SUBINT HDU, or a
+    SUBINT table without the DATA column) must raise a clean
+    ValueError from SpectraInfo — never an attribute/KeyError deep in
+    the decode path."""
+    import pytest
+
+    from tpulsar.io import fitscore
+    from tpulsar.io.psrfits import SpectraInfo
+
+    p1 = str(tmp_path / "nosubint.fits")
+    fitscore.write_fits(p1, [fitscore.HDU(fitscore.primary_header(),
+                                          None)])
+    with pytest.raises(ValueError, match="PSRFITS"):
+        SpectraInfo([p1])
+
+    rows = np.zeros(2, dtype=[("TSUBINT", ">f8")])
+    hdr = fitscore.bintable_header("SUBINT", rows, NCHAN=4, TBIN=1e-3,
+                                   NSBLK=16, NBITS=8, NPOL=1)
+    p2 = str(tmp_path / "nodata.fits")
+    fitscore.write_fits(p2, [
+        fitscore.HDU(fitscore.primary_header(), None),
+        fitscore.HDU(hdr, rows)])
+    with pytest.raises(ValueError, match="PSRFITS"):
+        SpectraInfo([p2])
+
+
 def test_search_params_rejects_bad_mode_values():
     import pytest
 
