@@ -79,6 +79,27 @@ def build_scenarios() -> dict:
         topk_per_stage=16, max_cands_to_fold=0, make_plots=False)
     out["pure_noise"] = (data3, np.linspace(1214.0, 1536.0, 16), dt,
                          plan3, params3)
+
+    # --- wapp_multistep: WAPP-style geometry — coarser sampling, a
+    # multi-step plan with rising downsamp (the second hardcoded
+    # survey family, PALFA2_presto_search.py:327-331, scaled down) ---
+    rng = np.random.default_rng(1133)
+    nchan4, T4, dt4 = 32, 1 << 15, 2e-4
+    freqs4 = np.linspace(1120.0, 1470.0, nchan4)
+    data4 = rng.standard_normal((nchan4, T4)).astype(np.float32)
+    _dispersed_pulses(data4, freqs4, dt4, period_s=0.4, dm=90.0,
+                      amp=1.1)
+    plan4 = [ddplan.DedispStep(lodm=50.0, dmstep=5.0, dms_per_pass=10,
+                               numpasses=1, numsub=16, downsamp=1),
+             ddplan.DedispStep(lodm=100.0, dmstep=10.0, dms_per_pass=6,
+                               numpasses=1, numsub=16, downsamp=3),
+             ddplan.DedispStep(lodm=160.0, dmstep=20.0, dms_per_pass=4,
+                               numpasses=1, numsub=16, downsamp=5)]
+    params4 = executor.SearchParams(
+        nsub=16, lo_accel_numharm=8, hi_accel_zmax=8,
+        hi_accel_numharm=2, topk_per_stage=16, max_cands_to_fold=2,
+        fold_nbin=32, fold_npart=8, make_plots=False)
+    out["wapp_multistep"] = (data4, freqs4, dt4, plan4, params4)
     return out
 
 

@@ -79,30 +79,57 @@ def test_read_all_uint8_affine_roundtrip(tmp_path):
 
 
 def test_structurally_broken_psrfits_rejected(tmp_path):
-    """Valid FITS that is not valid PSRFITS (no SUBINT HDU, or a
-    SUBINT table without the DATA column) must raise a clean
-    ValueError from SpectraInfo — never an attribute/KeyError deep in
-    the decode path."""
+    """Files that PASS the FITSTYPE/OBS_MODE gate but are broken
+    inside (no SUBINT HDU; a SUBINT table missing DATA/DAT_FREQ)
+    must raise a clean ValueError from SpectraInfo — never a
+    FitsError or numpy field error from deep in the decode path."""
     import pytest
 
     from tpulsar.io import fitscore
     from tpulsar.io.psrfits import SpectraInfo
 
-    p1 = str(tmp_path / "nosubint.fits")
-    fitscore.write_fits(p1, [fitscore.HDU(fitscore.primary_header(),
+    def _search_primary():
+        hdr = fitscore.primary_header()
+        hdr.set("FITSTYPE", "PSRFITS")
+        hdr.set("OBS_MODE", "SEARCH")
+        return hdr
+
+    # the gate itself: a plain FITS file without the PSRFITS cards
+    p0 = str(tmp_path / "notpsrfits.fits")
+    fitscore.write_fits(p0, [fitscore.HDU(fitscore.primary_header(),
                                           None)])
     with pytest.raises(ValueError, match="PSRFITS"):
+        SpectraInfo([p0])
+
+    # passes the gate, but no SUBINT HDU
+    p1 = str(tmp_path / "nosubint.fits")
+    fitscore.write_fits(p1, [fitscore.HDU(_search_primary(), None)])
+    with pytest.raises(ValueError, match="SUBINT"):
         SpectraInfo([p1])
 
+    # passes the gate, SUBINT present but missing DATA/DAT_FREQ
     rows = np.zeros(2, dtype=[("TSUBINT", ">f8")])
     hdr = fitscore.bintable_header("SUBINT", rows, NCHAN=4, TBIN=1e-3,
                                    NSBLK=16, NBITS=8, NPOL=1)
     p2 = str(tmp_path / "nodata.fits")
     fitscore.write_fits(p2, [
-        fitscore.HDU(fitscore.primary_header(), None),
+        fitscore.HDU(_search_primary(), None),
         fitscore.HDU(hdr, rows)])
-    with pytest.raises(ValueError, match="PSRFITS"):
+    with pytest.raises(ValueError, match="missing required"):
         SpectraInfo([p2])
+
+    # passes the gate, SUBINT with zero rows
+    rows3 = np.zeros(0, dtype=[("DATA", ">u1", (8,)),
+                               ("DAT_FREQ", ">f8", (4,))])
+    hdr3 = fitscore.bintable_header("SUBINT", rows3, NCHAN=4,
+                                    TBIN=1e-3, NSBLK=2, NBITS=8,
+                                    NPOL=1)
+    p3 = str(tmp_path / "norows.fits")
+    fitscore.write_fits(p3, [
+        fitscore.HDU(_search_primary(), None),
+        fitscore.HDU(hdr3, rows3)])
+    with pytest.raises(ValueError, match="no rows"):
+        SpectraInfo([p3])
 
 
 def test_search_params_rejects_bad_mode_values():

@@ -82,8 +82,22 @@ class SpectraInfo:
                 raise ValueError(f"{fn} does not appear to be PSRFITS")
             hdus = fitscore.read_fits(fn)
             primary = hdus[0].header
-            subint_hdu = fitscore.get_hdu(hdus, "SUBINT")
+            try:
+                subint_hdu = fitscore.get_hdu(hdus, "SUBINT")
+            except fitscore.FitsError:
+                raise ValueError(
+                    f"{fn}: PSRFITS-labelled file has no SUBINT HDU"
+                ) from None
             subint = subint_hdu.header
+            if subint_hdu.data is None or len(subint_hdu.data) == 0:
+                raise ValueError(f"{fn}: SUBINT table has no rows")
+            missing = [col for col in ("DATA", "DAT_FREQ")
+                       if col not in (subint_hdu.data.dtype.names or ())]
+            if missing:
+                raise ValueError(
+                    f"{fn}: SUBINT table is missing required "
+                    f"column(s) {missing} — not a search-mode "
+                    f"PSRFITS file")
             row0 = subint_hdu.data[0]
 
             if ii == 0:
