@@ -42,6 +42,19 @@ Environment knobs:
                           before it could print anything)
   TPULSAR_BENCH_CPU_FALLBACK   "0" to skip the reduced-scale CPU run
                           when the TPU is unhealthy (default on)
+  TPULSAR_BENCH_AOT       "0" to skip the mandatory compile-only AOT
+                          memory gate (tools/aot_check.py) that runs
+                          between the health probe and any full-scale
+                          execute.  The gate exists because a runtime
+                          HBM OOM wedges this chip for hours while a
+                          compile-stage error is clean — an over-budget
+                          program must die in the compiler, never on
+                          the device (round-2 lesson: one 70 GB
+                          program cost the whole round's TPU access)
+  TPULSAR_BENCH_LADDER    "0" to skip the measured scale ladder
+                          (0.1 -> 0.5) that runs before the full-scale
+                          beam on TPU, so even a failed full-scale run
+                          leaves real TPU datapoints
   TPULSAR_BENCH_CONFIG    focused BASELINE.json config instead of the
                           headline full search:
                             1  rfifind + dedispersion only, 128 DM trials
@@ -104,35 +117,74 @@ def probe_device(timeout: float, force_cpu: bool = False) -> dict | None:
 
 # ---------------------------------------------------------- child: measured run
 
-def make_block_device(nsamp: int, seed: int = 42, chan_chunk: int = 120):
-    """(NCHAN, nsamp) uint8 beam on device: noise + one injected
-    pulsar.  Generated on-accelerator in float32 channel chunks so the
-    host never materializes multi-GB float64 noise (round-1 weakness:
-    the old NumPy path burned minutes of untimed wall-clock)."""
+def _bench_dtype_name() -> str:
+    """Validated TPULSAR_BENCH_DTYPE value, with NO jax import — the
+    parent process must be able to fail fast on a misconfig without
+    dialing the accelerator runtime (import jax hangs on a wedged
+    chip)."""
+    val = os.environ.get("TPULSAR_BENCH_DTYPE", "uint8")
+    if val in ("uint8", "bfloat16"):
+        return val
+    # reject rather than guess: a silently-coerced dtype changes the
+    # measured headline number with no warning
+    raise SystemExit(
+        f"TPULSAR_BENCH_DTYPE must be uint8|bfloat16, got {val!r}")
+
+
+def _bench_dtype():
+    """Device block dtype from TPULSAR_BENCH_DTYPE — the ONE place the
+    knob is interpreted (the measured child, the focused configs, and
+    the AOT gate must all agree on the dtype or the gate compiles
+    programs that never execute)."""
+    import jax.numpy as jnp
+
+    return (jnp.uint8 if _bench_dtype_name() == "uint8"
+            else jnp.bfloat16)
+
+
+def gen_block_chunk(key, delay_chunk, n: int, nc: int, dtype):
+    """The jitted per-channel-chunk beam synthesizer (noise + one
+    injected pulsar, quantized to the device dtype).  Module-level so
+    tools/aot_check.py can compile-check the EXACT program the
+    measured run executes."""
+    import jax
+    import jax.numpy as jnp
+
+    t = jnp.arange(n, dtype=jnp.float32) * TSAMP
+    noise = 8.0 + 2.0 * jax.random.normal(key, (nc, n), jnp.float32)
+    phase = ((t[None, :] - delay_chunk[:, None]) / P_TRUE) % 1.0
+    dph = jnp.minimum(phase, 1.0 - phase)
+    x = noise + jnp.exp(-0.5 * (dph / 0.02) ** 2)
+    return jnp.clip(jnp.round(x), 0, 15).astype(dtype)
+
+
+def make_block_device(nsamp: int, seed: int = 42, chan_chunk: int = 120,
+                      dtype=None):
+    """(NCHAN, nsamp) beam on device in the bench dtype: noise + one
+    injected pulsar.  Generated on-accelerator in float32 channel
+    chunks so the host never materializes multi-GB float64 noise
+    (round-1 weakness: the old NumPy path burned minutes of untimed
+    wall-clock)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from functools import partial
     from tpulsar.constants import dispersion_delay_s
 
+    if dtype is None:
+        dtype = _bench_dtype()
     freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
     delays = dispersion_delay_s(DM_TRUE, freqs, freqs[-1]).astype(np.float32)
 
-    @partial(jax.jit, static_argnames=("n", "nc"))
-    def gen(key, delay_chunk, n, nc):
-        t = jnp.arange(n, dtype=jnp.float32) * TSAMP
-        noise = 8.0 + 2.0 * jax.random.normal(key, (nc, n), jnp.float32)
-        phase = ((t[None, :] - delay_chunk[:, None]) / P_TRUE) % 1.0
-        dph = jnp.minimum(phase, 1.0 - phase)
-        x = noise + jnp.exp(-0.5 * (dph / 0.02) ** 2)
-        return jnp.clip(jnp.round(x), 0, 15).astype(jnp.uint8)
-
+    gen = partial(jax.jit, static_argnames=("n", "nc", "dtype"))(
+        gen_block_chunk)
     key = jax.random.PRNGKey(seed)
     parts = []
     for c0 in range(0, NCHAN, chan_chunk):
         nc = min(chan_chunk, NCHAN - c0)
         key, sub = jax.random.split(key)
-        parts.append(gen(sub, jnp.asarray(delays[c0:c0 + nc]), nsamp, nc))
+        parts.append(gen(sub, jnp.asarray(delays[c0:c0 + nc]), n=nsamp,
+                         nc=nc, dtype=dtype))
     return jnp.concatenate(parts, axis=0)
 
 
@@ -260,7 +312,6 @@ def run_measured() -> None:
 
     scale = float(os.environ.get("TPULSAR_BENCH_SCALE", "1.0"))
     run_accel = os.environ.get("TPULSAR_BENCH_ACCEL", "1") != "0"
-    dtype = os.environ.get("TPULSAR_BENCH_DTYPE", "uint8")
     nbeams = max(1, int(os.environ.get("TPULSAR_BENCH_NBEAMS", "1")))
 
     nsamp = int(T_FULL * scale)
@@ -276,7 +327,6 @@ def run_measured() -> None:
         run_hi_accel=run_accel,
         max_cands_to_fold=int(os.environ.get("TPULSAR_BENCH_MAXFOLD",
                                              "20")))
-    dev_dtype = jnp.uint8 if dtype == "uint8" else jnp.bfloat16
     npasses = sum(s.numpasses for s in plan)
 
     with open(PARTIAL_PATH, "w") as fh:
@@ -290,7 +340,7 @@ def run_measured() -> None:
     for b in range(nbeams):
         _log(f"beam {b}: generating {NCHAN}x{nsamp} block on device")
         t_gen = time.time()
-        data = make_block_device(nsamp, seed=42 + b).astype(dev_dtype)
+        data = make_block_device(nsamp, seed=42 + b)
         data.block_until_ready()
         _log(f"beam {b}: block ready in {time.time()-t_gen:.1f} s")
 
@@ -401,6 +451,12 @@ def run_child(deadline: float, extra_env: dict | None = None
     env = dict(os.environ)
     if extra_env:
         env.update(extra_env)
+    # Truncate the partial-evidence file BEFORE the child spawns: the
+    # child only truncates it after `import jax` completes, so a child
+    # killed while importing (the sick-runtime hang) would otherwise
+    # report the PREVIOUS child's pass records as its own.
+    with open(PARTIAL_PATH, "w") as fh:
+        fh.write(json.dumps({"event": "spawn", "t": time.time()}) + "\n")
     if env.get("JAX_PLATFORMS", "").strip() == "cpu":
         # CPU children must not dial the accelerator runtime (a
         # wedged chip hangs `import jax` via the sitecustomize
@@ -431,6 +487,46 @@ def run_child(deadline: float, extra_env: dict | None = None
     return "crash", None
 
 
+def run_aot_gate(timeout: float, accel: bool, scale: float,
+                 config: int = 0) -> dict:
+    """Compile-only AOT memory gate (tools/aot_check.py) in a
+    subprocess.  Returns a record {ok, seconds, failures, detail}.
+    ok=False means the full-scale programs must NOT be executed on
+    the chip this run: either a program failed to compile (likely
+    over-budget — the exact failure mode that wedged the chip in
+    round 2) or the gate itself hung/crashed, leaving the memory
+    question unanswered.  Compiles land in the shared
+    JAX_COMPILATION_CACHE_DIR, so the measured run re-pays nothing."""
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "aot_check.py"),
+           "--scale", str(scale)]
+    if config in (1, 3, 4):
+        # focused configs compile their own exact program set
+        cmd += ["--config", str(config)]
+    elif accel:
+        cmd.append("--accel")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "seconds": round(time.time() - t0, 1),
+                "detail": f"aot_check hung > {timeout:.0f} s"}
+    except OSError as e:
+        return {"ok": False, "seconds": round(time.time() - t0, 1),
+                "detail": f"aot_check failed to start: {e}"}
+    out = proc.stdout or ""
+    failures = [ln.strip()[7:].split(":")[0]
+                for ln in out.splitlines() if "[FAIL]" in ln]
+    rec = {"ok": proc.returncode == 0,
+           "seconds": round(time.time() - t0, 1)}
+    if failures:
+        rec["failures"] = failures
+    if proc.returncode != 0 and not failures:
+        tail = (out + (proc.stderr or "")).strip().splitlines()
+        rec["detail"] = tail[-1][:200] if tail else f"rc={proc.returncode}"
+    return rec
+
+
 def main() -> None:
     if "--measured" in sys.argv:
         run_measured()
@@ -440,6 +536,36 @@ def main() -> None:
             float(os.environ.get("TPULSAR_BENCH_PROBE_TIMEOUT", "180")))
         print(json.dumps(rec if rec else {"ok": False}))
         return
+
+    try:
+        _bench_dtype_name()   # fail fast, before any TPU spend
+    except SystemExit as e:
+        print(json.dumps({
+            "metric": "mock_beam_full_plan_search_wallclock",
+            "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+            "error": str(e)}), flush=True)
+        return
+
+    cfg_raw = os.environ.get("TPULSAR_BENCH_CONFIG", "").strip()
+    bench_cfg = 0
+    if cfg_raw:
+        # Fail fast on a misconfig — before this check the harness
+        # would spend the AOT gate + smoke probes (most of the budget)
+        # only for the child to SystemExit on the same parse.  The
+        # parsed value is THE config for the rest of main (one parse;
+        # a second, different parse is how '+3' passes validation but
+        # gates the wrong program set).
+        try:
+            bench_cfg = int(cfg_raw)
+            if bench_cfg not in (1, 2, 3, 4, 5):
+                raise ValueError
+        except ValueError:
+            print(json.dumps({
+                "metric": "mock_beam_full_plan_search_wallclock",
+                "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+                "error": f"invalid TPULSAR_BENCH_CONFIG {cfg_raw!r} "
+                         "(must be 1-5)"}), flush=True)
+            return
 
     probe_timeout = float(os.environ.get("TPULSAR_BENCH_PROBE_TIMEOUT",
                                          "180"))
@@ -455,6 +581,55 @@ def main() -> None:
         kill/drain slop and the final JSON emission."""
         return max(5.0, total_budget - (time.time() - t_start) - reserve)
 
+    # Deadline floor reserved for the full-scale measured run: the
+    # gate, smoke probes, and ladder are aids — they must never starve
+    # the headline measurement into a guaranteed timeout record.
+    full_reserve = float(os.environ.get("TPULSAR_BENCH_FULL_RESERVE",
+                                        "300"))
+
+    def spendable(cap: float, floor: float = 30.0) -> float:
+        """Budget a pre-flight phase: at most `cap`, never dipping
+        into the full-run reserve, but at least `floor` so the phase
+        can do SOMETHING (a sub-floor budget means the total budget is
+        already blown and the run will be a timeout record anyway)."""
+        return max(floor, min(cap, remaining() - full_reserve))
+
+    def add_cpu_fallback(rec: dict) -> None:
+        """Attach a reduced-scale CPU evidence run to an error record."""
+        if os.environ.get("TPULSAR_BENCH_CPU_FALLBACK", "1") == "0":
+            return
+        _log("running reduced-scale CPU fallback for evidence")
+        cpu_probe = probe_device(min(probe_timeout, remaining()),
+                                 force_cpu=True)
+        if cpu_probe is None:
+            return
+        _, fb = run_child(
+            min(deadline, 600.0, remaining()),
+            extra_env={
+                "JAX_PLATFORMS": "cpu",
+                "TPULSAR_BENCH_SCALE":
+                    os.environ.get("TPULSAR_BENCH_CPU_SCALE", "0.0833"),
+                "TPULSAR_BENCH_ACCEL": "0",
+                # the evidence run is ALWAYS one reduced-scale
+                # headline beam: never inherit a focused config or a
+                # multi-beam batch into the CPU fallback
+                "TPULSAR_BENCH_CONFIG": "",
+                "TPULSAR_BENCH_NBEAMS": "1",
+                # rules-based fold grids are host-heavy on
+                # CPU; cap the fold set for the evidence run
+                "TPULSAR_BENCH_MAXFOLD": "3",
+            })
+        if fb is not None:
+            rec["cpu_fallback"] = {
+                "value_s": fb["value"],
+                "scale": float(os.environ.get(
+                    "TPULSAR_BENCH_CPU_SCALE", "0.0833")),
+                "accel_stage": False,
+                "dm_trials": fb.get("dm_trials"),
+                "injected_pulsar_recovered":
+                    fb.get("injected_pulsar_recovered"),
+            }
+
     try:
         _log(f"health-probing accelerator (timeout {probe_timeout:.0f} s)")
         probe = probe_device(min(probe_timeout, remaining()))
@@ -468,7 +643,38 @@ def main() -> None:
             probe = None
         if probe is not None:
             _log(f"probe OK: {probe}")
-            if probe.get("platform") not in (None, "cpu"):
+            on_tpu = probe.get("platform") not in (None, "cpu")
+            bench_scale = float(os.environ.get("TPULSAR_BENCH_SCALE",
+                                               "1.0"))
+            # config 2 is the headline with the accel stage forced off
+            # (run_measured sets ACCEL=0 in the child); the gate must
+            # see the accel setting the child will actually use
+            run_accel = (os.environ.get("TPULSAR_BENCH_ACCEL", "1")
+                         != "0") and bench_cfg != 2
+            aot_rec = None
+            if on_tpu and os.environ.get("TPULSAR_BENCH_AOT", "1") != "0":
+                # Mandatory compile-only gate before ANY full-scale
+                # execute: an over-budget program must die in the
+                # compiler (clean HTTP error), never at runtime (hours
+                # -long chip wedge — the round-2 failure mode).
+                _log("AOT compile-only memory gate "
+                     "(full-scale programs, no execution)")
+                aot_rec = run_aot_gate(spendable(600.0, floor=60.0),
+                                       accel=run_accel,
+                                       scale=bench_scale,
+                                       config=bench_cfg)
+                _log(f"AOT gate: {aot_rec}")
+                if not aot_rec["ok"]:
+                    result = {
+                        "metric": "mock_beam_full_plan_search_wallclock",
+                        "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+                        "error": "aot_gate_failed",
+                        "aot_check": aot_rec, "probe": probe,
+                    }
+                    add_cpu_fallback(result)
+                    print(json.dumps(result), flush=True)
+                    return
+            if on_tpu:
                 # Pre-run the Pallas smoke probe from here, while no
                 # process holds the chip; on success the measured
                 # child reads the cached verdict instead of probing
@@ -478,7 +684,8 @@ def main() -> None:
                 # would otherwise starve the measured run to the 5 s
                 # floor and guarantee a timeout record.
                 def smoke_cap() -> float:
-                    return min(probe_timeout + 330, remaining() * 0.3)
+                    return spendable(min(probe_timeout + 330,
+                                         remaining() * 0.3), floor=20.0)
 
                 _log("pre-running Pallas smoke probe")
                 try:
@@ -517,6 +724,66 @@ def main() -> None:
                     _log("accel batch smoke hung — pinning the "
                          "measured run to the per-DM accel path")
                     os.environ["TPULSAR_ACCEL_BATCH"] = "0"
+            # Measured scale ladder (TPU, full-scale headline only):
+            # short runs at 0.1 / 0.5 scale before committing the
+            # budget to the full beam.  Even if the full-scale run
+            # fails, the rungs are real TPU wall-clock datapoints.
+            ladder: list[dict] = []
+            anomaly = False
+            if (on_tpu and bench_scale >= 0.999 and bench_cfg == 0
+                    and os.environ.get("TPULSAR_BENCH_LADDER",
+                                       "1") != "0"):
+                for rung in (0.1, 0.5):
+                    rung_cap = min(300.0, remaining() * 0.3)
+                    if remaining() - rung_cap < full_reserve \
+                            or rung_cap < 60.0:
+                        _log(f"ladder rung {rung} skipped (budget: "
+                             "reserving the full-scale deadline)")
+                        break
+                    _log(f"ladder rung: scale={rung} "
+                         f"(cap {rung_cap:.0f} s)")
+                    st, rr = run_child(rung_cap, extra_env={
+                        "TPULSAR_BENCH_SCALE": str(rung),
+                        "TPULSAR_BENCH_NBEAMS": "1"})
+                    if rr is not None:
+                        ladder.append({
+                            "scale": rung, "value_s": rr["value"],
+                            "dm_trials": rr.get("dm_trials"),
+                            "injected_pulsar_recovered":
+                                rr.get("injected_pulsar_recovered"),
+                            "stage_s": rr.get("stage_s")})
+                        _log(f"rung {rung}: {rr['value']} s, "
+                             f"{rr.get('dm_trials')} trials")
+                    elif st == "timeout":
+                        # Rung shapes are NOT warmed by the AOT gate
+                        # (it compiles full-scale programs), so a rung
+                        # overrun is most likely cold-compile cost,
+                        # not a chip anomaly: skip remaining rungs but
+                        # still attempt the gated full-scale run.
+                        ladder.append({"scale": rung, "error": st,
+                                       **_read_partial()})
+                        _log(f"rung {rung} exceeded its cap — "
+                             "skipping remaining rungs, proceeding "
+                             "to the AOT-gated full-scale run")
+                        break
+                    else:
+                        ladder.append({"scale": rung, "error": st,
+                                       **_read_partial()})
+                        anomaly = True
+                        _log(f"rung {rung} CRASHED — stopping the "
+                             "ladder, skipping full scale")
+                        break
+            if anomaly:
+                result = {
+                    "metric": "mock_beam_full_plan_search_wallclock",
+                    "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+                    "error": "ladder_anomaly", "ladder": ladder,
+                    "probe": probe,
+                }
+                if aot_rec is not None:
+                    result["aot_check"] = aot_rec
+                print(json.dumps(result), flush=True)
+                return
             eff_deadline = min(deadline, remaining())
             status, result = run_child(eff_deadline)
             if result is None:
@@ -532,6 +799,14 @@ def main() -> None:
                     "error": err,
                     "probe": probe, **partial,
                 }
+            if aot_rec is not None:
+                result.setdefault("aot_check", aot_rec)
+            if ladder:
+                result.setdefault("ladder", ladder)
+                with open(PARTIAL_PATH, "a") as fh:
+                    for r in ladder:
+                        fh.write(json.dumps(
+                            {"event": "ladder_rung", **r}) + "\n")
         else:
             _log("accelerator UNHEALTHY (probe hung/crashed/fell back "
                  "to CPU)")
@@ -542,33 +817,7 @@ def main() -> None:
                 "probe": f"TPU jax.devices()+matmul did not complete in "
                          f"{probe_timeout:.0f} s (or fell back to CPU)",
             }
-            if os.environ.get("TPULSAR_BENCH_CPU_FALLBACK", "1") != "0":
-                _log("running reduced-scale CPU fallback for evidence")
-                cpu_probe = probe_device(min(probe_timeout, remaining()),
-                                         force_cpu=True)
-                if cpu_probe is not None:
-                    _, fb = run_child(
-                        min(deadline, 600.0, remaining()),
-                        extra_env={
-                            "JAX_PLATFORMS": "cpu",
-                            "TPULSAR_BENCH_SCALE":
-                                os.environ.get(
-                                    "TPULSAR_BENCH_CPU_SCALE", "0.0833"),
-                            "TPULSAR_BENCH_ACCEL": "0",
-                            # rules-based fold grids are host-heavy on
-                            # CPU; cap the fold set for the evidence run
-                            "TPULSAR_BENCH_MAXFOLD": "3",
-                        })
-                    if fb is not None:
-                        result["cpu_fallback"] = {
-                            "value_s": fb["value"],
-                            "scale": float(os.environ.get(
-                                "TPULSAR_BENCH_CPU_SCALE", "0.0833")),
-                            "accel_stage": False,
-                            "dm_trials": fb.get("dm_trials"),
-                            "injected_pulsar_recovered":
-                                fb.get("injected_pulsar_recovered"),
-                        }
+            add_cpu_fallback(result)
     except Exception as e:  # the one JSON line must still appear
         result = {
             "metric": "mock_beam_full_plan_search_wallclock",

@@ -51,6 +51,12 @@ def main() -> int:
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--accel", action="store_true",
                     help="also compile the hi-accel correlation block")
+    ap.add_argument("--config", type=int, default=0,
+                    help="compile the focused bench config's programs "
+                         "(1/3/4, matching bench.run_focused_config) "
+                         "instead of the headline survey-plan set — "
+                         "the gate must compile exactly what will "
+                         "execute")
     args = ap.parse_args()
 
     import jax
@@ -72,6 +78,11 @@ def main() -> int:
     nsamp -= nsamp % 30720
     freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
     plan = ddplan.survey_plan("pdev")
+    # the measured run's device block dtype and synthesizer come from
+    # bench itself — the gate must compile the EXACT programs the
+    # measured child executes, not a copy that can drift
+    import bench as bench_mod
+    blk_dtype = bench_mod._bench_dtype()
 
     failures: list[str] = []
 
@@ -88,8 +99,97 @@ def main() -> int:
                 traceback.print_exc()
 
     S = jax.ShapeDtypeStruct
-    blk = S((NCHAN, nsamp), jnp.uint8)
+    blk = S((NCHAN, nsamp), blk_dtype)
     nblocks = nsamp // 2048
+
+    print("synth:", flush=True)
+    check("make_block_chunk",
+          lambda key, dc: bench_mod.gen_block_chunk(
+              key, dc, n=nsamp, nc=120, dtype=blk_dtype),
+          S((2,), jnp.uint32), S((120,), jnp.float32))
+
+    if args.config in (1, 3, 4):
+        # Focused-config gate: compile the exact programs
+        # bench.run_focused_config(cfg) will execute (one
+        # 128/32-trial pass at ds=1 on the full-length block; the
+        # runtime dedisperse path is the XLA scan — Pallas only
+        # engages behind its own smoke gate).
+        dms = np.arange(128) * 2.0
+        if args.config == 3:
+            dms = dms[:32]
+        ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 96, 140.0, dms,
+                                            TSAMP, 1)
+        pad1 = dd._pad_bucket(int(ch_sh.max(initial=0)))
+        pad2 = dd._pad_bucket(int(sub_sh.max(initial=0)))
+        ndms = sub_sh.shape[0]
+        print(f"config {args.config} (ndms={ndms}, T={nsamp}):",
+              flush=True)
+        if args.config == 1:
+            check("cell_stats_chan",
+                  lambda d: rfi_k._cell_stats_chan(d, 2048), blk)
+            check("apply_mask_chan",
+                  lambda d, m, f: rfi_k.apply_mask_chan(d, m, f, 2048),
+                  blk, S((nblocks, NCHAN), jnp.bool_),
+                  S((NCHAN,), jnp.float32))
+        check("form_subbands",
+              lambda d, s: dd._form_subbands_jit(d, s, 96, 1, pad1),
+              blk, S((NCHAN,), jnp.int32))
+        check("dedisperse_scan",
+              lambda sb, sh: dd._dedisperse_subbands_scan(sb, sh, pad2),
+              S((96, nsamp), jnp.float32),
+              S((ndms, 96), jnp.int32))
+        if args.config == 4:
+            check("sp_boxcars",
+                  lambda s: sp_k.boxcar_search(sp_k.normalize_series(s)),
+                  S((ndms, nsamp), jnp.float32))
+        if args.config == 3:
+            from tpulsar.kernels import accel as ak
+            nbins = nsamp // 2 + 1
+            def _spec_scaled(s):
+                spec = fr.complex_spectrum(s)
+                powers, wpow = fr.whitened_powers(spec)
+                return fr.scale_spectrum(spec, powers, wpow)
+
+            check("spectrum+whiten+scale", _spec_scaled,
+                  S((ndms, nsamp), jnp.float32))
+            bank = ak.build_template_bank(200.0)
+            nz = len(bank.zs)
+            dmc = min(ndms, ak.plane_dm_chunk(nbins, nz))
+            print(f"accel z200 (nz={nz}, nbins={nbins}, "
+                  f"dm_chunk={dmc}):", flush=True)
+
+            # accel_search_batch's chunk_fn: full spectra argument +
+            # dynamic slice (the argument buffer is part of the gated
+            # footprint)
+            def _accel_chunk200(full, bf, c0):
+                import jax.lax as lax
+                block = lax.dynamic_slice_in_dim(full, c0, dmc, axis=0)
+                return ak._accel_block_topk(block, bf, bank.seg,
+                                            bank.step, bank.width, nz,
+                                            16, 64)
+
+            check("accel_chunk_z200", _accel_chunk200,
+                  S((ndms, nbins), jnp.complex64),
+                  S(bank.bank_fft.shape, jnp.complex64),
+                  S((), jnp.int32))
+
+            # per-DM fallback row program (see the headline gate)
+            def _accel_row200(full, bf, i):
+                import jax.lax as lax
+                spec = lax.dynamic_slice_in_dim(full, i, 1, axis=0)[0]
+                return ak._accel_plane_topk(spec, bf, bank.seg,
+                                            bank.step, bank.width, nz,
+                                            16, 64)
+
+            check("accel_row_z200", _accel_row200,
+                  S((ndms, nbins), jnp.complex64),
+                  S(bank.bank_fft.shape, jnp.complex64),
+                  S((), jnp.int32))
+        if failures:
+            print(f"{len(failures)} FAILED: {', '.join(failures)}")
+            return 1
+        print("all programs compiled")
+        return 0
 
     print("rfi:", flush=True)
     check("cell_stats_chan", lambda d: rfi_k._cell_stats_chan(d, 2048),
@@ -120,8 +220,13 @@ def main() -> int:
               S((step.numsub, T_ds), jnp.float32),
               S((ndms, step.numsub), jnp.int32))
         nfft = ddplan.choose_n(T_ds)
-        from tpulsar.search.executor import _budget_dm_chunk
-        chunk = min(ndms, _budget_dm_chunk(nfft, True, 6 << 30))
+        from tpulsar.search import executor as ex
+        # the executor's own chunk arithmetic (budget + even split),
+        # with run_hi_accel mirroring the measured run's accel setting
+        # — with the hi stage off it budgets a ~4/3 LARGER chunk, and
+        # the gate must compile that exact shape
+        chunk = ex.pass_chunk_size(
+            ndms, nfft, ex.SearchParams(run_hi_accel=args.accel))
         check(f"sp_boxcars ds={step.downsamp}",
               lambda s: sp_k.boxcar_search(sp_k.normalize_series(s)),
               S((chunk, T_ds), jnp.float32))
@@ -132,18 +237,51 @@ def main() -> int:
 
     if args.accel:
         from tpulsar.kernels import accel as ak
+        from tpulsar.search import executor as ex
         bank = ak.build_template_bank(50.0)
         nz = len(bank.zs)
         nfft = ddplan.choose_n(nsamp)
         nbins = nfft // 2 + 1
-        dmc = ak.plane_dm_chunk(nbins, nz)
-        print(f"accel (nz={nz}, nbins={nbins}, dm_chunk={dmc}):",
-              flush=True)
-        check("accel_block_topk",
-              lambda sp, bf: ak._accel_block_topk(
-                  sp, bf, bank.seg, bank.step, bank.width, nz, 8, 32),
-              S((dmc, nbins), jnp.complex64),
-              S(bank.bank_fft.shape, jnp.complex64))
+        # the executor hands accel_search_batch the budgeted pass
+        # chunk's spectra; inside, chunk_fn dynamic-slices
+        # plane_dm_chunk rows at a time — compile THAT program (full
+        # spectra argument + slice), not a pre-sliced stand-in, so
+        # the argument buffers are part of the gated footprint.
+        # ndms comes from the plan itself (the ds=1 step's pass
+        # width), not a hardcoded copy that can drift.
+        ds1 = next(s for s in plan if s.downsamp == 1)
+        spec_rows = ex.pass_chunk_size(
+            ds1.dms_per_pass, nfft, ex.SearchParams(run_hi_accel=True))
+        dmc = min(spec_rows, ak.plane_dm_chunk(nbins, nz))
+        print(f"accel (nz={nz}, nbins={nbins}, spec_rows={spec_rows}, "
+              f"dm_chunk={dmc}):", flush=True)
+
+        def _accel_chunk(full, bf, c0):
+            import jax.lax as lax
+            block = lax.dynamic_slice_in_dim(full, c0, dmc, axis=0)
+            return ak._accel_block_topk(block, bf, bank.seg, bank.step,
+                                        bank.width, nz, 8, 32)
+
+        check("accel_chunk_topk", _accel_chunk,
+              S((spec_rows, nbins), jnp.complex64),
+              S(bank.bank_fft.shape, jnp.complex64),
+              S((), jnp.int32))
+
+        # the per-DM fallback (accel_search_batch's row_fn): the path
+        # the child takes when the batch smoke fails or the runtime
+        # downgrades mid-run — it must be gated too, or an ungated
+        # program reaches the chip exactly when things already look
+        # shaky
+        def _accel_row(full, bf, i):
+            import jax.lax as lax
+            spec = lax.dynamic_slice_in_dim(full, i, 1, axis=0)[0]
+            return ak._accel_plane_topk(spec, bf, bank.seg, bank.step,
+                                        bank.width, nz, 8, 32)
+
+        check("accel_row_topk", _accel_row,
+              S((spec_rows, nbins), jnp.complex64),
+              S(bank.bank_fft.shape, jnp.complex64),
+              S((), jnp.int32))
 
     if failures:
         print(f"{len(failures)} FAILED: {', '.join(failures)}")

@@ -411,17 +411,8 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                     sp_chunks.append(events)
                 num_trials += len(dms)
             else:
-                chunk_sz = min(params.max_dms_per_chunk,
-                               _budget_dm_chunk(
-                                   ddplan.choose_n(subb.shape[1]),
-                                   hi=params.run_hi_accel
-                                   and params.hi_accel_zmax > 0,
-                                   budget=params.spectral_hbm_budget))
-                # Split the pass evenly so every chunk shares one
-                # compile signature (76 trials at a 51-trial budget
-                # run as 38+38, not 51+25).
-                n_chunks = -(-len(dms) // chunk_sz)
-                chunk_sz = -(-len(dms) // n_chunks)
+                chunk_sz = pass_chunk_size(
+                    len(dms), ddplan.choose_n(subb.shape[1]), params)
                 for lo in range(0, len(dms), chunk_sz):
                     dm_chunk = dms[lo: lo + chunk_sz]
                     with timers.timing("dedispersing"):
@@ -601,6 +592,22 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
 
 
 # ------------------------------------------------------------------ helpers
+
+def pass_chunk_size(ndms: int, nfft: int, params: SearchParams) -> int:
+    """The DM-chunk size a pass actually runs with: the HBM budget and
+    max_dms_per_chunk cap, then an even split so every chunk of the
+    pass shares one compile signature (76 trials at a 51-trial budget
+    run as 38+38, not 51+25).  tools/aot_check.py compiles gate
+    programs at this exact shape — keep the two in lockstep."""
+    chunk_sz = min(params.max_dms_per_chunk,
+                   _budget_dm_chunk(
+                       nfft,
+                       hi=params.run_hi_accel and params.hi_accel_zmax > 0,
+                       budget=params.spectral_hbm_budget))
+    chunk_sz = min(chunk_sz, ndms)
+    n_chunks = -(-ndms // chunk_sz)
+    return -(-ndms // n_chunks)
+
 
 class _BoundedCache:
     """Tiny FIFO-bounded memo for per-DM device arrays (a long
