@@ -1,0 +1,153 @@
+"""Tier-batched fold kernel (kernels/fold_batch.py): parity with the
+per-candidate fold path, batch-size invariance, and injected-pulsar
+recovery through the pass-grouped driver."""
+
+import numpy as np
+import pytest
+
+from tpulsar.constants import KDM
+from tpulsar.kernels import dedisperse as dd
+from tpulsar.kernels import fold as fold_k
+from tpulsar.kernels import fold_batch as fb
+
+NSUB, T, DT = 8, 1 << 13, 5e-4
+P_TRUE, DM_TRUE = 0.15, 60.0
+FREQS = np.linspace(1214.0, 1536.0, 64)
+
+
+def _subrefs():
+    return dd.subband_reference_freqs(FREQS, NSUB)
+
+
+def _synth(snr=4.0, seed=0):
+    """Unaligned subband block with a dispersed pulsar."""
+    rng = np.random.default_rng(seed)
+    subrefs = _subrefs()
+    t = np.arange(T) * DT
+    subb = rng.normal(0, 1, (NSUB, T)).astype(np.float32)
+    delays = KDM * DM_TRUE * (subrefs ** -2 - subrefs[-1] ** -2)
+    for s in range(NSUB):
+        ph = np.mod((t - delays[s]) / P_TRUE, 1.0)
+        subb[s] += snr * np.exp(
+            -0.5 * (np.minimum(ph, 1 - ph) / 0.03) ** 2)
+    return subb, delays
+
+
+def test_matches_per_candidate_fold_path():
+    """The batch kernel and kernels/fold.py agree on the optimized
+    candidate (their rotation schemes differ — fractional FFT vs
+    integer bins — so agreement is to grid-step tolerance)."""
+    subb, delays = _synth()
+    rules = fold_k.fold_rules(P_TRUE)
+    r_new = fb.fold_subbands_batch(subb, _subrefs(), DT,
+                                   [(P_TRUE, DM_TRUE)], rules)[0]
+    sub_sh0 = np.round(delays / DT).astype(np.int64)
+    r_old = fold_k.fold_subbands_and_optimize(
+        subb, _subrefs(), DT, P_TRUE, DM_TRUE, rules=rules,
+        sub_shifts_dm0=sub_sh0)
+    T_s = T * DT
+    dp_step = P_TRUE ** 2 / (rules.nbin * T_s)
+    # the old path rounds rotations to whole bins and can wander a
+    # couple of grid steps off the truth; the FFT path must be at
+    # least as close
+    assert abs(r_new.period_s - r_old.period_s) <= 4 * dp_step
+    assert abs(r_new.period_s - P_TRUE) <= abs(r_old.period_s - P_TRUE)
+    assert abs(r_new.reduced_chi2 - r_old.reduced_chi2) \
+        <= 0.05 * r_old.reduced_chi2
+    # both must see a very strong detection
+    assert r_new.reduced_chi2 > 50
+
+
+def test_exact_parameters_need_no_offset():
+    """Folding at the true (p, DM) must optimize to zero offsets —
+    the FFT rotations are exact, so nothing should beat the truth."""
+    subb, _ = _synth()
+    rules = fold_k.fold_rules(P_TRUE)
+    r = fb.fold_subbands_batch(subb, _subrefs(), DT,
+                               [(P_TRUE, DM_TRUE)], rules)[0]
+    assert r.delta_p == 0.0
+    assert r.delta_dm == 0.0
+
+
+def test_recovers_offset_parameters():
+    """A candidate handed in slightly off in (p, DM) is pulled back
+    toward the truth by the coordinate descent — to within the DM
+    grid's resolution (at this short observation one DM grid step is
+    ~1.4 DM units, so an offset of 1.0 is sub-resolution)."""
+    subb, _ = _synth(snr=8.0)
+    rules = fold_k.fold_rules(P_TRUE)
+    subrefs = _subrefs()
+    band_span = abs(subrefs[0] ** -2 - subrefs[-1] ** -2)
+    ddm_step = (P_TRUE / (rules.nbin * KDM * band_span)) * rules.dmstep
+    # offset by 3 period-grid steps (an offset under half a step is
+    # sub-resolution: the grid correctly stays at zero)
+    dp_step = P_TRUE ** 2 / (rules.nbin * T * DT)
+    p_off = P_TRUE + 3 * dp_step
+    r = fb.fold_subbands_batch(subb, subrefs, DT,
+                               [(p_off, DM_TRUE + 1.0)], rules)[0]
+    assert abs(r.period_s - P_TRUE) <= 1.5 * dp_step
+    assert abs(r.dm - DM_TRUE) <= 1.0 + 2 * ddm_step
+    assert r.reduced_chi2 > 50
+
+
+def test_batch_equals_singles():
+    """One batched call == per-candidate calls (same tier)."""
+    subb, _ = _synth()
+    rules = fold_k.fold_rules(P_TRUE)
+    cands = [(P_TRUE, DM_TRUE), (P_TRUE * 1.001, DM_TRUE + 2.0),
+             (P_TRUE * 0.999, DM_TRUE - 2.0)]
+    batch = fb.fold_subbands_batch(subb, _subrefs(), DT, cands, rules)
+    for cand, rb in zip(cands, batch):
+        rs = fb.fold_subbands_batch(subb, _subrefs(), DT, [cand],
+                                    rules)[0]
+        assert rb.period_s == pytest.approx(rs.period_s, rel=1e-6)
+        assert rb.dm == pytest.approx(rs.dm, abs=1e-6)
+        assert rb.reduced_chi2 == pytest.approx(rs.reduced_chi2,
+                                                rel=1e-4)
+
+
+def test_no_pdot_tier_has_flat_pdot_axis():
+    """Slow-pulsar tier (p >= 0.5 s) must not search pdot
+    (reference rule: RFI-prone slow folds, PALFA2_presto_search.py:
+    195-211)."""
+    rng = np.random.default_rng(1)
+    subb = rng.normal(0, 1, (NSUB, T)).astype(np.float32)
+    rules = fold_k.fold_rules(0.8)
+    assert not rules.search_pdot
+    r = fb.fold_subbands_batch(subb, _subrefs(), DT, [(0.8, 10.0)],
+                               rules)[0]
+    assert r.delta_pdot == 0.0
+
+
+def test_pass_grouped_driver(tmp_path):
+    """fold_candidates_by_pass folds candidates from their plan
+    pass's subband geometry and returns results keyed by caller
+    index."""
+    import jax.numpy as jnp
+
+    from tpulsar.plan import ddplan
+
+    rng = np.random.default_rng(2)
+    nchan, nsamp, dt = 64, 1 << 13, 5e-4
+    freqs = np.linspace(1214.0, 1536.0, nchan)
+    t = np.arange(nsamp) * dt
+    data = rng.normal(8, 2, (nchan, nsamp)).astype(np.float32)
+    delays = KDM * DM_TRUE * (freqs ** -2 - freqs[-1] ** -2)
+    for c in range(nchan):
+        ph = np.mod((t - delays[c]) / P_TRUE, 1.0)
+        data[c] += 5.0 * np.exp(
+            -0.5 * (np.minimum(ph, 1 - ph) / 0.03) ** 2)
+
+    plan = [ddplan.DedispStep(lodm=0.0, dmstep=2.0, dms_per_pass=38,
+                              numpasses=2, numsub=NSUB, downsamp=1)]
+    results = fb.fold_candidates_by_pass(
+        jnp.asarray(data), freqs, dt, plan,
+        [(0, P_TRUE, DM_TRUE), (1, 2 * P_TRUE, DM_TRUE)], NSUB,
+        lambda d, ch_sh, ns, ds: dd.form_subbands(
+            d, jnp.asarray(ch_sh), ns, ds))
+    assert set(results) == {0, 1}
+    r = results[0]
+    assert abs(r.dm - DM_TRUE) < 4.0
+    assert r.reduced_chi2 > 20
+    # the fundamental should beat the 2x-period alias
+    assert r.reduced_chi2 > results[1].reduced_chi2

@@ -44,7 +44,7 @@ from tpulsar.kernels import fourier as fr
 from tpulsar.kernels import rfi as rfi_k
 from tpulsar.kernels import singlepulse as sp_k
 from tpulsar.plan import ddplan
-from tpulsar.search import sifting
+from tpulsar.search import degraded, sifting
 from tpulsar.search.report import StageTimers
 
 
@@ -72,6 +72,13 @@ class SearchParams:
     #                                 axis (PALFA2_presto_search.py:
     #                                 195-211); False = fixed-geometry
     #                                 series fold below
+    fold_batched: bool = True       # fold candidates per originating
+    #                                 plan pass, tier-batched into one
+    #                                 device program (kernels/
+    #                                 fold_batch.py — prepfold folds
+    #                                 the pass's subband files too,
+    #                                 :168-175); False = the
+    #                                 per-candidate loop
     fold_nbin: int = 64
     fold_npart: int = 32
     max_dms_per_chunk: int = 128    # device memory blocking; the
@@ -211,9 +218,10 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                 or (params.block_quantize == "auto"
                     and f32_bytes > params.block_quantize_min))
     if quantize:
-        block, _qscale, _qoff = si.read_all_uint8()
+        block, qscale, qoff = si.read_all_uint8()
     else:
         block = si.read_all()                 # (T, nchan) ascending freq
+        qscale = qoff = None
     with timers.timing("rfifind"):
         # One host transpose, one transfer: the block lives on device
         # channel-major in its native dtype (uint8 beams stay 4x
@@ -223,7 +231,12 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
         mask = rfi_k.find_rfi_chan(data, si.dt,
                                    block_len=params.rfifind_blocklen,
                                    threshold=params.rfi_threshold)
-        mask.save(os.path.join(resultsdir, f"{basenm}_rfifind.npz"))
+        # the quantization affine travels with the mask: chan_fill
+        # (and any folded-profile amplitudes downstream) are in
+        # quantized units, and without the map a mask saved from a
+        # quantized run could not be re-applied to float32 data
+        mask.save(os.path.join(resultsdir, f"{basenm}_rfifind.npz"),
+                  qscale=qscale, qoff=qoff)
         # mask.block_len, not the configured one: find_rfi clamps it
         # for observations shorter than a block
         data = rfi_k.apply_mask_chan(
@@ -282,9 +295,11 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                 t_obs=data.shape[1] * si.dt)
 
     _write_header_json(resultsdir, obj)
+    deg = degraded.snapshot()
     _write_search_params(resultsdir, params, basenm, si, num_trials,
-                         baryv=baryv)
-    timers.write_report(os.path.join(resultsdir, f"{basenm}.report"), basenm)
+                         baryv=baryv, degraded_modes=deg)
+    timers.write_report(os.path.join(resultsdir, f"{basenm}.report"),
+                        basenm, degraded=deg)
     _tar_result_classes(resultsdir, basenm)
 
     return SearchOutcome(basenm=basenm, resultsdir=resultsdir,
@@ -342,6 +357,7 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     """
     params = params or SearchParams()
     timers = timers or StageTimers()
+    degraded.reset()   # this run's fallback flags only
     # TPULSAR_PROFILE=<dir>: capture a JAX profiler trace of the whole
     # block search (the TPU-era equivalent of the reference's stage
     # timers, SURVEY.md 5.1 — view with TensorBoard/xprof)
@@ -561,6 +577,22 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                 sub_sh[0])
 
     with timers.timing("folding"):
+        if params.fold_by_rules and params.fold_batched and to_fold:
+            # Tier-batched pass-grouped folding: candidates fold from
+            # their originating pass's subband geometry (subdm +
+            # downsamp — the same form_subbands program the search
+            # passes already compiled), one device program per tier.
+            from tpulsar.kernels import fold_batch as fbk
+
+            folded_by_idx = fbk.fold_candidates_by_pass(
+                data, freqs, dt, plan,
+                [(k, c.period_s, c.dm) for k, c in enumerate(to_fold)],
+                nsub,
+                lambda d, ch_sh, ns, ds: dd.form_subbands(
+                    d, jnp.asarray(ch_sh), ns, ds))
+            folded = [folded_by_idx[k] for k in range(len(to_fold))]
+            return final, folded, sp_events, num_trials
+
         # group by DM so each DM's subband block is formed once even
         # when same-DM candidates interleave in the sigma ordering
         fold_groups: dict[float, list[int]] = {}
@@ -823,6 +855,16 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
                and subb.nbytes > params.seq_shard_min_bytes))
     seq_ok = (n_dm > 1 and T_ds % n_dm == 0
               and dd_pad <= T_ds // n_dm)
+    # Ultra-long series: when even ONE trial's spectral tail exceeds
+    # the per-device budget, the seq-shard reshard to whole per-device
+    # series is impossible — the spectrum itself must be distributed
+    # (parallel/dist_fft four-step FFT; SURVEY.md section 5.7).
+    from tpulsar.parallel.dist_fft import spectral_bytes_per_trial
+    if (seq_ok and params.seq_shard != "off"
+            and spectral_bytes_per_trial(nfft)
+            > params.spectral_hbm_budget):
+        return pmesh.seq_dist_search(
+            mesh, subb, sub_shifts, dms, dt_ds, nfft, params)
     if seq and not seq_ok and params.seq_shard == "on":
         import warnings
         warnings.warn(
@@ -916,6 +958,11 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
         # single-device route (accel_search_batch -> its own proven
         # per-DM fallback), re-dedispersing in chunks.  Slower, but
         # correct on runtimes that reject the batched shapes.
+        from tpulsar.search import degraded
+        degraded.note("sharded_hi_fallback",
+                      "batched-FFT gate failed on the mesh path; hi "
+                      "stage re-dedisperses per chunk (2x stage-2 "
+                      "cost)")
         for lo in range(0, ndms, params.max_dms_per_chunk):
             dm_chunk = dms[lo: lo + params.max_dms_per_chunk]
             series = dd.dedisperse_subbands(
@@ -993,15 +1040,19 @@ def _write_header_json(resultsdir, obj) -> None:
 
 
 def _write_search_params(resultsdir, params, basenm, si, num_trials,
-                         baryv: float = 0.0) -> None:
+                         baryv: float = 0.0,
+                         degraded_modes: dict | None = None) -> None:
     """Provenance dump, python-literal assignments like the reference's
-    search_params.txt (PALFA2_presto_search.py:695-700)."""
+    search_params.txt (PALFA2_presto_search.py:695-700).
+    degraded_modes: fallback-path flags, so the provenance states
+    which code paths produced these results."""
     with open(os.path.join(resultsdir, "search_params.txt"), "w") as fh:
         fh.write(f"basenm = {basenm!r}\n")
         fh.write(f"source = {si.source!r}\n")
         fh.write(f"backend = {si.backend!r}\n")
         fh.write(f"num_dm_trials = {num_trials}\n")
         fh.write(f"baryv = {baryv!r}\n")
+        fh.write(f"degraded_modes = {dict(degraded_modes or {})!r}\n")
         for k, v in params.provenance().items():
             fh.write(f"{k} = {v!r}\n")
 
