@@ -314,3 +314,34 @@ def test_awkward_length_beam_pads_to_fft_friendly(tmp_path):
     assert abs(best.freq_hz - 1.0 / p_true) * p_true < 0.01 \
         or abs(best.freq_hz - 2.0 / p_true) * p_true / 2 < 0.01
     assert abs(best.dm - dm_true) <= 5.0
+
+
+def test_degraded_modes_surfaced(tmp_path, monkeypatch):
+    """A forced fallback (accel batch pinned to per-DM) must be
+    visible in search_params.txt and the .report — a results
+    directory has to be self-explaining about which code path
+    produced it (round-2 verdict weakness #8)."""
+    import tpulsar.kernels.accel as accel_k
+
+    monkeypatch.setenv("TPULSAR_ACCEL_BATCH", "0")
+    monkeypatch.setattr(accel_k, "_BATCH_OK", None)
+    spec = synth.BeamSpec(nchan=24, nsamp=1 << 13, nbits=4,
+                          tsamp_s=5.24288e-4)
+    fns = synth.synth_beam(str(tmp_path / "deg"), spec, merged=True)
+    plan = [ddplan.DedispStep(lodm=0.0, dmstep=2.0, dms_per_pass=8,
+                              numpasses=1, numsub=24, downsamp=1)]
+    params = executor.SearchParams(nsub=24, hi_accel_zmax=8,
+                                   topk_per_stage=8,
+                                   max_cands_to_fold=1)
+    out = executor.search_beam(fns, str(tmp_path / "w"),
+                               str(tmp_path / "r"), params=params,
+                               plan=plan)
+    ns: dict = {}
+    exec(open(os.path.join(out.resultsdir,
+                           "search_params.txt")).read(), {}, ns)
+    assert "accel_batch_pinned" in ns["degraded_modes"]
+    rep = open(os.path.join(out.resultsdir,
+                            f"{out.basenm}.report")).read()
+    assert "accel_batch_pinned" in rep
+    # restore the module verdict for other tests in this process
+    monkeypatch.setattr(accel_k, "_BATCH_OK", None)

@@ -325,10 +325,20 @@ def _batch_path_usable() -> bool:
     e.g. from bench.py's jax-free parent)."""
     global _BATCH_OK
     if _BATCH_OK is not None:
+        if not _BATCH_OK:
+            # re-note on every consult: searches reset the degraded
+            # registry per run, and the cached verdict still applies
+            from tpulsar.search import degraded
+            degraded.note("accel_batch_pinned",
+                          "cached verdict: per-DM accel path")
         return _BATCH_OK
     forced = os.environ.get("TPULSAR_ACCEL_BATCH", "").strip()
     if forced in ("0", "1"):
         _BATCH_OK = forced == "1"
+        if not _BATCH_OK:
+            from tpulsar.search import degraded
+            degraded.note("accel_batch_pinned",
+                          "TPULSAR_ACCEL_BATCH=0 (per-DM accel path)")
         return _BATCH_OK
     from tpulsar.kernels.pallas_dd import _backend_already_initialized
     if _backend_already_initialized():
@@ -366,6 +376,11 @@ def _batch_path_usable() -> bool:
                                        or platform in ("", "cpu"))
     except (subprocess.TimeoutExpired, OSError):
         _BATCH_OK = False
+    if not _BATCH_OK:
+        from tpulsar.search import degraded
+        degraded.note("accel_batch_pinned",
+                      "batched-FFT smoke failed on this runtime "
+                      "(per-DM accel path)")
     if _BATCH_OK:
         try:
             with open(_smoke_cache_path(), "w") as fh:
@@ -434,6 +449,10 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
             global _BATCH_OK
             _BATCH_OK = False
             use_batch = False
+            from tpulsar.search import degraded
+            degraded.note("accel_batch_downgraded",
+                          f"runtime rejected batched shapes: "
+                          f"{str(exc)[:160]}")
             import warnings
             warnings.warn("batched accel path rejected by the "
                           f"runtime ({exc}); using per-DM fallback")

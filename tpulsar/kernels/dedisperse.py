@@ -221,7 +221,9 @@ def dedisperse_subbands(subbands: jnp.ndarray,
             "standard stage-2 path", stacklevel=2)
 
     sig = (tuple(subbands.shape), tuple(np.asarray(sub_shifts).shape))
-    if pallas_dd.use_pallas() and pallas_dd.signature_enabled(sig):
+    use_p = pallas_dd.use_pallas()
+    sig_on = pallas_dd.signature_enabled(sig)
+    if use_p and sig_on:
         try:
             out = pallas_dd.dedisperse_subbands_pallas(subbands,
                                                        sub_shifts)
@@ -234,6 +236,22 @@ def dedisperse_subbands(subbands: jnp.ndarray,
             if pallas_dd.forced():
                 raise      # TPULSAR_PALLAS=1 = no-fallback (CI mode)
             pallas_dd.disable_signature(sig, reason=str(e)[:200])
+            from tpulsar.search import degraded
+            degraded.note("pallas_dd_disabled",
+                          f"kernel fault, XLA fallback: {str(e)[:160]}")
+    elif pallas_dd.is_tpu_backend():
+        # flagship kernel off on the TPU backend (smoke gate, env, or
+        # a signature disabled by an earlier fault): the result must
+        # say which stage-2 path produced it — on EVERY later run too
+        # (the registry resets per search run, the verdict persists
+        # for the process).  Non-TPU backends are NOT degraded: the
+        # XLA path is their only and intended path.
+        from tpulsar.search import degraded
+        degraded.note("pallas_dd_disabled",
+                      "smoke gate or TPULSAR_PALLAS=0; XLA scan path"
+                      if not use_p else
+                      "signature disabled after an earlier kernel "
+                      "fault; XLA scan path")
     return _dedisperse_subbands_xla(subbands, sub_shifts)
 
 

@@ -1,0 +1,35 @@
+"""Degraded-mode registry: which fallback code paths produced a
+result.
+
+The search has several silent fallbacks (Pallas dedispersion ->
+XLA scan, batched accel FFT -> per-DM, sharded hi stage ->
+re-dedispersing single-device route).  Correctness is preserved by
+construction, but a results directory must be self-explaining about
+WHICH code path produced it — a beam searched at 2x dedispersion cost
+or without the flagship kernel should say so in its own artifacts
+(round-2 verdict weakness #8).  Flags land in `search_params.txt` and
+the `.report` (reference artifact contract:
+PALFA2_presto_search.py:336-372).
+
+Process-global by design: the fallback decisions themselves are
+process-global (smoke-gate verdicts, runtime downgrades), and a
+search run snapshots + resets around its own execution.
+"""
+
+from __future__ import annotations
+
+_FLAGS: dict[str, str] = {}
+
+
+def note(flag: str, detail: str = "") -> None:
+    """Record a degraded-mode event (first detail wins — the first
+    occurrence is the decision point; repeats are the same verdict)."""
+    _FLAGS.setdefault(flag, detail)
+
+
+def snapshot() -> dict[str, str]:
+    return dict(_FLAGS)
+
+
+def reset() -> None:
+    _FLAGS.clear()

@@ -49,6 +49,14 @@ class StageTimers:
                      f"({100*(total-accounted)/total:5.1f}%)")
         return "\n".join(lines) + "\n"
 
-    def write_report(self, path: str, basenm: str) -> None:
+    def write_report(self, path: str, basenm: str,
+                     degraded: dict[str, str] | None = None) -> None:
+        """degraded: fallback-path flags (search.degraded.snapshot())
+        appended so a results directory is self-explaining about
+        which code paths produced it."""
         with open(path, "w") as fh:
             fh.write(self.report_text(basenm))
+            if degraded:
+                fh.write("\nDegraded modes (fallback paths taken):\n")
+                for flag, detail in sorted(degraded.items()):
+                    fh.write(f"  {flag}: {detail}\n")
