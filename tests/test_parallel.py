@@ -332,3 +332,90 @@ def test_dist_fft_multimillion_bins():
     assert err < 5e-4, err
     for f in (12345, 1 << 20, N - 777):
         assert np.abs(got[f]) > 0.5 * N    # tone power concentrated
+
+
+def test_dist_fft_large_n_error_bound():
+    """2^22-point accumulated twiddle error (round-2 verdict weak #7:
+    the 4096-point check said nothing about survey-scale lengths).
+    complex64 four-step keeps sub-1e-4 relative max-norm error."""
+    m = pmesh.make_mesh(n_beam=1, n_dm=8)
+    rng = np.random.default_rng(22)
+    N = 1 << 22
+    x = (rng.standard_normal(N) + 1j * rng.standard_normal(N)
+         ).astype(np.complex64)
+    got = dist_fft.dist_fft_natural(x, m, axis_name="dm")
+    want = np.fft.fft(x).astype(np.complex64)
+    err = np.max(np.abs(got - want)) / np.max(np.abs(want))
+    assert err < 1e-4, err
+
+
+def test_dist_spectral_topk_finds_tones():
+    """The production consumer path: an ultra-long real series,
+    time-sharded, searched WITHOUT ever materializing the spectrum on
+    one device — injected tones must come back as the top bins with
+    whitened powers near the analytic coherent power."""
+    m = pmesh.make_mesh(n_beam=1, n_dm=8)
+    rng = np.random.default_rng(5)
+    N = 1 << 21
+    t = np.arange(N, dtype=np.float64)
+    x = rng.standard_normal(N).astype(np.float32)
+    bins = [12345, 333333, 700007]
+    amp = 0.05
+    for b in bins:
+        x += (amp * np.cos(2 * np.pi * b * t / N)).astype(np.float32)
+    vals, got_bins = dist_fft.dist_spectral_topk(
+        jnp.asarray(x.astype(np.complex64)), m, "dm", N, topk=16)
+    # all three tones in the top-k, at their exact bins
+    for b in bins:
+        assert b in got_bins.tolist(), (b, got_bins)
+    # whitened coherent power ~ N*amp^2/4 (full-FFT convention),
+    # within the noise envelope + the sampled-whitening tolerance
+    p_expect = N * amp ** 2 / 4.0
+    top3 = sorted(vals[np.isin(got_bins, bins)], reverse=True)
+    for p in top3:
+        assert abs(p / p_expect - 1.0) < 0.25, (p, p_expect)
+    # nothing mirrored: every reported bin is in the real half
+    assert (got_bins >= 1).all() and (got_bins <= N // 2).all()
+
+
+def test_dist_spectral_gate_arithmetic():
+    """The seq-shard gate quantity: per-trial spectral bytes grow
+    linearly in nfft and cross a 1 GB budget only far beyond the
+    survey's 2^22-sample beams — the distributed tail must NOT engage
+    at survey scale."""
+    survey = dist_fft.spectral_bytes_per_trial(1 << 22)
+    assert survey < (1 << 30)
+    huge = dist_fft.spectral_bytes_per_trial(1 << 28)
+    assert huge > (1 << 30)
+
+
+def test_seq_dist_search_pass_finds_pulsar():
+    """The ultra-long-series production path (executor gate forced by
+    a tiny spectral budget): time-sharded dedisperse + distributed
+    FFT tail must still find the injected pulsar and its SP events,
+    without ever resharding whole series per device."""
+    from tpulsar.plan import ddplan
+    from tpulsar.search import degraded, executor
+
+    m = pmesh.make_mesh(n_beam=1, n_dm=8)
+    rng = np.random.default_rng(77)
+    nchan, T, dt = 16, 1 << 14, 1e-3
+    freqs = np.linspace(1200.0, 1500.0, nchan)
+    data = rng.standard_normal((nchan, T)).astype(np.float32)
+    tgrid = np.arange(T) * dt
+    data += ((tgrid / 0.08) % 1.0 < 0.1)[None, :] * 2.0
+    plan = [ddplan.DedispStep(lodm=0.0, dmstep=10.0, dms_per_pass=8,
+                              numpasses=1, numsub=8, downsamp=1)]
+    params = executor.SearchParams(
+        nsub=8, lo_accel_numharm=4, run_hi_accel=False,
+        topk_per_stage=16, max_cands_to_fold=0, make_plots=False,
+        seq_shard="on", spectral_hbm_budget=1 << 16)  # force the gate
+    cands, folded, sp, ntrials = executor.search_block(
+        jnp.asarray(data), freqs, dt, plan, params, mesh=m)
+    assert ntrials == 8
+    assert any(abs(c.freq_hz - 1.0 / 0.08) < 0.05 or
+               abs(c.freq_hz - 2.0 / 0.08) < 0.05 for c in cands), \
+        [c.freq_hz for c in cands]
+    # the mode self-reports in the degraded registry
+    assert "seq_dist_spectral" in degraded.snapshot()
+    assert len(sp) > 0

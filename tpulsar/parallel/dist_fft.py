@@ -31,6 +31,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+#: jitted program caches — a fresh closure per call would re-trace
+#: the whole distributed program on EVERY invocation (the per-trial
+#: loop in mesh.seq_dist_search calls these once per DM trial)
+_FFT_FN_CACHE: dict = {}
+_TAIL_FN_CACHE: dict = {}
+
+
 def dist_fft(x: jnp.ndarray, mesh: Mesh, axis_name: str = "dm"):
     """FFT of a complex series sharded along its (single) axis.
 
@@ -39,8 +46,15 @@ def dist_fft(x: jnp.ndarray, mesh: Mesh, axis_name: str = "dm"):
     spectrum in transposed-digit order, still sharded (B rows over the
     axis).
     """
-    n_dev = mesh.shape[axis_name]
     N = x.shape[0]
+    key = (mesh, axis_name, N)
+    if key not in _FFT_FN_CACHE:
+        _FFT_FN_CACHE[key] = _build_fft_fn(mesh, axis_name, N)
+    return _FFT_FN_CACHE[key](x.astype(jnp.complex64))
+
+
+def _build_fft_fn(mesh: Mesh, axis_name: str, N: int):
+    n_dev = mesh.shape[axis_name]
     A = _choose_A(N, n_dev)
     B = N // A
     A_loc, B_loc = A // n_dev, B // n_dev
@@ -68,9 +82,9 @@ def dist_fft(x: jnp.ndarray, mesh: Mesh, axis_name: str = "dm"):
         return jnp.fft.fft(full, axis=1)               # [k1_loc, k2]
 
     from jax import shard_map
-    fn = shard_map(body, mesh=mesh, in_specs=P(axis_name),
-                   out_specs=P(axis_name, None), check_vma=False)
-    return fn(x.astype(jnp.complex64))
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis_name),
+                             out_specs=P(axis_name, None),
+                             check_vma=False))
 
 
 def _choose_A(N: int, n_dev: int) -> int:
@@ -105,3 +119,107 @@ def dist_fft_natural(x: np.ndarray, mesh: Mesh, axis_name: str = "dm"
     out = np.empty(N, dtype=np.complex64)
     out[idx.ravel()] = Xt.ravel()
     return out
+
+
+# ----------------------------------------------- distributed spectral search
+#
+# The production consumer (executor seq-shard spectral tail, gated on
+# the per-trial series size): search ONE ultra-long real series whose
+# padded complex spectrum does not fit a single device.  The series
+# arrives time-sharded (seq_dedisperse output); the spectrum stays
+# sharded in transposed-digit order end to end — only the top-k
+# candidate bins ever leave the mesh.
+#
+# Whitening in transposed order: device d's rows k1 in
+# [d*A_loc, (d+1)*A_loc) hold natural bins k = k1 + A*k2 — for every
+# k2, a CONTIGUOUS run of A_loc bins, strided A apart.  Each device
+# therefore sees an A_loc/A uniform sample of EVERY whitening block,
+# so per-device block medians are an unbiased estimate of the global
+# block medians (sample >= block_len/n_dev points; the estimate error
+# is O(1/sqrt(sample)) of the local power scale).  This is
+# deliberately NOT bit-identical to the single-device whitening —
+# callers get a documented statistical tolerance instead of a 2x
+# memory blow-up.  Harmonic summing is fundamental-only here: summing
+# h*k across transposed shards is a residue permutation we have not
+# needed yet (the gate only engages for series far beyond the survey
+# workload; extend if such a survey materializes).
+
+
+def dist_spectral_topk(x_sharded, mesh: Mesh, axis_name: str,
+                       N: int, topk: int = 64, block: int = 1 << 15):
+    """Top-k whitened power bins of a length-N complex series sharded
+    over `axis_name` (natural contiguous shards, N = A*B as in
+    dist_fft).
+
+    Returns (powers[topk], bins[topk]) as numpy, bins in NATURAL
+    frequency order, powers whitened to unit-mean noise.  Only the
+    per-device top-k (a few hundred bytes) crosses the mesh at the
+    end.
+    """
+    Xt = dist_fft(x_sharded, mesh, axis_name)   # (A, B) sharded rows
+    key = (mesh, axis_name, N, topk, block)
+    if key not in _TAIL_FN_CACHE:
+        _TAIL_FN_CACHE[key] = _build_tail_fn(mesh, axis_name, N, topk,
+                                             block)
+    vals, bins = _TAIL_FN_CACHE[key](Xt)
+    return np.asarray(vals), np.asarray(bins)
+
+
+def _build_tail_fn(mesh: Mesh, axis_name: str, N: int, topk: int,
+                   block: int):
+    n_dev = mesh.shape[axis_name]
+    A = _choose_A(N, n_dev)
+    B = N // A
+    A_loc = A // n_dev
+
+    def tail(xt_shard):
+        # xt_shard: (A_loc, B) rows k1 -> natural bins k1 + A*k2
+        pw = jnp.abs(xt_shard) ** 2
+        # distributed whitening: block medians over the LOCAL comb
+        # sample of each natural-frequency block.  Natural bin of
+        # column k2 is k1 + A*k2 ~ A*k2: block index = A*k2 // block,
+        # identical for all local rows — group columns.
+        cols_per_block = min(max(1, block // A), B)
+        nblk = max(1, B // cols_per_block)
+        usable = nblk * cols_per_block
+        med = jnp.median(
+            pw[:, :usable].reshape(A_loc, nblk, cols_per_block),
+            axis=(0, 2))                         # (nblk,)
+        med = jnp.maximum(med, 1e-30) / jnp.log(2.0)  # median -> mean
+        scale = jnp.repeat(med, cols_per_block, total_repeat_length=usable)
+        scale = jnp.concatenate(
+            [scale, jnp.full((B - usable,), med[-1])])
+        white = pw / scale[None, :]
+        # real input: keep only the non-mirrored half (bin k and N-k
+        # carry equal power), and never report DC
+        d0 = jax.lax.axis_index(axis_name)
+        k1_col = d0 * A_loc + jnp.arange(A_loc)[:, None]
+        nat_grid = k1_col + A * jnp.arange(B)[None, :]
+        white = jnp.where((nat_grid >= 1) & (nat_grid <= N // 2),
+                          white, 0.0)
+        # local top-k over the flattened shard
+        flat = white.reshape(-1)
+        vals, idx = jax.lax.top_k(flat, topk)
+        # natural bin: k1 = d*A_loc + idx//B (row), k2 = idx % B
+        k1 = d0 * A_loc + idx // B
+        k2 = idx % B
+        nat = k1 + A * k2
+        # gather every device's top-k, reduce to the global top-k
+        all_vals = jax.lax.all_gather(vals, axis_name)   # (n, topk)
+        all_nat = jax.lax.all_gather(nat, axis_name)
+        gvals, gidx = jax.lax.top_k(all_vals.reshape(-1), topk)
+        return gvals, all_nat.reshape(-1)[gidx]
+
+    from jax import shard_map
+    return jax.jit(shard_map(tail, mesh=mesh,
+                             in_specs=P(axis_name, None),
+                             out_specs=(P(), P()), check_vma=False))
+
+
+def spectral_bytes_per_trial(nfft: int) -> int:
+    """Peak per-device bytes for ONE trial's single-device spectral
+    tail (complex spectrum + powers + whitened copy) — the gate
+    quantity for switching to the distributed tail (same bookkeeping
+    style as executor._budget_dm_chunk)."""
+    nbins = nfft // 2 + 1
+    return 8 * nbins + 4 * nbins + 4 * nbins + 4 * nfft

@@ -301,3 +301,83 @@ def shard_dm_table(sub_shifts: np.ndarray, n_dm: int) -> np.ndarray:
         pad = np.repeat(sub_shifts[-1:], rem, axis=0)
         sub_shifts = np.concatenate([sub_shifts, pad], axis=0)
     return sub_shifts
+
+
+# --------------------------------------------- ultra-long-series dist pass
+
+def seq_dist_search(mesh: Mesh, subbands, sub_shifts, dms, dt_ds: float,
+                    nfft: int, params, axis_name: str = "dm"):
+    """One pass over DM trials whose per-trial spectral tail exceeds a
+    device (parallel/dist_fft.spectral_bytes_per_trial > the HBM
+    budget): the seq-shard all_to_all reshard to whole per-device
+    series is impossible, so the series STAYS time-sharded end to end
+    and the spectrum is computed with the distributed four-step FFT —
+    only top-k candidate bins ever leave the mesh (SURVEY.md
+    section 5.7's 'FFT of a series that exceeds one chip').
+
+    Returns (candidates, sp_events) like the sharded pass.
+
+    Documented deviations from the single-device tail (this mode only
+    engages beyond single-chip scale, far outside the golden
+    scenarios): whitening block medians are estimated from each
+    device's comb sample of the block (unbiased, not bit-identical);
+    single-pulse normalization is per time-chunk; periodicity reports
+    FUNDAMENTAL (numharm=1) candidates — harmonic summing across
+    transposed shards is future work; zaplists are not applied.
+    """
+    from tpulsar.kernels import singlepulse as sp_k
+    from tpulsar.parallel import dist_fft as dfft
+    from tpulsar.parallel.seq_dedisperse import halo_extend, seq_dedisperse
+    from tpulsar.search import degraded, sifting
+    from tpulsar.search.executor import _lo_sigma_fn
+
+    n_dev = int(mesh.shape[axis_name])
+    nsub, T = subbands.shape
+    ndms = len(dms)
+    chunk = T // n_dev
+    degraded.note("seq_dist_spectral",
+                  "per-trial spectrum beyond one device: distributed "
+                  "FFT tail, fundamental-only, no zaplist")
+
+    series = seq_dedisperse(subbands, np.asarray(sub_shifts)[:ndms],
+                            mesh, axis_name=axis_name)  # (ndms, T) sharded
+
+    # single-pulse: local-chunk boxcars with a right halo so no pulse
+    # straddling a shard boundary is lost; halo hits are the right
+    # neighbour's to report (mask them out here)
+    sp_halo = max(params.sp_widths)
+
+    def sp_body(series_loc):
+        ext = halo_extend(series_loc, sp_halo, axis_name, n_dev)
+        norm = sp_k.normalize_series(ext)
+        snr, idx = sp_k.boxcar_search(norm, tuple(params.sp_widths),
+                                      sp_k.DEFAULT_TOPK)
+        local = idx < chunk
+        snr = jnp.where(local, snr, -jnp.inf)
+        idx = idx + jax.lax.axis_index(axis_name) * chunk
+        return (jax.lax.all_gather(snr, axis_name, axis=2, tiled=True),
+                jax.lax.all_gather(idx, axis_name, axis=2, tiled=True))
+
+    from jax import shard_map
+    sp_fn = jax.jit(shard_map(
+        sp_body, mesh=mesh, in_specs=P(None, axis_name),
+        out_specs=(P(), P()), check_vma=False))
+    sp_snr, sp_idx = sp_fn(series)
+    events = sp_k.events_from_topk(
+        np.asarray(sp_snr), np.asarray(sp_idx), np.asarray(dms), dt_ds,
+        threshold=params.sp_threshold, widths=tuple(params.sp_widths))
+
+    # periodicity: per-trial distributed spectral top-k (fundamental)
+    nbins = nfft // 2 + 1
+    topk = params.topk_per_stage
+    vals = np.empty((ndms, topk), np.float32)
+    bins = np.empty((ndms, topk), np.int64)
+    for i in range(ndms):
+        x = jnp.pad(series[i], (0, nfft - T)).astype(jnp.complex64)
+        v, b = dfft.dist_spectral_topk(x, mesh, axis_name, nfft,
+                                       topk=topk)
+        vals[i], bins[i] = v, b
+    cands = sifting.make_candidates(
+        {1: (vals, bins)}, np.asarray(dms), nfft * dt_ds,
+        _lo_sigma_fn(nbins), sigma_min=params.sifting.sigma_threshold)
+    return cands, events
