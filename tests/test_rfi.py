@@ -75,3 +75,35 @@ def test_short_observation_mask_is_finite():
     out = rfi.apply_mask(jnp.asarray(data),
                          jnp.asarray(mask.full_mask()), mask.block_len)
     assert out.shape == data.shape
+
+
+def test_mask_quantization_roundtrip(tmp_path):
+    """The per-channel dequantization affine saved with a quantized
+    run's mask must load back exactly: a mask whose chan_fill is in
+    quantized units is only re-applicable to float32 data through
+    this map (round-2 advisor finding)."""
+    import numpy as np
+
+    from tpulsar.kernels.rfi import RFIMask
+
+    nchan, nblocks = 8, 4
+    mask = RFIMask(block_len=128, dt=1e-3,
+                   cell_mask=np.zeros((nblocks, nchan), bool),
+                   bad_channels=np.zeros(nchan, bool),
+                   bad_blocks=np.zeros(nblocks, bool),
+                   chan_fill=np.arange(nchan, dtype=np.float32))
+    qscale = np.linspace(0.1, 2.0, nchan).astype(np.float32)
+    qoff = np.linspace(-3.0, 3.0, nchan).astype(np.float32)
+    p = str(tmp_path / "m.npz")
+    mask.save(p, qscale=qscale, qoff=qoff)
+    got = RFIMask.load_quantization(p)
+    assert got is not None
+    np.testing.assert_array_equal(got[0], qscale)
+    np.testing.assert_array_equal(got[1], qoff)
+    # float32 runs carry no map
+    p2 = str(tmp_path / "m2.npz")
+    mask.save(p2)
+    assert RFIMask.load_quantization(p2) is None
+    # the mask itself still round-trips
+    m2 = RFIMask.load(p)
+    np.testing.assert_array_equal(m2.chan_fill, mask.chan_fill)

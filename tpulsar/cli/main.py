@@ -514,7 +514,6 @@ def cmd_doctor(args):
 
     print("dependencies:")
     for mod, hint in [("numpy", "pip install numpy"),
-                      ("jax", "pip install jax (TPU: jax[tpu])"),
                       ("matplotlib", "pip install matplotlib "
                                      "(plots/stats dashboards)"),
                       ("yaml", "pip install pyyaml (YAML configs; "
@@ -524,6 +523,27 @@ def cmd_doctor(args):
             report(f"import {mod}", True)
         except ImportError as e:
             report(f"import {mod}", False, f"{e}; hint: {hint}")
+    # jax is NEVER imported in this process: the container's
+    # sitecustomize registers the accelerator PJRT plugin during
+    # `import jax` and dials the runtime — on a wedged chip that
+    # hangs BEFORE any timeout can be armed, turning the doctor into
+    # the very hang it exists to diagnose.  Probe importability in a
+    # CPU-pinned subprocess under a hard timeout instead.
+    from tpulsar import cpu_subprocess_env
+    try:
+        pr = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.__version__)"],
+            env=cpu_subprocess_env(), capture_output=True, text=True,
+            timeout=60)
+        report("import jax (subprocess)", pr.returncode == 0,
+               "" if pr.returncode == 0
+               else (pr.stderr.strip().splitlines() or ["import failed"]
+                     )[-1][:200]
+               + "; hint: pip install jax (TPU: jax[tpu])")
+    except subprocess.TimeoutExpired:
+        report("import jax (subprocess)", False,
+               "import hung > 60 s even CPU-pinned — runtime plugin "
+               "registration is wedged")
 
     cfg = settings()
     print("config:")

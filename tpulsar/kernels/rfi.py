@@ -57,13 +57,22 @@ class RFIMask:
         return (self.cell_mask | self.bad_channels[None, :]
                 | self.bad_blocks[:, None])
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, qscale=None, qoff=None) -> None:
+        """qscale/qoff: the per-channel affine dequantization map of
+        the uint8 block the mask was derived from (value = q * scale
+        + off).  Persisted so a mask saved from a quantized run can be
+        re-applied to calibrated float32 data later — chan_fill is in
+        QUANTIZED units whenever they are present."""
         np.savez_compressed(
             path, block_len=self.block_len, dt=self.dt,
             cell_mask=self.cell_mask, bad_channels=self.bad_channels,
             bad_blocks=self.bad_blocks,
             chan_fill=(self.chan_fill if self.chan_fill is not None
-                       else np.zeros(0, np.float32)))
+                       else np.zeros(0, np.float32)),
+            qscale=(np.asarray(qscale, np.float32) if qscale is not None
+                    else np.zeros(0, np.float32)),
+            qoff=(np.asarray(qoff, np.float32) if qoff is not None
+                  else np.zeros(0, np.float32)))
 
     @classmethod
     def load(cls, path: str) -> "RFIMask":
@@ -74,6 +83,15 @@ class RFIMask:
         return cls(block_len=int(z["block_len"]), dt=float(z["dt"]),
                    cell_mask=z["cell_mask"], bad_channels=z["bad_channels"],
                    bad_blocks=z["bad_blocks"], chan_fill=fill)
+
+    @staticmethod
+    def load_quantization(path: str):
+        """(qscale, qoff) per-channel dequantization arrays saved with
+        the mask, or None if the mask came from a float32 run."""
+        z = np.load(path)
+        if "qscale" not in z.files or z["qscale"].size == 0:
+            return None
+        return z["qscale"], z["qoff"]
 
 
 @partial(jax.jit, static_argnames=("block_len", "chunk"))

@@ -110,7 +110,13 @@ void tpulsar_unpack4_q8(const uint8_t* in, uint8_t* out, size_t nspec,
     std::vector<uint8_t> lut(nchan * 16);
     for (size_t c = 0; c < nchan; ++c) {
         for (int x = 0; x < 16; ++x) {
-            const long r = lroundf(static_cast<float>(x) * a[c] + b[c]);
+            // rint (round-half-to-even in the default FP environment)
+            // matches the NumPy fallback's np.rint: lround's
+            // half-away-from-zero differed by 1 LSB at exact .5
+            // boundaries, making quantized blocks environment-
+            // dependent
+            const long r = static_cast<long>(
+                rintf(static_cast<float>(x) * a[c] + b[c]));
             lut[c * 16 + x] =
                 r < 0 ? 0 : (r > 255 ? 255 : static_cast<uint8_t>(r));
         }
