@@ -100,16 +100,71 @@ def build_scenarios() -> dict:
         hi_accel_numharm=2, topk_per_stage=16, max_cands_to_fold=2,
         fold_nbin=32, fold_npart=8, make_plots=False)
     out["wapp_multistep"] = (data4, freqs4, dt4, plan4, params4)
+
+    # --- rfi_rednoise: red noise + a zapped birdie + saturated
+    # channels, all interacting (rednoise/zapbirds/rfifind semantics,
+    # reference PALFA2_presto_search.py:493-499, 549-557) — the clean
+    # scenarios above cannot catch a whitening/zap/mask regression
+    # that only shows when they fight each other ------------------
+    rng = np.random.default_rng(909)
+    nchan5, T5, dt5 = 32, 1 << 15, 5e-4
+    freqs5 = np.linspace(1214.0, 1536.0, nchan5)
+    data5 = rng.standard_normal((nchan5, T5)).astype(np.float32)
+    # red noise: a common random-walk baseline (receiver gain wander),
+    # per-channel coupling factors
+    walk = np.cumsum(rng.standard_normal(T5)).astype(np.float32)
+    walk *= 2.0 / walk.std()
+    data5 += walk[None, :] * (0.5 + rng.random(nchan5)
+                              ).astype(np.float32)[:, None]
+    # birdie: constant-frequency tone in every channel (no dispersion
+    # -> max at DM 0, but strong enough to leak into low-DM trials if
+    # the zap fails)
+    f_bird = 25.0
+    tt = np.arange(T5, dtype=np.float64) * dt5
+    data5 += (1.0 * np.sin(2 * np.pi * f_bird * tt)
+              ).astype(np.float32)[None, :]
+    # the pulsar the search must still win back
+    _dispersed_pulses(data5, freqs5, dt5, period_s=0.11, dm=45.0,
+                      amp=1.2)
+    # a saturated channel block rfifind must remove
+    data5[10:13] += (rng.standard_normal((3, T5)) * 30.0
+                     ).astype(np.float32)
+    zap5 = np.array([[f_bird, 0.5]])
+    plan5 = [ddplan.DedispStep(lodm=20.0, dmstep=5.0, dms_per_pass=12,
+                               numpasses=1, numsub=16, downsamp=1)]
+    params5 = executor.SearchParams(
+        nsub=16, lo_accel_numharm=8, hi_accel_zmax=8,
+        hi_accel_numharm=4, topk_per_stage=16, max_cands_to_fold=2,
+        fold_nbin=32, fold_npart=8, make_plots=False)
+    out["rfi_rednoise"] = (data5, freqs5, dt5, plan5, params5, zap5,
+                           True)
     return out
+
+
+def _unpack(entry):
+    """Pad legacy 5-tuples to (data, freqs, dt, plan, params,
+    zaplist, apply_rfi)."""
+    if len(entry) == 5:
+        return entry + (None, False)
+    return entry
 
 
 def run_scenario(name: str):
     """-> list of candidate record dicts for the named scenario."""
     import jax.numpy as jnp
 
-    data, freqs, dt, plan, params = build_scenarios()[name]
+    data, freqs, dt, plan, params, zaplist, apply_rfi = _unpack(
+        build_scenarios()[name])
+    data = jnp.asarray(data)
+    if apply_rfi:
+        from tpulsar.kernels import rfi as rfi_k
+
+        mask = rfi_k.find_rfi_chan(data, dt, block_len=2048)
+        data = rfi_k.apply_mask_chan(
+            data, jnp.asarray(mask.full_mask()),
+            jnp.asarray(mask.chan_fill), mask.block_len)
     final, folded, sp, ntrials = executor.search_block(
-        jnp.asarray(data), np.asarray(freqs), dt, plan, params)
+        data, np.asarray(freqs), dt, plan, params, zaplist=zaplist)
     return [
         {"freq_hz": round(c.freq_hz, 6), "dm": round(c.dm, 2),
          "z": round(c.z, 2), "sigma": round(c.sigma, 2),

@@ -1,0 +1,150 @@
+"""Analytic parity tests (round-2 verdict missing #2).
+
+PRESTO itself is not available in this environment, so parity is
+pinned the analytic way:
+
+* the sigma calculus must reproduce an INDEPENDENT direct evaluation
+  of the same statistics (incomplete-gamma tail + trials correction +
+  Gaussian quantile) to float precision — a 1% sigma regression fails
+  loudly (reference: presto candidate_sigma, used throughout
+  PALFA2_presto_search.py's sifting);
+* injected tones with known (f, fdot, amplitude) must come back from
+  the spectral chain (whiten -> refine) with the analytically
+  expected coherent power and with frequencies at sub-bin accuracy.
+"""
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+from tpulsar.kernels import fourier as fr
+
+# ----------------------------------------------------------- sigma calculus
+
+
+def _sigma_direct(s: float, n: int, m: int) -> float:
+    """Plain-float64 reference implementation, valid only in regimes
+    with no under/overflow (the production code's log-space routes
+    exist for the regimes this cannot reach)."""
+    q = float(sps.gammaincc(n, s))              # single-trial p-value
+    p = q if m == 1 else -np.expm1(m * np.log1p(-q))
+    return float(-sps.ndtri(p))                 # norm.isf(p)
+
+
+@pytest.mark.parametrize("numharm", [1, 2, 4, 8, 16])
+@pytest.mark.parametrize("numindep", [1, 1000, 1 << 20])
+def test_sigma_matches_direct_formula(numharm, numindep):
+    """Across the regime where plain float64 works, the production
+    calculus must agree to 1e-6 relative — any change to the gamma
+    tail, the trials correction, or the quantile conversion fails."""
+    for s in np.linspace(numharm + 18.0, numharm + 60.0, 25):
+        got = float(fr.sigma_from_power(s, numharm, numindep=numindep))
+        want = _sigma_direct(s, numharm, numindep)
+        if want < 0.5:        # deep in the noise: not a candidate
+            continue
+        assert got == pytest.approx(want, rel=1e-6), (
+            f"s={s} n={numharm} M={numindep}: {got} vs {want}")
+
+
+def test_sigma_extreme_powers_stay_ordered():
+    """Very strong signals (where the direct formula underflows) must
+    keep strictly increasing sigma — underflow-induced ties were the
+    failure mode the log-space route exists for."""
+    powers = np.linspace(5_000.0, 50_000.0, 40)
+    sigmas = np.array([float(fr.sigma_from_power(p, 2, numindep=1 << 22))
+                       for p in powers])
+    assert np.all(np.isfinite(sigmas))
+    assert np.all(np.diff(sigmas) > 0)
+    # asymptotically sigma ~ sqrt(2 * logp-ish): check the scale is
+    # right to 5% against the n=1 closed form sigma ~ sqrt(2s)
+    approx = np.sqrt(2 * powers)
+    assert np.all(np.abs(sigmas / approx - 1.0) < 0.05)
+
+
+def test_sigma_trials_correction_scale():
+    """The trials correction must behave as log(M) in the tiny-p
+    regime: sigma(M) solves Q(sigma) = M * p exactly."""
+    s, n = 120.0, 4
+    logq = float(np.log(sps.gammaincc(n, s)))
+    for m in (10, 10_000, 1 << 30):
+        got = float(fr.sigma_from_power(s, n, numindep=m))
+        want = float(-sps.ndtri_exp(logq + np.log(m)))
+        assert got == pytest.approx(want, rel=1e-6)
+
+
+# ------------------------------------------------------- injected-tone chain
+
+
+N_T = 1 << 17
+DT = 1e-3
+T_S = N_T * DT
+
+
+def _tone_series(freqs_hz, amps, fdots=None, seed=7):
+    rng = np.random.default_rng(seed)
+    t = np.arange(N_T) * DT
+    x = rng.normal(0, 1.0, N_T)
+    fdots = fdots or [0.0] * len(freqs_hz)
+    for f, a, fd in zip(freqs_hz, amps, fdots):
+        x = x + a * np.cos(2 * np.pi * (f * t + 0.5 * fd * t * t)
+                           + 0.3)
+    return x.astype(np.float32)
+
+
+def test_injected_tones_power_and_frequency():
+    """Known-amplitude tones at non-integer bins: the whitened,
+    refined coherent power must match the analytic expectation
+    N*A^2/4 within the noise envelope, and the refined frequency must
+    land within a quarter of a Fourier bin (the 'half a refined bin'
+    demand of the round-2 verdict, with margin)."""
+    import jax.numpy as jnp
+
+    from tpulsar.search.refine import refine_peak
+
+    bins = np.array([917.37, 2411.81, 5320.24, 9993.55,
+                     17341.13, 26017.68, 33999.41, 41532.93])
+    freqs = bins / T_S
+    amp = 0.20
+    x = _tone_series(freqs, [amp] * len(bins))
+    spec = fr.complex_spectrum(jnp.asarray(x)[None, :])
+    powers, wpow = fr.whitened_powers(spec)
+    wspec = np.asarray(fr.scale_spectrum(spec, powers, wpow))[0]
+
+    p_expect = N_T * amp ** 2 / 4.0
+    rel_errs = []
+    for b in bins:
+        r, z, p = refine_peak(wspec, round(b), 0.0, numharm=1)
+        assert abs(r - b) < 0.25, f"bin {b}: refined to {r}"
+        assert abs(z) < 2.0
+        rel_errs.append(p / p_expect - 1.0)
+    # single-tone scatter is ~2/sqrt(p_expect) (~5.5%); the MEAN over
+    # 8 tones pins the whitening normalization to a few percent — a
+    # 5% normalization drift fails here, a 1% calculus drift fails in
+    # the direct-formula tests above
+    assert abs(float(np.mean(rel_errs))) < 0.05, rel_errs
+    assert float(np.max(np.abs(rel_errs))) < 0.25, rel_errs
+
+
+def test_injected_drifting_tone_recovers_fdot():
+    """A tone with a known frequency derivative must refine to the
+    analytic z = fdot * T^2 and keep its coherent power (the
+    accelerated-candidate analogue of the tone test; reference
+    accelsearch's (r, z) plane)."""
+    import jax.numpy as jnp
+
+    from tpulsar.search.refine import refine_peak
+
+    f0, fdot, amp = 2411.81 / T_S, 6.0 / T_S ** 2, 0.25
+    # z = fdot * T^2 = 6 bins of drift
+    x = _tone_series([f0], [amp], fdots=[fdot])
+    spec = fr.complex_spectrum(jnp.asarray(x)[None, :])
+    powers, wpow = fr.whitened_powers(spec)
+    wspec = np.asarray(fr.scale_spectrum(spec, powers, wpow))[0]
+
+    # mean frequency over the observation is f0 + fdot*T/2
+    r0 = round(f0 * T_S + 3.0)
+    r, z, p = refine_peak(wspec, r0, 6.0, numharm=1, max_dz=4.0)
+    assert abs(z - 6.0) < 1.0, z
+    assert abs(r - (f0 * T_S + 3.0)) < 0.5, r
+    p_expect = N_T * amp ** 2 / 4.0
+    assert p / p_expect > 0.6, (p, p_expect)
