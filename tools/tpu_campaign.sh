@@ -1,0 +1,105 @@
+#!/bin/bash
+# The on-chip measurement campaign, in chip-safe order (see
+# docs/architecture.md memory discipline: one runtime HBM OOM wedges
+# the chip for hours, so everything full-scale is AOT-compile-gated
+# and every step runs under a hard timeout).
+#
+# Run as soon as the chip is healthy — the watcher may fire it
+# automatically.  Everything appends to tpu_campaign.log; bench JSON
+# records land in bench_runs/.
+#
+#   1. subprocess health probe (no step runs on a wedged chip)
+#   2. tools/aot_check.py --accel   compile-only full-scale gate;
+#      also warms .jax_cache for every later step
+#   3. bench.py headline ladder (0.1 -> 0.5 -> 1.0, accel on)
+#   4. focused configs 1, 3, 4, then 5 (8-beam steady state)
+#   5. Pallas smoke with the captured error text (the round-3
+#      fix-or-retire decision needs the real lowering error)
+
+set -u
+cd "$(dirname "$0")/.."
+REPO=$(pwd)
+LOG="$REPO/tpu_campaign.log"
+OUT="$REPO/bench_runs"
+mkdir -p "$OUT"
+
+say() { echo "[campaign $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+say "=== TPU campaign start ==="
+
+# 1. health probe
+timeout 150 python -c "
+import tpulsar, json, sys
+r = tpulsar.probe_device_subprocess(timeout=120)
+print(json.dumps(r))
+sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
+" >> "$LOG" 2>&1
+if [ $? -ne 0 ]; then
+    say "ABORT: probe unhealthy"
+    exit 1
+fi
+say "probe healthy"
+
+# 2. AOT gate (compile-only; also the cache warmer)
+timeout 1500 python tools/aot_check.py --accel >> "$LOG" 2>&1
+rc=$?
+if [ $rc -ne 0 ]; then
+    say "ABORT: aot_check rc=$rc — full-scale programs must not run"
+    exit 2
+fi
+say "aot_check passed (full-scale programs compiled)"
+
+# 3. headline ladder bench (generous self-run budgets; the driver's
+#    own run later reuses the warmed cache)
+say "headline bench (ladder + full scale, accel on)"
+TPULSAR_BENCH_TOTAL_BUDGET=2400 TPULSAR_BENCH_DEADLINE=1500 \
+TPULSAR_BENCH_FULL_RESERVE=600 TPULSAR_BENCH_AOT=0 \
+timeout 2600 python bench.py > "$OUT/headline.json" 2>>"$LOG"
+say "headline: $(tail -c 600 "$OUT/headline.json")"
+
+# stop early if the chip wedged mid-campaign
+timeout 150 python -c "
+import tpulsar, sys
+r = tpulsar.probe_device_subprocess(timeout=120)
+sys.exit(0 if r.get('ok') else 1)
+" >> "$LOG" 2>&1 || { say "ABORT: chip unhealthy after headline"; exit 3; }
+
+# 4. focused configs
+for cfg in 1 4 3; do
+    say "focused config $cfg"
+    TPULSAR_BENCH_CONFIG=$cfg TPULSAR_BENCH_TOTAL_BUDGET=1500 \
+    TPULSAR_BENCH_DEADLINE=1200 \
+    timeout 1700 python bench.py > "$OUT/config$cfg.json" 2>>"$LOG"
+    say "config $cfg: $(tail -c 400 "$OUT/config$cfg.json")"
+    timeout 150 python -c "
+import tpulsar, sys
+r = tpulsar.probe_device_subprocess(timeout=120)
+sys.exit(0 if r.get('ok') else 1)
+" >> "$LOG" 2>&1 || { say "ABORT: chip unhealthy after config $cfg"; exit 4; }
+done
+
+say "focused config 5 (8-beam steady state)"
+TPULSAR_BENCH_CONFIG=5 TPULSAR_BENCH_TOTAL_BUDGET=3000 \
+TPULSAR_BENCH_DEADLINE=2700 TPULSAR_BENCH_FULL_RESERVE=900 \
+timeout 3200 python bench.py > "$OUT/config5.json" 2>>"$LOG"
+say "config 5: $(tail -c 400 "$OUT/config5.json")"
+
+# 5. Pallas diagnosis: run the smoke in a subprocess and capture the
+#    REAL error text (fix-or-retire decision input)
+say "pallas smoke diagnosis"
+timeout 400 python -c "
+import os, sys; sys.path.insert(0, '$REPO')
+from tpulsar.kernels import pallas_dd
+# force a REAL probe: the memo/disk-cache fast paths would return a
+# stale verdict with no error text, which is exactly what this step
+# must not do
+pallas_dd._SMOKE_OK = None
+try:
+    os.remove(pallas_dd._smoke_cache_path())
+except OSError:
+    pass
+ok = pallas_dd.smoke_test_ok()
+print('pallas smoke:', ok)
+print('detail:', pallas_dd.LAST_SMOKE_DETAIL)
+" >> "$LOG" 2>&1
+say "=== TPU campaign done ==="

@@ -131,6 +131,12 @@ def dedisperse_subbands_pallas(subbands, sub_shifts,
 _DISABLED_SIGS: dict[tuple, str] = {}
 _SMOKE_OK: bool | None = None
 
+#: the last smoke probe's outcome detail ("ok", or the captured
+#: subprocess stderr tail / timeout note) — the on-chip diagnosis
+#: campaign (tools/tpu_campaign.sh) reads this to act on the REAL
+#: lowering error instead of a bare False
+LAST_SMOKE_DETAIL: str | None = None
+
 #: PJRT platform names that are real TPU runtimes (the axon plugin
 #: reports "axon", not "tpu") — the single source every gate uses
 TPU_BACKENDS = ("tpu", "axon")
@@ -192,7 +198,7 @@ def smoke_test_ok(timeout: float = 300.0) -> bool:
     in that case skip the probe and rely on the per-signature
     try/except fallback (bench.py avoids this by probing from a parent
     that never touches jax)."""
-    global _SMOKE_OK
+    global _SMOKE_OK, LAST_SMOKE_DETAIL
     if _SMOKE_OK is not None:
         return _SMOKE_OK
     path = _smoke_cache_path()
@@ -229,6 +235,7 @@ def smoke_test_ok(timeout: float = 300.0) -> bool:
         ok = False
         detail = str(e)
     _SMOKE_OK = ok
+    LAST_SMOKE_DETAIL = detail or "ok"
     if ok:
         try:
             with open(path, "w") as fh:
