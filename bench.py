@@ -495,15 +495,30 @@ def run_aot_gate(timeout: float, accel: bool, scale: float,
     the chip this run: either a program failed to compile (likely
     over-budget — the exact failure mode that wedged the chip in
     round 2) or the gate itself hung/crashed, leaving the memory
-    question unanswered.  Compiles land in the shared
-    JAX_COMPILATION_CACHE_DIR, so the measured run re-pays nothing."""
+    question unanswered.
+
+    Headline runs gate with --fast (maximal-footprint programs only):
+    the gated compiles land in the shared JAX_COMPILATION_CACHE_DIR,
+    while the ~19 smaller skipped programs cold-compile INSIDE the
+    measured window.  That is a deliberate tradeoff: a full cold gate
+    risks timing out and aborting the whole run with no result,
+    whereas fast-gate compile time merely inflates the (explicitly
+    compile-inclusive) headline number — and tools/tpu_campaign.sh
+    runs the full gate first precisely so the driver's later run
+    finds a warm cache."""
     cmd = [sys.executable, os.path.join(_REPO, "tools", "aot_check.py"),
            "--scale", str(scale)]
     if config in (1, 3, 4):
         # focused configs compile their own exact program set
         cmd += ["--config", str(config)]
-    elif accel:
-        cmd.append("--accel")
+    else:
+        # --fast: gate the maximal-footprint programs only, so a
+        # cold-cache gate (~7 remote compiles, not ~26) cannot eat
+        # the measured run's deadline; tools/tpu_campaign.sh runs the
+        # FULL gate separately
+        cmd.append("--fast")
+        if accel:
+            cmd.append("--accel")
     t0 = time.time()
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,

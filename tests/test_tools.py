@@ -72,3 +72,23 @@ def test_aot_check_cli_smoke():
         capture_output=True, text=True, timeout=560, env=env)
     assert out.returncode == 0, out.stdout[-800:] + out.stderr[-400:]
     assert "all programs compiled" in out.stdout
+
+
+@pytest.mark.slow
+def test_aot_check_fast_mode():
+    """--fast (bench.py's headline pre-flight) gates the
+    maximal-footprint subset: the ds=1 block programs and exactly one
+    budget-capped sp/spectrum pair must be present, the ds>1 block
+    variants absent."""
+    import tpulsar
+
+    env = tpulsar.cpu_subprocess_env()
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "aot_check.py"),
+         "--scale", "0.02", "--fast"],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert out.returncode == 0, out.stdout[-800:] + out.stderr[-400:]
+    assert "all programs compiled" in out.stdout
+    assert "form_subbands ds=1" in out.stdout
+    assert "form_subbands ds=2" not in out.stdout
+    assert out.stdout.count("sp_boxcars") == 1

@@ -57,6 +57,20 @@ def main() -> int:
                          "instead of the headline survey-plan set — "
                          "the gate must compile exactly what will "
                          "execute")
+    ap.add_argument("--fast", action="store_true",
+                    help="gate only the MAXIMAL-footprint programs: "
+                         "the ds=1 step (whole-block shapes dominate "
+                         "every higher-downsamp variant of the same "
+                         "program) plus the largest budget-capped "
+                         "sp/spectrum chunk across steps.  The "
+                         "skipped ds>1 programs are the same code at "
+                         "strictly smaller block shapes and "
+                         "budget-capped chunk bytes, so an "
+                         "over-budget program cannot hide among "
+                         "them.  Used by bench.py's pre-flight so a "
+                         "cold-cache gate cannot eat the measured "
+                         "run's deadline (~7 compiles instead of "
+                         "~26)")
     args = ap.parse_args()
 
     import jax
@@ -198,16 +212,41 @@ def main() -> int:
           lambda d, m, f: rfi_k.apply_mask_chan(d, m, f, 2048),
           blk, S((nblocks, NCHAN), jnp.bool_), S((NCHAN,), jnp.float32))
 
-    # one representative pass per plan step
+    from tpulsar.search import executor as ex
+
+    # per-step geometry: (step, T_ds, ndms, pad1, pad2, nfft, chunk,
+    # chunk_bytes) — --fast gates only the maximal-footprint entries
+    geoms = []
     for step in plan:
         T_ds = nsamp // step.downsamp
         ppass = next(iter(step.passes()))
         ch_sh, sub_sh = dd.plan_pass_shifts(
             freqs, step.numsub, ppass.subdm, np.asarray(ppass.dms),
             TSAMP, step.downsamp)
-        pad1 = dd._pad_bucket(int(ch_sh.max(initial=0)))
-        pad2 = dd._pad_bucket(int(sub_sh.max(initial=0)))
-        ndms = sub_sh.shape[0]
+        nfft = ddplan.choose_n(T_ds)
+        # the executor's own chunk arithmetic (budget + even split),
+        # with run_hi_accel mirroring the measured run's accel setting
+        # — with the hi stage off it budgets a ~4/3 LARGER chunk, and
+        # the gate must compile that exact shape
+        chunk = ex.pass_chunk_size(
+            ndms=sub_sh.shape[0], nfft=nfft,
+            params=ex.SearchParams(run_hi_accel=args.accel))
+        geoms.append((step, T_ds, sub_sh.shape[0],
+                      dd._pad_bucket(int(ch_sh.max(initial=0))),
+                      dd._pad_bucket(int(sub_sh.max(initial=0))),
+                      nfft, chunk, chunk * T_ds))
+
+    if args.fast:
+        # ds=1 dominates every higher-downsamp variant of the block
+        # programs (same code, strictly larger shapes); the
+        # sp/spectrum chunk byte count is budget-capped per step, so
+        # gate its argmax
+        block_geoms = [g for g in geoms if g[0].downsamp == 1][:1]
+        sp_geoms = [max(geoms, key=lambda g: g[7])]
+    else:
+        block_geoms = sp_geoms = geoms
+
+    for step, T_ds, ndms, pad1, pad2, nfft, chunk, _ in block_geoms:
         print(f"step downsamp={step.downsamp} (T'={T_ds}, "
               f"ndms={ndms}):", flush=True)
         check(f"form_subbands ds={step.downsamp}",
@@ -219,14 +258,7 @@ def main() -> int:
               dd._dedisperse_subbands_scan(sb, sh, _p),
               S((step.numsub, T_ds), jnp.float32),
               S((ndms, step.numsub), jnp.int32))
-        nfft = ddplan.choose_n(T_ds)
-        from tpulsar.search import executor as ex
-        # the executor's own chunk arithmetic (budget + even split),
-        # with run_hi_accel mirroring the measured run's accel setting
-        # — with the hi stage off it budgets a ~4/3 LARGER chunk, and
-        # the gate must compile that exact shape
-        chunk = ex.pass_chunk_size(
-            ndms, nfft, ex.SearchParams(run_hi_accel=args.accel))
+    for step, T_ds, ndms, pad1, pad2, nfft, chunk, _ in sp_geoms:
         check(f"sp_boxcars ds={step.downsamp}",
               lambda s: sp_k.boxcar_search(sp_k.normalize_series(s)),
               S((chunk, T_ds), jnp.float32))
@@ -237,7 +269,6 @@ def main() -> int:
 
     if args.accel:
         from tpulsar.kernels import accel as ak
-        from tpulsar.search import executor as ex
         bank = ak.build_template_bank(50.0)
         nz = len(bank.zs)
         nfft = ddplan.choose_n(nsamp)
