@@ -55,3 +55,51 @@ def test_write_singlepulse_file(tmp_path):
     lines = path.read_text().splitlines()
     assert lines[0].startswith("# DM")
     assert "20.00" in lines[1] and "5000" in lines[1]
+
+
+def test_detrend_estimator_variants_agree_on_pulses():
+    """All three baseline estimators must find the same injected
+    pulses with SNRs within a few percent on clean data — the
+    alternatives exist to dodge the median sort's cost, not to change
+    the physics."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    ndms, T, dt = 4, 1 << 15, 1e-3
+    series = rng.standard_normal((ndms, T)).astype(np.float32)
+    # a slow baseline wander the detrend must remove
+    series += 0.5 * np.sin(np.arange(T) / 3000.0)[None, :]
+    spots = [5000, 17000, 29000]
+    for s in spots:
+        series[1, s:s + 4] += 6.0
+    dms = np.arange(ndms) * 10.0
+
+    found = {}
+    for est in ("median", "median_sub4", "clipped_mean"):
+        ev = sp.single_pulse_search(jnp.asarray(series), dms, dt,
+                                    estimator=est)
+        ev1 = ev[ev["dm"] == 10.0]
+        found[est] = {int(e["sample"]) // 32: float(e["sigma"])
+                      for e in ev1}
+    def _near(d, b):
+        """Bucket lookup with +-1 tolerance: a peak one sample before
+        a 32-sample bucket boundary can land in the neighbour."""
+        return next((d[k] for k in (b, b - 1, b + 1) if k in d), None)
+
+    for s in spots:
+        b = s // 32
+        sig_med = _near(found["median"], b)
+        assert sig_med is not None, (s, found["median"])
+        for est in ("median_sub4", "clipped_mean"):
+            sig = _near(found[est], b)
+            assert sig is not None, (est, s, found[est])
+            assert abs(sig - sig_med) / sig_med < 0.05, (est, s)
+
+
+def test_detrend_env_override(monkeypatch):
+    """TPULSAR_SP_DETREND beats the params value (the bench A/B knob)."""
+    monkeypatch.setenv("TPULSAR_SP_DETREND", "clipped_mean")
+    assert sp.detrend_estimator("median") == "clipped_mean"
+    monkeypatch.delenv("TPULSAR_SP_DETREND")
+    assert sp.detrend_estimator("median_sub4") == "median_sub4"
+    assert sp.detrend_estimator(None) == "median"

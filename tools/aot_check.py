@@ -153,8 +153,13 @@ def main() -> int:
               S((96, nsamp), jnp.float32),
               S((ndms, 96), jnp.int32))
         if args.config == 4:
+            # estimator resolved exactly as the measured run resolves
+            # it (TPULSAR_SP_DETREND is inherited by this subprocess)
+            # — a different estimator is a different static-arg
+            # program and must not reach the chip ungated
             check("sp_boxcars",
-                  lambda s: sp_k.boxcar_search(sp_k.normalize_series(s)),
+                  lambda s: sp_k.boxcar_search(sp_k.normalize_series(
+                      s, estimator=sp_k.detrend_estimator())),
                   S((ndms, nsamp), jnp.float32))
         if args.config == 3:
             from tpulsar.kernels import accel as ak
@@ -214,8 +219,8 @@ def main() -> int:
 
     from tpulsar.search import executor as ex
 
-    # per-step geometry: (step, T_ds, ndms, pad1, pad2, nfft, chunk,
-    # chunk_bytes) — --fast gates only the maximal-footprint entries
+    # per-step geometry: (step, T_ds, ndms, pad1, pad2, nfft, chunk)
+    # — --fast gates only the maximal-footprint entries
     geoms = []
     for step in plan:
         T_ds = nsamp // step.downsamp
@@ -234,19 +239,25 @@ def main() -> int:
         geoms.append((step, T_ds, sub_sh.shape[0],
                       dd._pad_bucket(int(ch_sh.max(initial=0))),
                       dd._pad_bucket(int(sub_sh.max(initial=0))),
-                      nfft, chunk, chunk * T_ds))
+                      nfft, chunk))
 
     if args.fast:
         # ds=1 dominates every higher-downsamp variant of the block
-        # programs (same code, strictly larger shapes); the
-        # sp/spectrum chunk byte count is budget-capped per step, so
-        # gate its argmax
+        # programs (same code, strictly larger shapes).  The
+        # sp/spectrum pair needs TWO argmaxes: sp_boxcars scales with
+        # chunk*T_ds but spectrum+whiten with chunk*nfft, and
+        # choose_n padding can make those maxima land on different
+        # steps — gate both (deduped) so neither program family can
+        # hide an ungated maximal footprint
         block_geoms = [g for g in geoms if g[0].downsamp == 1][:1]
-        sp_geoms = [max(geoms, key=lambda g: g[7])]
+        sp_geoms = list({id(g): g for g in (
+            max(geoms, key=lambda g: g[6] * g[1]),    # chunk*T_ds
+            max(geoms, key=lambda g: g[6] * g[5]),    # chunk*nfft
+        )}.values())
     else:
         block_geoms = sp_geoms = geoms
 
-    for step, T_ds, ndms, pad1, pad2, nfft, chunk, _ in block_geoms:
+    for step, T_ds, ndms, pad1, pad2, nfft, chunk in block_geoms:
         print(f"step downsamp={step.downsamp} (T'={T_ds}, "
               f"ndms={ndms}):", flush=True)
         check(f"form_subbands ds={step.downsamp}",
@@ -258,9 +269,12 @@ def main() -> int:
               dd._dedisperse_subbands_scan(sb, sh, _p),
               S((step.numsub, T_ds), jnp.float32),
               S((ndms, step.numsub), jnp.int32))
-    for step, T_ds, ndms, pad1, pad2, nfft, chunk, _ in sp_geoms:
+    for step, T_ds, ndms, pad1, pad2, nfft, chunk in sp_geoms:
+        # estimator resolved exactly as the measured run resolves it
+        # (TPULSAR_SP_DETREND inherited by this subprocess)
         check(f"sp_boxcars ds={step.downsamp}",
-              lambda s: sp_k.boxcar_search(sp_k.normalize_series(s)),
+              lambda s: sp_k.boxcar_search(sp_k.normalize_series(
+                  s, estimator=sp_k.detrend_estimator())),
               S((chunk, T_ds), jnp.float32))
         check(f"spectrum+whiten ds={step.downsamp}",
               lambda s, _n=nfft: fr.whitened_powers(

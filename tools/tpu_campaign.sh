@@ -84,6 +84,15 @@ TPULSAR_BENCH_DEADLINE=2700 TPULSAR_BENCH_FULL_RESERVE=900 \
 timeout 3200 python bench.py > "$OUT/config5.json" 2>>"$LOG"
 say "config 5: $(tail -c 400 "$OUT/config5.json")"
 
+# 4b. SP detrend A/B (config 4 again with the sort-free estimator:
+#     on CPU the exact-median sort is ~3.5x the whole boxcar ladder;
+#     this run decides whether the TPU default should change)
+say "focused config 4 A/B: clipped_mean detrend"
+TPULSAR_BENCH_CONFIG=4 TPULSAR_SP_DETREND=clipped_mean \
+TPULSAR_BENCH_TOTAL_BUDGET=1200 TPULSAR_BENCH_DEADLINE=900 \
+timeout 1400 python bench.py > "$OUT/config4_clipped.json" 2>>"$LOG"
+say "config 4 clipped: $(tail -c 400 "$OUT/config4_clipped.json")"
+
 # 5. Pallas diagnosis: run the smoke in a subprocess and capture the
 #    REAL error text (fix-or-retire decision input)
 say "pallas smoke diagnosis"

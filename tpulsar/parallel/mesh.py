@@ -137,6 +137,8 @@ class PassSpec:
     sp_widths: tuple[int, ...]
     sp_topk: int
     hi: bool                    # run the accelerated (zmax>0) search
+    sp_detrend: str = "median"  # SP baseline estimator (see
+    #                             kernels/singlepulse.normalize_series)
     hi_numharm: int = 8
     hi_seg: int = 0             # TemplateBank geometry (static)
     hi_step: int = 0
@@ -247,7 +249,7 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
         else:
             series = _dedisperse_subbands_scan(
                 subb, shifts, spec.dd_pad or subb.shape[-1])
-        norm = sp_k.normalize_series(series)
+        norm = sp_k.normalize_series(series, estimator=spec.sp_detrend)
         sp_snr, sp_idx = sp_k.boxcar_search(norm, spec.sp_widths,
                                             spec.sp_topk)
         cspec = fr.complex_spectrum(fr.pad_series(series, spec.nfft))
@@ -349,7 +351,8 @@ def seq_dist_search(mesh: Mesh, subbands, sub_shifts, dms, dt_ds: float,
 
     def sp_body(series_loc):
         ext = halo_extend(series_loc, sp_halo, axis_name, n_dev)
-        norm = sp_k.normalize_series(ext)
+        norm = sp_k.normalize_series(
+            ext, estimator=sp_k.detrend_estimator(params.sp_detrend))
         snr, idx = sp_k.boxcar_search(norm, tuple(params.sp_widths),
                                       sp_k.DEFAULT_TOPK)
         local = idx < chunk

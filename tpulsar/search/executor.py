@@ -63,6 +63,12 @@ class SearchParams:
     topk_per_stage: int = 32
     sp_threshold: float = 5.0       # singlepulse_threshold
     sp_widths: tuple[int, ...] = sp_k.DEFAULT_WIDTHS
+    sp_detrend: str = "median"      # SP baseline estimator: exact
+    #                                 "median" (PRESTO parity) |
+    #                                 "median_sub4" | "clipped_mean"
+    #                                 (see kernels/singlepulse.py;
+    #                                 TPULSAR_SP_DETREND overrides for
+    #                                 the on-chip A/B)
     sifting: sifting.SiftParams = dataclasses.field(
         default_factory=sifting.SiftParams)
     to_prepfold_sigma: float = 6.0  # :44
@@ -447,7 +453,8 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                         ev = sp_k.single_pulse_search(
                             series, dm_chunk, dt_ds,
                             threshold=params.sp_threshold,
-                            widths=params.sp_widths)
+                            widths=params.sp_widths,
+                            estimator=params.sp_detrend)
                         if len(ev):
                             sp_chunks.append(ev)
 
@@ -882,6 +889,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
         max_numharm=params.lo_accel_numharm,
         topk=params.topk_per_stage,
         sp_widths=tuple(params.sp_widths), sp_topk=sp_k.DEFAULT_TOPK,
+        sp_detrend=sp_k.detrend_estimator(params.sp_detrend),
         hi=hi_sharded, hi_numharm=params.hi_accel_numharm,
         hi_seg=bank.seg if hi_sharded else 0,
         hi_step=bank.step if hi_sharded else 0,
