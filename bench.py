@@ -641,8 +641,12 @@ def main() -> None:
                     "TPULSAR_BENCH_CPU_SCALE", "0.0833")),
                 "accel_stage": False,
                 "dm_trials": fb.get("dm_trials"),
+                "dm_trials_per_sec": fb.get("dm_trials_per_sec"),
                 "injected_pulsar_recovered":
                     fb.get("injected_pulsar_recovered"),
+                # per-stage breakdown so even a fallback record is
+                # decomposable (the .report contract)
+                "stage_s": fb.get("stage_s"),
             }
 
     try:
