@@ -10,6 +10,13 @@ from tpulsar.kernels import dedisperse as dd
 from tpulsar.parallel import dist_fft, mesh as pmesh
 
 
+def _evset(ev):
+    """(dm, sample, downfact) identity set for SP event comparison —
+    ONE definition for every sharded-vs-single equality test."""
+    return {(round(float(e["dm"]), 3), int(e["sample"]),
+             int(e["downfact"])) for e in ev}
+
+
 def test_make_mesh_shapes():
     m = pmesh.make_mesh(n_beam=2, n_dm=4)
     assert m.shape == {"beam": 2, "dm": 4}
@@ -199,11 +206,7 @@ def test_sharded_search_block_matches_single_device():
                         round(c.dm, 3))]
         assert c.sigma == pytest.approx(ref.sigma, rel=1e-3)
 
-    def evset(ev):
-        return {(round(float(e["dm"]), 3), int(e["sample"]),
-                 int(e["downfact"])) for e in ev}
-
-    assert evset(s_events) == evset(m_events)
+    assert _evset(s_events) == _evset(m_events)
 
 
 def test_seq_sharded_search_block_matches_dm_sharded():
@@ -253,11 +256,7 @@ def test_seq_sharded_search_block_matches_dm_sharded():
     assert keyset(dm_sharded[0]) == keyset(seq_sharded[0])
     assert dm_sharded[3] == seq_sharded[3] == 16
 
-    def evset(ev):
-        return {(round(float(e["dm"]), 3), int(e["sample"]),
-                 int(e["downfact"])) for e in ev}
-
-    assert evset(dm_sharded[2]) == evset(seq_sharded[2])
+    assert _evset(dm_sharded[2]) == _evset(seq_sharded[2])
 
 
 def test_sharded_hi_fallback_when_batch_gate_fails(monkeypatch):
@@ -419,3 +418,36 @@ def test_seq_dist_search_pass_finds_pulsar():
     # the mode self-reports in the degraded registry
     assert "seq_dist_spectral" in degraded.snapshot()
     assert len(sp) > 0
+
+
+def test_sharded_sp_detrend_estimator_consistency(monkeypatch):
+    """A non-default SP detrend estimator must produce the same
+    events on the sharded path as single-device (the estimator is
+    part of the sharded program's static spec)."""
+    from tpulsar.plan import ddplan
+    from tpulsar.search import executor
+
+    # the env knob would silently override the SearchParams value
+    # and make this test vacuous in campaign environments
+    monkeypatch.delenv("TPULSAR_SP_DETREND", raising=False)
+    n_dm = min(8, len(jax.devices()))
+    m = pmesh.make_mesh(n_beam=1, n_dm=n_dm,
+                        devices=jax.devices()[:n_dm])
+    rng = np.random.default_rng(11)
+    nchan, T, dt = 16, 1 << 13, 1e-3
+    freqs = np.linspace(1200.0, 1500.0, nchan)
+    data = rng.standard_normal((nchan, T)).astype(np.float32)
+    data[:, 3000:3004] += 5.0     # one bright pulse
+    plan = [ddplan.DedispStep(lodm=0.0, dmstep=10.0, dms_per_pass=8,
+                              numpasses=1, numsub=8, downsamp=1)]
+    params = executor.SearchParams(
+        nsub=8, lo_accel_numharm=4, run_hi_accel=False,
+        topk_per_stage=8, max_cands_to_fold=0, make_plots=False,
+        sp_detrend="clipped_mean")
+    single = executor.search_block(jnp.asarray(data), freqs, dt, plan,
+                                   params)
+    sharded = executor.search_block(jnp.asarray(data), freqs, dt, plan,
+                                    params, mesh=m)
+
+    assert len(single[2]) > 0
+    assert _evset(single[2]) == _evset(sharded[2])
