@@ -45,7 +45,8 @@ def test_stationary_tone_found_at_z0():
     vals, rbins, zvals = res[1]
     true_r = round(40.0 * T_s)
     best = np.argmax(vals)
-    assert abs(int(rbins[best]) - true_r) <= 1
+    # rbins are numbetween=2 half-bin indices (PRESTO ACCEL_DR=0.5)
+    assert abs(0.5 * int(rbins[best]) - true_r) <= 1
     assert abs(zvals[best]) <= accel.DZ
 
 
@@ -66,14 +67,14 @@ def test_drifting_tone_recovered_at_correct_z():
     # mean frequency over the obs: f0 + fdot*T/2 -> bin f0*T + z/2
     true_r = 40.0 * T_s + z_true / 2
     assert abs(zvals[best] - z_true) <= accel.DZ
-    assert abs(rbins[best] - true_r) <= 2
+    assert abs(0.5 * rbins[best] - true_r) <= 2
     # the z=0 response to the same signal is much weaker
     zi0 = list(bank.zs).index(0.0)
     plane = accel._correlate_segments(
         jnp.asarray(np.asarray(spec), np.complex64),
         jnp.asarray(bank.bank_fft), bank.seg, bank.step, bank.width)
     plane = np.asarray(plane)
-    r_idx = int(round(true_r))
+    r_idx = int(round(2 * true_r))     # half-bin plane index
     zi_best = int(np.argmin(np.abs(np.asarray(bank.zs) - z_true)))
     assert plane[zi_best, r_idx] > 2.0 * plane[zi0, r_idx]
 

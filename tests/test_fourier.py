@@ -56,9 +56,10 @@ def test_tone_detected_with_correct_bin_and_sigma():
     res, nbins = fr.periodicity_search(jnp.asarray(x), T_s, max_numharm=1,
                                        topk=8)
     vals, bins = res[1]
-    best_bin = bins[1, 0]
+    # bins are interbinned half-bin indices (dr=0.5)
+    best_bin = 0.5 * bins[1, 0]
     expect_bin = round(37.0 * T_s)
-    assert abs(int(best_bin) - expect_bin) <= 1
+    assert abs(best_bin - expect_bin) <= 1
     sig_signal = fr.sigma_from_power(vals[1, 0], 1)
     sig_noise = fr.sigma_from_power(vals[0, 0], 1)
     assert sig_signal > 8.0
@@ -86,11 +87,13 @@ def test_harmonic_summing_helps_narrow_pulses():
     x = (rng.standard_normal(T).astype(np.float32) + 1.2 * sig)[None]
     res, _ = fr.periodicity_search(jnp.asarray(x), T * dt, max_numharm=16,
                                    topk=8)
-    fund_bin = round(T * dt / period)
+    # bins are half-bin indices (interbinned grid): the fundamental
+    # sits at half-index 2 * T_s / period
+    fund_bin = round(2 * T * dt / period)
     # find the candidate at the fundamental in stage 1 and stage 16
     def power_at(stage):
         vals, bins = res[stage]
-        hit = np.abs(bins[0] - fund_bin) <= 1
+        hit = np.abs(bins[0] - fund_bin) <= 2
         return vals[0][hit].max() if hit.any() else 0.0
     s1 = fr.sigma_from_power(power_at(1), 1)
     s16 = fr.sigma_from_power(power_at(16), 16)

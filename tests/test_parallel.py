@@ -58,16 +58,19 @@ def test_sharded_search_matches_single_device():
 
     vals, bins = (np.asarray(x) for x in res[1])
     assert vals.shape == (1, ndms, 8)
-    true_bin = round(40.0 * T * dt)
-    # every DM trial (all identical here) must find the tone
-    assert np.all(bins[0, :, 0] == true_bin)
+    # bin indices are in half-bin units (interbinned detection
+    # grid); the 40 Hz tone sits at 327.68 bins, so the NEAREST
+    # half-bin (327.5, index 655) wins — finer than the old integer
+    # grid could express
+    true_half = round(2 * 40.0 * T * dt)
+    assert np.all(bins[0, :, 0] == true_half)
 
     # compare against the plain single-device path
     series = np.repeat(subb.sum(axis=0)[None, :], ndms, axis=0)
     res1, _ = fr.periodicity_search(jnp.asarray(series), T * dt,
                                     max_numharm=2, topk=8)
     vals1, bins1 = res1[1]
-    assert bins1[0, 0] == true_bin
+    assert bins1[0, 0] == true_half
     np.testing.assert_allclose(vals[0, 0, 0], vals1[0, 0], rtol=1e-3)
 
 

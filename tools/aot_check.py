@@ -276,9 +276,22 @@ def main() -> int:
               lambda s: sp_k.boxcar_search(sp_k.normalize_series(
                   s, estimator=sp_k.detrend_estimator())),
               S((chunk, T_ds), jnp.float32))
-        check(f"spectrum+whiten ds={step.downsamp}",
-              lambda s, _n=nfft: fr.whitened_powers(
-                  fr.complex_spectrum(fr.pad_series(s, _n))),
+        # the full lo-stage program the executor runs: whiten ->
+        # scale -> interbin (half-bin grid) -> all harmonic stages,
+        # with stage list and topk from SearchParams (a hardcoded
+        # copy would drift from a configured run)
+        _sp = ex.SearchParams(run_hi_accel=args.accel)
+
+        def _lo_stages(s, _n=nfft):
+            spec = fr.complex_spectrum(fr.pad_series(s, _n))
+            powers, wpow = fr.whitened_powers(spec)
+            wspec = fr.scale_spectrum(spec, powers, wpow)
+            return fr.all_stage_candidates(
+                fr.interbin_powers(wspec),
+                tuple(fr.harmonic_stages(_sp.lo_accel_numharm)),
+                _sp.topk_per_stage)
+
+        check(f"spectrum+lo-stages ds={step.downsamp}", _lo_stages,
               S((chunk, T_ds), jnp.float32))
 
     if args.accel:

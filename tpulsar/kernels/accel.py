@@ -39,25 +39,30 @@ def z_grid(zmax: float) -> np.ndarray:
     return np.arange(-n, n + 1) * DZ
 
 
-def gen_z_response(z: float, width: int) -> np.ndarray:
+def gen_z_response(z: float, width: int,
+                   numbetween: int = 1) -> np.ndarray:
     """Complex frequency-domain response of a unit-amplitude signal
-    drifting linearly by `z` bins, sampled at integer bin offsets.
+    drifting linearly by `z` bins, sampled every 1/numbetween bins
+    (PRESTO's gen_z_response with NUMBETWEEN; numbetween=2 is the
+    half-bin template the ACCEL_DR=0.5 search correlates with).
 
     Computed numerically: DFT of the discrete chirp
-    exp(2*pi*i*(c*n/N + z*n^2/(2*N^2))) for a long N, then the bins
-    around the centroid are extracted.  The result depends only on z
-    (in bins), not on N, for N >> width.
+    exp(2*pi*i*(c*n/N + z*n^2/(2*N^2))) for a long N, zero-padded by
+    numbetween for sub-bin resolution, then the samples around the
+    centroid are extracted.  The result depends only on z (in bins),
+    not on N, for N >> width.  Returns numbetween*width samples
+    spanning `width` bins.
     """
     N = 1 << 14
     c = N // 4
     n = np.arange(N)
     phase = 2 * np.pi * (c * n / N + 0.5 * z * (n / N) ** 2)
     chirp = np.exp(1j * phase)
-    spec = np.fft.fft(chirp) / N
+    spec = np.fft.fft(chirp, numbetween * N) / N
     # The response is centered on the *mean* frequency c + z/2.
-    center = int(round(c + z / 2))
-    lo = center - width // 2
-    resp = spec[lo:lo + width]
+    center = int(round(numbetween * (c + z / 2)))
+    lo = center - (numbetween * width) // 2
+    resp = spec[lo:lo + numbetween * width]
     return np.asarray(resp, dtype=np.complex64)
 
 
@@ -78,27 +83,57 @@ class TemplateBank:
 
 
 def build_template_bank(zmax: float, seg: int = 1 << 13) -> TemplateBank:
+    """Half-bin (numbetween=2) matched-filter bank: templates sampled
+    every 0.5 bins over `width` bins, stored as length-2*seg FFTs.
+    The data spectrum is zero-interleaved to the same half-bin grid
+    before correlation, so the correlation output IS the matched
+    filter evaluated at ACCEL_DR=0.5 — the analytic template carries
+    the sub-bin interpolation (band-limited interpolation of the
+    correlation SAMPLES cannot recover a half-bin tone: its adjacent
+    responses alternate sign and interpolate to ~zero between)."""
     zs = z_grid(zmax)
     width = template_width(zmax)
     if seg <= 2 * width:
         raise ValueError("segment too short for template width")
-    bank = np.zeros((len(zs), seg), dtype=np.complex64)
+    bank = np.zeros((len(zs), 2 * seg), dtype=np.complex64)
     for i, z in enumerate(zs):
-        resp = gen_z_response(float(z), width)
-        # matched filter: correlate with conj response
-        bank[i, :width] = np.conj(resp)[::-1]
+        resp = gen_z_response(float(z), width, numbetween=2)
+        # matched filter: correlate with conj response (2*width taps)
+        bank[i, :2 * width] = np.conj(resp)[::-1]
     bank_fft = np.fft.fft(bank, axis=-1).astype(np.complex64)
     return TemplateBank(zs=tuple(float(z) for z in zs), width=width,
                         seg=seg, step=seg - width, bank_fft=bank_fft)
 
 
+def _interleave_zeros(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., n) -> (..., 2n) with x at even indices, zeros at odd —
+    the data half of the numbetween=2 correlation (the half-bin
+    resolution comes from the analytically half-bin-sampled
+    templates, never from interpolating data or correlation
+    samples)."""
+    z = jnp.zeros_like(x)
+    return jnp.stack([x, z], axis=-1).reshape(*x.shape[:-1],
+                                              2 * x.shape[-1])
+
+
 @partial(jax.jit, static_argnames=("seg", "step", "width"))
 def _correlate_segments(spectrum: jnp.ndarray, bank_fft: jnp.ndarray,
                         seg: int, step: int, width: int) -> jnp.ndarray:
-    """Overlap-save correlation of one complex spectrum with the bank.
+    """Overlap-save matched filter of one complex spectrum against
+    the half-bin template bank.
 
-    spectrum: (nbins,) complex64.  Returns (nz, nvalid) float32 powers,
-    nvalid = nsegs * step, plane bin r corresponds to spectrum bin r.
+    spectrum: (nbins,) complex64.  Returns (nz, 2*nbins) float32
+    powers on the numbetween=2 HALF-BIN grid: plane index 2r
+    corresponds to spectrum bin r (PRESTO searches the accel plane at
+    ACCEL_DR = 0.5; a dr=1 grid loses up to ~64% of a half-bin
+    signal's power to scalloping).
+
+    Derivation of the valid region: with the bank row holding the
+    reversed conjugate 2*width-tap half-grid template, the cyclic
+    convolution out[n] = sum_m S2[n - 2*width + 1 + m] conj(resp2[m])
+    is linear for n >= 2*width - 1; a tone at data bin b (S2 index
+    2b) aligned with the template center tap (index width) peaks at
+    n = 2b + width - 1, i.e. valid index 2(b - s0) - width.
     """
     nbins = spectrum.shape[0]
     nsegs = max(1, -(-nbins // step))  # ceil: cover every spectrum bin
@@ -109,20 +144,18 @@ def _correlate_segments(spectrum: jnp.ndarray, bank_fft: jnp.ndarray,
 
     def one_seg(s0):
         seg_data = jax.lax.dynamic_slice(padded, (s0,), (seg,))
-        f = jnp.fft.fft(seg_data)
+        f = jnp.fft.fft(_interleave_zeros(seg_data))
         corr = jnp.fft.ifft(f[None, :] * bank_fft, axis=-1)
-        # Circular==linear convolution only for output n >= width-1;
-        # there, out[n] = sum_m S[s0 + (n-width+1) + m] conj(resp[m]).
-        return jnp.abs(corr[:, width - 1: width - 1 + step]) ** 2
+        return jnp.abs(corr[:, 2 * width - 1:
+                            2 * width - 1 + 2 * step]) ** 2
 
-    planes = jax.lax.map(one_seg, starts)          # (nsegs, nz, step)
+    planes = jax.lax.map(one_seg, starts)          # (nsegs, nz, 2*step)
     plane = jnp.transpose(planes, (1, 0, 2)).reshape(
-        bank_fft.shape[0], nsegs * step)
-    # A signal at spectrum bin b peaks at template center m=width//2,
-    # i.e. at raw plane index b - width//2.  Left-pad so that plane
-    # index == spectrum bin (required for harmonic-sum alignment),
-    # then truncate to the true spectrum length.
-    plane = jnp.pad(plane, ((0, 0), (width // 2, 0)))[:, :nbins]
+        bank_fft.shape[0], nsegs * 2 * step)
+    # Valid index of data bin b is 2*b - width: left-pad width so
+    # plane index == 2*spectrum bin (harmonic-sum alignment), then
+    # truncate to the half-bin spectrum length.
+    plane = jnp.pad(plane, ((0, 0), (width, 0)))[:, :2 * nbins]
     return plane
 
 
@@ -200,7 +233,9 @@ def plane_dm_chunk(nbins: int, nz: int, max_chunk: int = 32) -> int:
     and the complex64 overlap-save intermediates (segs + their FFT at
     ~16 B/bin plus the (Z_CHUNK, seg) product/ifft at ~≈65 B/bin with
     batch padding slop)."""
-    per_dm = nz * nbins * 4 * 3 + nbins * 96
+    # x2 throughout: the numbetween=2 plane is 2*nbins wide and the
+    # interpolated iffts are 2*seg long
+    per_dm = nz * nbins * 4 * 3 * 2 + nbins * 192
     return max(1, min(max_chunk, PLANE_HBM_BUDGET // max(per_dm, 1)))
 
 
@@ -227,30 +262,32 @@ def _correlate_block(specs: jnp.ndarray, bank_fft: jnp.ndarray,
     nd, nbins = specs.shape
     nsegs = max(1, -(-nbins // step))
     padded = jnp.pad(specs, ((0, 0), (0, nsegs * step + seg - nbins)))
-    # (nd, nsegs, seg) strided segment gather, then one big rank-2 FFT.
+    # (nd, nsegs, seg) strided segment gather, zero-interleaved to
+    # the half-bin grid (numbetween=2 — the bank's templates are
+    # half-bin sampled), then one big rank-2 FFT.
     idx = jnp.arange(nsegs)[:, None] * step + jnp.arange(seg)[None, :]
-    segs = padded[:, idx]                            # (nd, nsegs, seg)
-    f = jnp.fft.fft(_pad_rows(segs.reshape(nd * nsegs, seg),
+    segs = _interleave_zeros(padded[:, idx])       # (nd, nsegs, 2*seg)
+    f = jnp.fft.fft(_pad_rows(segs.reshape(nd * nsegs, 2 * seg),
                               FFT_BATCH_PAD), axis=-1)
-    f = f[: nd * nsegs].reshape(nd, nsegs, seg)
+    f = f[: nd * nsegs].reshape(nd, nsegs, 2 * seg)
 
     planes = []
     for z0 in range(0, nz, Z_CHUNK):
         zc = min(Z_CHUNK, nz - z0)
         prod = f[:, :, None, :] * bank_fft[z0: z0 + zc][None, None]
         corr = jnp.fft.ifft(
-            _pad_rows(prod.reshape(nd * nsegs * zc, seg),
+            _pad_rows(prod.reshape(nd * nsegs * zc, 2 * seg),
                       FFT_BATCH_PAD), axis=-1)[: nd * nsegs * zc]
-        corr = corr.reshape(nd, nsegs, zc, seg)
-        # Circular==linear convolution only for output n >= width-1.
-        pw = jnp.abs(corr[..., width - 1: width - 1 + step]) ** 2
-        # (nd, zc, nsegs*step)
+        corr = corr.reshape(nd, nsegs, zc, 2 * seg)
+        # linear-valid region and alignment: see _correlate_segments
+        pw = jnp.abs(corr[..., 2 * width - 1:
+                          2 * width - 1 + 2 * step]) ** 2
+        # (nd, zc, nsegs*2*step)
         planes.append(jnp.transpose(pw, (0, 2, 1, 3)).reshape(
-            nd, zc, nsegs * step))
+            nd, zc, nsegs * 2 * step))
     plane = jnp.concatenate(planes, axis=1)          # (nd, nz, nvalid)
-    # A signal at spectrum bin b peaks at raw plane index b - width//2;
-    # left-pad so plane index == spectrum bin, truncate to nbins.
-    return jnp.pad(plane, ((0, 0), (0, 0), (width // 2, 0)))[:, :, :nbins]
+    return jnp.pad(plane, ((0, 0), (0, 0),
+                           (width, 0)))[:, :, :2 * nbins]
 
 
 @partial(jax.jit, static_argnames=("seg", "step", "width", "nz",

@@ -58,10 +58,13 @@ class SearchStepSpec:
 
 def _local_search(subbands, sub_shifts, keep_mask, spec: SearchStepSpec):
     """Per-device body: dedisperse local DM chunk -> rfft -> whiten ->
-    harmonic top-k.  Returns dict of stage -> (vals, bins)."""
+    interbin -> harmonic top-k.  Returns dict of stage -> (vals,
+    bins); bins are in HALF-BIN units (the production dr=0.5
+    detection grid — fourier.interbin_powers)."""
     from tpulsar.kernels.dedisperse import _dedisperse_subbands_scan
     from tpulsar.kernels.fourier import (blockmax_topk, harmonic_stages,
-                                         harmonic_sum, whiten_powers)
+                                         harmonic_sum, interbin_powers,
+                                         scale_spectrum, whiten_powers)
 
     pad = spec.dd_pad or subbands.shape[-1]
     series = _dedisperse_subbands_scan(subbands, sub_shifts, pad)
@@ -72,15 +75,17 @@ def _local_search(subbands, sub_shifts, keep_mask, spec: SearchStepSpec):
         series = jnp.pad(series, ((0, 0), (0, nfft - T)))
     else:
         series = series[:, :nfft]
-    powers = jnp.abs(jnp.fft.rfft(series, axis=-1)) ** 2
+    cspec = jnp.fft.rfft(series, axis=-1)
+    powers = jnp.abs(cspec) ** 2
     powers = powers.at[..., 0].set(0.0)
     powers = powers * keep_mask
-    powers = whiten_powers(powers, spec.whiten_edges)
-    powers = powers * keep_mask
+    wpow = whiten_powers(powers, spec.whiten_edges)
+    wpow = wpow * keep_mask
+    p2 = interbin_powers(scale_spectrum(cspec, powers, wpow))
 
     out = {}
     for h in harmonic_stages(spec.max_numharm):
-        summed = harmonic_sum(powers, h)
+        summed = harmonic_sum(p2, h)
         # same hierarchical top-k as the single-device stage_candidates
         out[h] = blockmax_topk(summed, spec.topk)
     return out
@@ -254,9 +259,14 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
                                             spec.sp_topk)
         cspec = fr.complex_spectrum(fr.pad_series(series, spec.nfft))
         powers, wpow = fr.whitened_powers(cspec, keep)
+        # half-bin detection grid (interbinning, PRESTO ACCEL_DR=0.5)
+        # — identical to the single-device path; bin indices are in
+        # half-bin units and the host applies bin_scale=0.5
+        wspec = fr.scale_spectrum(cspec, powers, wpow)
+        p2 = fr.interbin_powers(wspec)
         lo_vals, lo_bins = [], []
         for h in fr.harmonic_stages(spec.max_numharm):
-            v, b = fr.stage_candidates(wpow, h, spec.topk)
+            v, b = fr.stage_candidates(p2, h, spec.topk)
             lo_vals.append(v)
             lo_bins.append(b)
 
@@ -270,7 +280,6 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
             "sp_idx": g(sp_idx, 1),
         }
         if spec.hi:
-            wspec = fr.scale_spectrum(cspec, powers, wpow)
             hv, hr, hz = ak._accel_block_topk(
                 wspec, bank, spec.hi_seg, spec.hi_step, spec.hi_width,
                 spec.hi_nz, spec.hi_numharm, spec.topk)

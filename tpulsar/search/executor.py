@@ -320,8 +320,14 @@ def _budget_dm_chunk(nfft: int, hi: bool, budget: int) -> int:
     spectral HBM budget: series (f32, nfft) + padded copy (f32, nfft)
     + complex spectrum (c64, ~nfft/2 bins = 4*nfft bytes) + powers and
     whitening scale (2x f32, ~nfft/2 = 2*nfft each) + the scaled
-    spectrum for the hi stage (c64, ~nfft/2 = 4*nfft)."""
-    per_trial = (4 + 4 + 4 + 2 + 2 + (4 if hi else 0)) * nfft
+    spectrum (c64, ~nfft/2 = 4*nfft — ALWAYS built now: both stages
+    consume it) + the interbinned half-bin grid and its largest
+    harmonic-sum intermediate (2x f32, ~nfft bins = 4*nfft each).
+    `hi` keeps a modest surcharge for the accel stage's top-k
+    bookkeeping riding alongside (the big accel planes have their own
+    budget, accel.plane_dm_chunk)."""
+    per_trial = (4 + 4 + 4 + 2 + 2 + 4 + 4 + 4
+                 + (2 if hi else 0)) * nfft
     return max(4, int(budget // per_trial))
 
 
@@ -462,34 +468,37 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                         nbins = nfft // 2 + 1
                         keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
                             if zaplist is not None else None
-                        # One rfft + one whitening estimate per chunk,
-                        # shared by the lo (powers) and hi (complex
-                        # spectrum) stages.
+                        # One rfft + one whitening estimate per chunk;
+                        # the whitened COMPLEX spectrum is shared by
+                        # the lo stage (interbinned powers) and the hi
+                        # stage (correlation input).  Zapped bins have
+                        # wpow==0 so they vanish from both.
                         spec = fr.complex_spectrum(
                             fr.pad_series(series, nfft))
                         powers, wpow = fr.whitened_powers(
                             spec,
                             jnp.asarray(keep) if keep is not None else None)
+                        wspec = fr.scale_spectrum(spec, powers, wpow)
+                        del spec, powers, wpow
                     with timers.timing("lo-accelsearch"):
+                        # half-bin detection grid (PRESTO ACCEL_DR=0.5
+                        # via interbinning) — bin indices are in
+                        # half-bin units, hence bin_scale=0.5
                         res = fr.all_stage_candidates(
-                            wpow,
+                            fr.interbin_powers(wspec),
                             tuple(fr.harmonic_stages(
                                 params.lo_accel_numharm)),
                             params.topk_per_stage)
                         all_cands.extend(sifting.make_candidates(
                             res, dm_chunk, T_s, _lo_sigma_fn(nbins),
-                            sigma_min=params.sifting.sigma_threshold))
+                            sigma_min=params.sifting.sigma_threshold,
+                            bin_scale=0.5))
 
                     if params.run_hi_accel and params.hi_accel_zmax > 0:
                         with timers.timing("hi-accelsearch"):
-                            # Whitening scale from the already-computed
-                            # powers; zapped bins have wpow==0 so they
-                            # vanish from the correlation input too.
-                            wspec = fr.scale_spectrum(spec, powers, wpow)
                             all_cands.extend(_hi_accel_pass(
                                 wspec, dm_chunk, T_s, params))
-                            del wspec
-                    del spec, powers, wpow
+                    del wspec
             del subb
             if checkpoint_dir:
                 _save_pass_checkpoint(
@@ -797,12 +806,14 @@ def _hi_accel_pass(wspec, dm_chunk, T_s, params: SearchParams
         topk=params.topk_per_stage)
 
     # z~0 rows are the lo search's job (z_min_abs); sub-threshold rows
-    # never become Python objects (sigma_min pre-filter).
+    # never become Python objects (sigma_min pre-filter).  The
+    # correlation plane is numbetween=2 interpolated: r indices are
+    # half-bin units (bin_scale).
     return sifting.make_candidates(
         res, dm_chunk, T_s,
         _hi_sigma_fn(wspec.shape[-1], len(bank.zs)),
         sigma_min=params.sifting.sigma_threshold,
-        z_min_abs=accel_k.DZ / 2)
+        z_min_abs=accel_k.DZ / 2, bin_scale=0.5)
 
 
 _BANK_CACHE: dict[int, accel_k.TemplateBank] = {}
@@ -947,11 +958,12 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
             hi_rbins[sl] = np.asarray(out["hi_rbins"])
             hi_zidx[sl] = np.asarray(out["hi_zidx"])
 
+    # both stages search the numbetween=2 half-bin grid (bin_scale)
     lo_res = {h: (lo_vals[si, :ndms], lo_bins[si, :ndms])
               for si, h in enumerate(stages_lo)}
     cands = sifting.make_candidates(
         lo_res, dms, T_s, _lo_sigma_fn(nbins),
-        sigma_min=params.sifting.sigma_threshold)
+        sigma_min=params.sifting.sigma_threshold, bin_scale=0.5)
     if hi_sharded:
         zs = np.asarray(bank.zs)
         hi_res = {h: (hi_vals[:ndms, si], hi_rbins[:ndms, si],
@@ -960,7 +972,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
         cands.extend(sifting.make_candidates(
             hi_res, dms, T_s, _hi_sigma_fn(nbins, nz),
             sigma_min=params.sifting.sigma_threshold,
-            z_min_abs=accel_k.DZ / 2))
+            z_min_abs=accel_k.DZ / 2, bin_scale=0.5))
     elif hi:
         # Batched-FFT gate failed: run the hi stage through the
         # single-device route (accel_search_batch -> its own proven

@@ -60,12 +60,18 @@ class SiftParams:
 
 def make_candidates(stage_results: dict, dms: np.ndarray, T_s: float,
                     sigma_fn, sigma_min: float = 0.0,
-                    z_min_abs: float | None = None) -> list[Candidate]:
+                    z_min_abs: float | None = None,
+                    bin_scale: float = 1.0) -> list[Candidate]:
     """Flatten per-stage top-k device output into Candidate objects.
 
     stage_results: {numharm: (powers[ndms, k], bins[ndms, k])} for the
     zero-accel search, or {numharm: (powers, bins, zvals)} for the
     accelerated search.  sigma_fn(power, numharm) -> sigma.
+
+    bin_scale: multiplier mapping device bin indices to fundamental
+    Fourier bins r — 0.5 when the stage searched the interbinned
+    half-bin grid (fourier.interbin_powers / the numbetween=2 accel
+    plane, PRESTO's ACCEL_DR).
 
     sigma_min: per-pass pre-filter — candidates below it never become
     Python objects.  The survey plan emits ~topk x 5 stages x 1272
@@ -82,11 +88,14 @@ def make_candidates(stage_results: dict, dms: np.ndarray, T_s: float,
         powers, bins = np.asarray(res[0]), np.asarray(res[1])
         zvals = np.asarray(res[2]) if len(res) > 2 else None
         sig = np.asarray(sigma_fn(powers, numharm))
-        keep = (bins >= 1) & (powers > 0) & (sig >= sigma_min)
+        # r cutoff in FUNDAMENTAL bins (r >= 1), independent of the
+        # device grid's resolution
+        keep = (bins * bin_scale >= 1 - 1e-9) & (powers > 0) \
+            & (sig >= sigma_min)
         if zvals is not None and z_min_abs is not None:
             keep &= np.abs(zvals) >= z_min_abs
         for di, j in np.argwhere(keep):
-            r = float(bins[di, j])
+            r = float(bins[di, j]) * bin_scale
             f = r / T_s
             cands.append(Candidate(
                 r=r, z=0.0 if zvals is None else float(zvals[di, j]),
