@@ -204,3 +204,28 @@ def test_half_bin_drifting_tone_found_by_accel_plane():
     assert plane[zi0, 805] > 0.7 * p_expect, plane[zi0, 800:810]
     # the dr=1 grid alone (even indices) would have seen much less
     assert max(plane[zi0, 804], plane[zi0, 806]) < 0.75 * plane[zi0, 805]
+
+
+def test_interbin_noise_statistics_are_prestos():
+    """Interbinning's known normalization quirk, pinned deliberately:
+    for unit-mean-power Gaussian noise the half-bin samples have mean
+    (pi^2/16)*2 ~ 1.234 (adjacent bins are independent, so the
+    difference has twice the power) while integer bins stay at 1.0.
+    PRESTO's interbinning has exactly the same property and uses the
+    powers as-is — 'fixing' the odd-bin mean to 1 would BREAK parity
+    and under-report half-bin candidates relative to PRESTO.  The
+    6-sigma sifting threshold absorbs the ~23% odd-bin noise
+    inflation (the pure_noise golden stays empty)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(42)
+    N = 1 << 17
+    x = rng.standard_normal(N).astype(np.float32)
+    spec = fr.complex_spectrum(jnp.asarray(x)[None, :])
+    powers, wpow = fr.whitened_powers(spec)
+    p2 = np.asarray(fr.interbin_powers(
+        fr.scale_spectrum(spec, powers, wpow)))[0]
+    even = p2[2:-2:2]        # skip DC/edge
+    odd = p2[3:-2:2]
+    assert abs(float(even.mean()) - 1.0) < 0.03, even.mean()
+    assert abs(float(odd.mean()) - np.pi ** 2 / 8) < 0.04, odd.mean()
