@@ -1,0 +1,43 @@
+#!/bin/bash
+# Shared rc-3 resume loop around tools/aot_check.py.
+#
+#   aot_gate_loop.sh LOGFILE DEADLINE [extra aot_check args...]
+#
+# Runs the compile-only gate with an internal between-compiles
+# --deadline so it is never SIGTERM-killed mid-compile (killing the
+# PJRT client during an active remote compile wedges the axon runtime
+# like a runtime OOM — docs/architecture.md memory discipline), and
+# loops on rc 3 while each attempt still shrinks the deferred set
+# (every attempt resumes from the persistent compilation cache).
+# Output streams to LOGFILE live.  The 7200 s outer timeout is only a
+# catastrophic backstop, far above any observed single compile.
+#
+# Exit: 0 = all programs compiled; 2 = deferral stopped converging;
+# otherwise aot_check's own nonzero rc (compile failure or crash).
+set -u
+cd "$(dirname "$0")/.."
+LOG="$1"; DEADLINE="$2"; shift 2
+
+aot_rc=3
+prev_deferred=-1
+while [ "$aot_rc" -eq 3 ]; do
+    tmp=$(mktemp /tmp/aot_gate.XXXXXX)
+    timeout 7200 python tools/aot_check.py --deadline "$DEADLINE" "$@" \
+        2>&1 | tee -a "$LOG" > "$tmp"
+    aot_rc=${PIPESTATUS[0]}
+    deferred=$(grep -c "\[defer\]" "$tmp" || true)
+    rm -f "$tmp"
+    if [ "$aot_rc" -eq 3 ]; then
+        # not strictly shrinking (equal OR grown, e.g. timing jitter
+        # around the deadline boundary) = no progress
+        if [ "$prev_deferred" -ge 0 ] && [ "$deferred" -ge "$prev_deferred" ]; then
+            echo "aot gate stopped converging ($deferred still deferred)" \
+                | tee -a "$LOG"
+            exit 2
+        fi
+        prev_deferred=$deferred
+        echo "aot gate deferred $deferred programs; resuming from cache" \
+            | tee -a "$LOG"
+    fi
+done
+exit "$aot_rc"

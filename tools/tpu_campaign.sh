@@ -40,11 +40,19 @@ if [ $? -ne 0 ]; then
 fi
 say "probe healthy"
 
-# 2. AOT gate (compile-only; also the cache warmer)
-timeout 1500 python tools/aot_check.py --accel >> "$LOG" 2>&1
-rc=$?
-if [ $rc -ne 0 ]; then
-    say "ABORT: aot_check rc=$rc — full-scale programs must not run"
+# 2. AOT gate (compile-only; also the cache warmer).  NEVER
+# SIGTERM-kill this mid-compile: killing the PJRT client during an
+# active remote compile wedged the chip on 2026-07-31 (01:25 rc=124
+# kill -> probe hung at 01:29) exactly like a runtime OOM.  Instead
+# the tool takes an internal --deadline checked BETWEEN compiles and
+# exits rc 3 cleanly; we loop, resuming from the persistent cache.
+# The outer timeout is only a catastrophic backstop sized far above
+# any observed single compile (accel: >7 min each on this 1-core
+# host).
+bash tools/aot_gate_loop.sh "$LOG" 1800 --accel > /dev/null
+aot_rc=$?
+if [ $aot_rc -ne 0 ]; then
+    say "ABORT: aot gate rc=$aot_rc (2=stopped converging, else compile failure/crash) — full-scale programs must not run"
     exit 2
 fi
 say "aot_check passed (full-scale programs compiled)"

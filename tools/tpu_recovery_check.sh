@@ -49,8 +49,11 @@ from tpulsar.kernels.accel import _batch_path_usable
 print('accel batch smoke:', _batch_path_usable())" || true
 
 echo "==== 3. AOT compile-only, full scale ===="
-timeout 580 python tools/aot_check.py --scale 1.0 --accel \
-    || { echo "FAILED: aot_check"; exit 1; }
+# Shared rc-3 resume loop: never SIGTERM-kills the gate mid-compile
+# (that wedges the chip like a runtime OOM — docs/architecture.md);
+# each attempt resumes from the persistent compilation cache.
+bash tools/aot_gate_loop.sh /dev/stdout 480 --scale 1.0 --accel \
+    || { echo "FAILED: aot_check rc=$?"; exit 1; }
 
 echo "==== 4. focused benches ===="
 TPULSAR_BENCH_CONFIG=1 TPULSAR_BENCH_TOTAL_BUDGET=600 \

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Wedge-recovery watcher: probe the chip every PERIOD seconds (hang-
+# proof subprocess probe) and fire tools/tpu_campaign.sh the moment it
+# answers.  A wedged axon runtime recovers on its own after an
+# unpredictable number of hours, and the measurement campaign must be
+# the FIRST thing that touches the healthy chip — not an interactive
+# experiment that could wedge it again (docs/architecture.md, memory
+# discipline).
+#
+# Usage: nohup bash tools/tpu_watch.sh [period_s] & (default 600)
+
+set -u
+cd "$(dirname "$0")/.."
+PERIOD=${1:-600}
+LOG="$(pwd)/tpu_watch.log"
+
+echo "[watch $(date +%H:%M:%S)] start, period ${PERIOD}s" >> "$LOG"
+while true; do
+    if timeout 180 python -c "
+import tpulsar, sys
+r = tpulsar.probe_device_subprocess(timeout=150)
+sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
+" >> "$LOG" 2>&1; then
+        echo "[watch $(date +%H:%M:%S)] chip healthy -> campaign" >> "$LOG"
+        bash tools/tpu_campaign.sh >> "$LOG" 2>&1
+        rc=$?
+        echo "[watch $(date +%H:%M:%S)] campaign finished rc=$rc" >> "$LOG"
+        # only disarm on a completed campaign — an abort (e.g. the
+        # chip re-wedged before the campaign's own probe) must re-arm
+        # the watcher, which is the whole point of running one
+        [ $rc -eq 0 ] && exit 0
+    fi
+    echo "[watch $(date +%H:%M:%S)] still wedged" >> "$LOG"
+    sleep "$PERIOD"
+done
