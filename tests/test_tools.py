@@ -75,6 +75,49 @@ def test_aot_check_cli_smoke():
 
 
 @pytest.mark.slow
+def test_aot_check_deadline_defers_cleanly_and_resumes(tmp_path):
+    """--deadline is checked BETWEEN compiles: a mid-run expiry
+    compiles a prefix ([ok]), defers the tail ([defer], rc 3, never
+    killed mid-compile — a SIGTERM during an active remote compile
+    wedges the axon runtime, docs/architecture.md), and a re-run
+    against the same cache resumes the partially-warmed set to rc 0.
+
+    Determinism: an ISOLATED cold cache dir makes the full ~27-program
+    set take far longer than the deadline slack (defer guaranteed),
+    while calibrating the deadline to this host's import time leaves
+    room for the first compiles ([ok] guaranteed)."""
+    import time as _time
+
+    import tpulsar
+
+    env = dict(tpulsar.cpu_subprocess_env())
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "cache")
+
+    t0 = _time.monotonic()
+    subprocess.run([sys.executable, "-c", "import jax"],
+                   capture_output=True, timeout=120, env=env)
+    import_s = _time.monotonic() - t0
+
+    first = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "aot_check.py"),
+         "--scale", "0.02", "--deadline", str(import_s + 6.0)],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert first.returncode == 3, first.stdout[-800:] + first.stderr[-400:]
+    assert "[ok]" in first.stdout          # a prefix compiled...
+    assert "[defer]" in first.stdout       # ...the tail deferred
+    assert "deferred past deadline" in first.stdout
+    assert "[FAIL]" not in first.stdout
+
+    resumed = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "aot_check.py"),
+         "--scale", "0.02"],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert resumed.returncode == 0, (resumed.stdout[-800:]
+                                     + resumed.stderr[-400:])
+    assert "all programs compiled" in resumed.stdout
+
+
+@pytest.mark.slow
 def test_aot_check_fast_mode():
     """--fast (bench.py's headline pre-flight) gates the
     maximal-footprint subset: the ds=1 block programs and exactly one
