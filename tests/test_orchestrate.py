@@ -556,3 +556,183 @@ def test_exhausted_archive_backs_off_requests(tracker, tmp_path):
         d.run()
     nreq = tracker.count("requests")
     assert nreq <= 3, f"{nreq} restore requests fired after exhaustion"
+
+
+# ---------------------------------------------------------------- Moab
+
+
+def _moab_showq_xml(jobs):
+    """showq --xml reply with [(option, JobID, JobName, State)] rows."""
+    buckets: dict[str, list[str]] = {"active": [], "eligible": [],
+                                     "blocked": []}
+    for option, qid, name, state in jobs:
+        buckets[option].append(
+            f'<job JobID="{qid}" JobName="{name}" State="{state}"/>')
+    queues = "".join(
+        f'<queue option="{opt}">{"".join(rows)}</queue>'
+        for opt, rows in buckets.items())
+    return f"<Data>{queues}</Data>"
+
+
+class _MoabFake:
+    """Scriptable msub/showq/canceljob runner with a call log."""
+
+    def __init__(self):
+        self.calls: list[list[str]] = []
+        self.msub_replies: list[tuple[str, str]] = [("12345\n", "")]
+        self.showq_jobs: list = []
+        self.showq_comm_err = False
+        self.showq_comm_err_n = 0      # next N showq calls comm-err
+
+    def __call__(self, cmd, **kw):
+        self.calls.append(list(cmd))
+
+        class R:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+
+        r = R()
+        if cmd[0] == "msub":
+            out, err = (self.msub_replies.pop(0)
+                        if self.msub_replies else ("", ""))
+            r.stdout, r.stderr = out, err
+        elif cmd[0] == "showq":
+            if self.showq_comm_err_n > 0:
+                self.showq_comm_err_n -= 1
+                r.stderr = "ERROR: lost communication error with server"
+            elif self.showq_comm_err:
+                r.stderr = "ERROR: lost communication error with server"
+            else:
+                r.stdout = _moab_showq_xml(self.showq_jobs)
+        return r
+
+    def n(self, prog: str) -> int:
+        return sum(1 for c in self.calls if c[0] == prog)
+
+
+def _moab(fake, tmp_path, **kw):
+    from tpulsar.orchestrate.queue_managers.moab import MoabManager
+    kw.setdefault("state_file", str(tmp_path / "moab.json"))
+    kw.setdefault("retry_wait_s", 0.0)
+    kw.setdefault("sleeper", lambda s: None)
+    return MoabManager(script="job.sh", runner=fake, **kw)
+
+
+def test_moab_submit_walltime_and_registry(tmp_path):
+    """Walltime comes from input size x hours/GB (reference
+    moab.py:72-79), and error detection survives a daemon restart."""
+    fake = _MoabFake()
+    datafile = tmp_path / "beam.fits"
+    datafile.write_bytes(b"x" * (2 ** 30 // 10))      # 0.1 GB
+    outdir = tmp_path / "out"
+    qm = _moab(fake, tmp_path, walltime_per_gb=50.0)
+    qid = qm.submit([str(datafile)], str(outdir), job_id=3)
+    assert qid == "12345"
+    msub = next(c for c in fake.calls if c[0] == "msub")
+    assert any("walltime=5:00:00" in a for a in msub)
+    assert any("DATAFILES=" in a for a in msub)
+    (outdir / "job3.stderr").write_text("boom\n")
+    qm2 = _moab(_MoabFake(), tmp_path)
+    assert qm2.had_errors(qid)
+    assert "boom" in qm2.get_errors(qid)
+
+
+def test_moab_lost_msub_reply_recovered_by_job_name(tmp_path):
+    """A communication error on msub must NOT resubmit (double-running
+    the beam): the submission is recovered by its -N job name from
+    showq (reference moab.py:94-139)."""
+    fake = _MoabFake()
+    fake.msub_replies = [("", "moab communication error (timeout)")]
+    fake.showq_jobs = [("eligible", "777", "tpulsar9", "Idle")]
+    qm = _moab(fake, tmp_path)
+    qid = qm.submit([], str(tmp_path / "out"), job_id=9)
+    assert qid == "777"
+    assert fake.n("msub") == 1            # never resubmitted
+
+
+def test_moab_comm_error_blocks_submission_and_assumes_alive(tmp_path):
+    """While the scheduler is unreachable, status() reports sentinel
+    counts that block can_submit(), and running jobs are assumed alive
+    (reference moab.py:160-174,282-283)."""
+    fake = _MoabFake()
+    fake.showq_jobs = [("active", "55", "tpulsar1", "Running")]
+    qm = _moab(fake, tmp_path, showq_ttl_s=0.0)
+    assert qm.is_running("55")
+    fake.showq_comm_err = True
+    assert qm.status() == (9999, 9999)
+    assert not qm.can_submit()
+    assert qm.is_running("55")            # stale snapshot: still alive
+    assert qm.is_running("does-not-exist")  # COMMERR: assume alive
+
+
+def test_moab_showq_ttl_cache(tmp_path):
+    """Polls within the TTL share one showq snapshot (reference
+    moab.py:365-393)."""
+    fake = _MoabFake()
+    fake.showq_jobs = [("active", "55", "tpulsar1", "Running"),
+                       ("blocked", "56", "tpulsar2", "Hold")]
+    now = [0.0]
+    qm = _moab(fake, tmp_path, showq_ttl_s=300.0, clock=lambda: now[0])
+    assert qm.status() == (1, 1)
+    for _ in range(5):
+        qm.status()
+        qm.is_running("55")
+    assert fake.n("showq") == 1
+    now[0] = 301.0
+    qm.status()
+    assert fake.n("showq") == 2
+
+
+def test_moab_delete_verifies_departure(tmp_path):
+    """delete() re-polls past the cache: True only once the job left
+    the queue (reference moab.py:229-256)."""
+    fake = _MoabFake()
+    fake.showq_jobs = [("active", "55", "tpulsar1", "Running")]
+    qm = _moab(fake, tmp_path, showq_ttl_s=300.0)
+    qm.status()                           # warm the cache
+    assert not qm.delete("55")            # still listed: not gone
+    fake.showq_jobs = []
+    assert qm.delete("55")                # departed
+    assert fake.n("canceljob") == 2
+
+
+def test_moab_recovery_succeeds_on_last_attempt(tmp_path):
+    """A recovery that only lands on the final retry must still be
+    honored (an off-by-one here re-raises fatal and double-runs the
+    beam on the next rotate)."""
+    fake = _MoabFake()
+    fake.msub_replies = [("", "moab communication error (timeout)")]
+    fake.showq_comm_err_n = 2
+    fake.showq_jobs = [("eligible", "888", "tpulsar4", "Idle")]
+    qm = _moab(fake, tmp_path, comm_retry_limit=3)
+    assert qm.submit([], str(tmp_path / "out"), job_id=4) == "888"
+    assert fake.n("msub") == 1
+
+
+def test_moab_lost_reply_definitively_absent_is_nonfatal(tmp_path):
+    """If showq answers and the job name is absent, the lost msub
+    never landed: retrying the submission later cannot double-run the
+    beam, so the error is non-fatal (not daemon-fatal)."""
+    from tpulsar.orchestrate.queue_managers import (
+        QueueManagerNonFatalError)
+    fake = _MoabFake()
+    fake.msub_replies = [("", "moab communication error (timeout)")]
+    fake.showq_jobs = []                  # definitive: not in queue
+    qm = _moab(fake, tmp_path)
+    with pytest.raises(QueueManagerNonFatalError):
+        qm.submit([], str(tmp_path / "out"), job_id=5)
+    assert fake.n("msub") == 1
+
+
+def test_moab_recovery_ignores_dying_previous_attempt(tmp_path):
+    """Job names are deterministic per job_id, so recovery must not
+    latch onto a Canceling/Completed remnant of a previous attempt."""
+    from tpulsar.orchestrate.queue_managers import (
+        QueueManagerNonFatalError)
+    fake = _MoabFake()
+    fake.msub_replies = [("", "moab communication error (timeout)")]
+    fake.showq_jobs = [("active", "600", "tpulsar6", "Canceling")]
+    qm = _moab(fake, tmp_path)
+    with pytest.raises(QueueManagerNonFatalError):
+        qm.submit([], str(tmp_path / "out"), job_id=6)

@@ -115,14 +115,14 @@ def _queue_manager_kwargs(cfg) -> dict:
                      cfg.processing.base_working_directory, ".localq")}
         if cfg.jobpooler.submit_script:
             qm_kw["script"] = cfg.jobpooler.submit_script
-    elif cfg.jobpooler.queue_manager in ("slurm", "pbs"):
+    elif cfg.jobpooler.queue_manager in ("slurm", "pbs", "moab"):
         qm_kw = {"script": cfg.jobpooler.submit_script,
                  "queue_name": cfg.jobpooler.queue_name,
                  "max_jobs_running": cfg.jobpooler.max_jobs_running,
                  "max_jobs_queued": cfg.jobpooler.max_jobs_queued,
                  "state_file": os.path.join(
                      state_dir, f"{cfg.jobpooler.queue_manager}.json")}
-        if cfg.jobpooler.queue_manager == "slurm":
+        if cfg.jobpooler.queue_manager in ("slurm", "moab"):
             qm_kw["walltime_per_gb"] = cfg.jobpooler.walltime_per_gb
     elif cfg.jobpooler.queue_manager == "tpu_slice":
         hosts = [h.strip() for h in cfg.jobpooler.tpu_hosts.split(",")

@@ -81,7 +81,7 @@ class ProcessingConfig:
 
 @dataclasses.dataclass
 class JobPoolerConfig:
-    queue_manager: str = "local"           # local | slurm | pbs | tpu_slice
+    queue_manager: str = "local"     # local | slurm | pbs | moab | tpu_slice
     max_jobs_running: int = 2
     max_jobs_queued: int = 1
     max_attempts: int = 2
@@ -206,7 +206,7 @@ class TpulsarConfig:
         if self.jobpooler.max_attempts < 1:
             problems.append("jobpooler.max_attempts must be >= 1")
         if self.jobpooler.queue_manager not in (
-                "local", "slurm", "pbs", "tpu_slice"):
+                "local", "slurm", "pbs", "moab", "tpu_slice"):
             problems.append(
                 f"jobpooler.queue_manager unknown: "
                 f"{self.jobpooler.queue_manager!r}")
@@ -215,7 +215,7 @@ class TpulsarConfig:
             problems.append(
                 "jobpooler.queue_manager='tpu_slice' requires "
                 "jobpooler.tpu_hosts (comma-separated host list)")
-        if (self.jobpooler.queue_manager in ("slurm", "pbs")
+        if (self.jobpooler.queue_manager in ("slurm", "pbs", "moab")
                 and not self.jobpooler.submit_script):
             problems.append(
                 f"jobpooler.queue_manager="

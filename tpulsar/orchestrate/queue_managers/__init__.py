@@ -5,8 +5,8 @@ implement (lib/python/queue_managers/generic_interface.py:7-99) and a
 3-level error taxonomy that drives the job pool's recovery decisions
 (lib/python/queue_managers/__init__.py:4-27).  Both are preserved
 here; backends are: an in-process LocalProcessManager (testing +
-single-node), Slurm and PBS CLI backends, and a TPUSliceManager that
-fans beam jobs out to TPU hosts.
+single-node), Slurm, PBS and Moab CLI backends, and a TPUSliceManager
+that fans beam jobs out to TPU hosts.
 """
 
 from __future__ import annotations
@@ -77,6 +77,35 @@ class SubmitRegistry:
         os.replace(tmp, self.path)
 
 
+class CLIQueueBackend:
+    """Shared behavior of the CLI-driven backends (slurm/pbs/moab):
+    walltime provisioned from input size with the hours-per-GB
+    heuristic (reference moab.py:14,72-79) and stderr-file error
+    detection through the restart-safe SubmitRegistry (reference
+    pbs.py:209-230).  Subclasses set ``walltime_per_gb`` (if they
+    provision walltime) and ``self._stderr``."""
+
+    walltime_per_gb: float = 50.0
+
+    def _walltime(self, datafiles: list[str]) -> str:
+        gb = sum(os.path.getsize(f) for f in datafiles
+                 if os.path.exists(f)) / 2 ** 30
+        hours = max(1, int(self.walltime_per_gb * gb + 0.5))
+        return f"{hours}:00:00"
+
+    def had_errors(self, queue_id: str) -> bool:
+        errpath = self._stderr.get(queue_id, "errpath")
+        return bool(errpath and os.path.exists(errpath)
+                    and os.path.getsize(errpath) > 0)
+
+    def get_errors(self, queue_id: str) -> str:
+        errpath = self._stderr.get(queue_id, "errpath")
+        if errpath and os.path.exists(errpath):
+            with open(errpath, errors="replace") as fh:
+                return fh.read()
+        return ""
+
+
 class QueueManagerFatalError(Exception):
     """The queue system itself is broken: stop the daemon."""
 
@@ -133,6 +162,9 @@ def get_queue_manager(name: str, **kw) -> PipelineQueueManager:
     if name == "pbs":
         from tpulsar.orchestrate.queue_managers.pbs import PBSManager
         return PBSManager(**kw)
+    if name == "moab":
+        from tpulsar.orchestrate.queue_managers.moab import MoabManager
+        return MoabManager(**kw)
     if name == "tpu_slice":
         from tpulsar.orchestrate.queue_managers.tpu_slice import (
             TPUSliceManager)

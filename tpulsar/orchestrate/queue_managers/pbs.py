@@ -15,13 +15,14 @@ import re
 import subprocess
 
 from tpulsar.orchestrate.queue_managers import (
+    CLIQueueBackend,
     QueueManagerJobFatalError,
     QueueManagerNonFatalError,
     SubmitRegistry,
 )
 
 
-class PBSManager:
+class PBSManager(CLIQueueBackend):
     def __init__(self, script: str, queue_name: str = "",
                  max_jobs_running: int = 50, max_jobs_queued: int = 1,
                  job_basename: str = "tpulsar", ppn: int = 1,
@@ -100,14 +101,4 @@ class PBSManager:
                 queued += 1
         return queued, running
 
-    def had_errors(self, queue_id: str) -> bool:
-        errpath = self._stderr.get(queue_id, "errpath")
-        return bool(errpath and os.path.exists(errpath)
-                    and os.path.getsize(errpath) > 0)
-
-    def get_errors(self, queue_id: str) -> str:
-        errpath = self._stderr.get(queue_id, "errpath")
-        if errpath and os.path.exists(errpath):
-            with open(errpath, errors="replace") as fh:
-                return fh.read()
-        return ""
+    # had_errors / get_errors come from CLIQueueBackend

@@ -14,13 +14,14 @@ import os
 import subprocess
 
 from tpulsar.orchestrate.queue_managers import (
+    CLIQueueBackend,
     QueueManagerJobFatalError,
     QueueManagerNonFatalError,
     SubmitRegistry,
 )
 
 
-class SlurmManager:
+class SlurmManager(CLIQueueBackend):
     def __init__(self, script: str, queue_name: str = "",
                  max_jobs_running: int = 50, max_jobs_queued: int = 1,
                  walltime_per_gb: float = 50.0,
@@ -35,12 +36,6 @@ class SlurmManager:
         self.job_basename = job_basename
         self._run = runner           # injectable for hermetic tests
         self._stderr = SubmitRegistry(state_file)
-
-    def _walltime(self, datafiles: list[str]) -> str:
-        gb = sum(os.path.getsize(f) for f in datafiles
-                 if os.path.exists(f)) / 2 ** 30
-        hours = max(1, int(self.walltime_per_gb * gb + 0.5))
-        return f"{hours}:00:00"
 
     def submit(self, datafiles: list[str], outdir: str, job_id: int) -> str:
         os.makedirs(outdir, exist_ok=True)
@@ -104,14 +99,4 @@ class SlurmManager:
                 queued += 1
         return queued, running
 
-    def had_errors(self, queue_id: str) -> bool:
-        errpath = self._stderr.get(queue_id, "errpath")
-        return bool(errpath and os.path.exists(errpath)
-                    and os.path.getsize(errpath) > 0)
-
-    def get_errors(self, queue_id: str) -> str:
-        errpath = self._stderr.get(queue_id, "errpath")
-        if errpath and os.path.exists(errpath):
-            with open(errpath, errors="replace") as fh:
-                return fh.read()
-        return ""
+    # had_errors / get_errors / _walltime come from CLIQueueBackend
