@@ -23,6 +23,15 @@ LOG="$REPO/tpu_campaign.log"
 OUT="$REPO/bench_runs"
 mkdir -p "$OUT"
 
+# one campaign at a time: two concurrent campaigns (watcher + manual)
+# would contend for the single chip and corrupt both measurements
+exec 9> "$REPO/.campaign.lock"
+if ! flock -n 9; then
+    echo "[campaign] another campaign holds $REPO/.campaign.lock; exiting" \
+        | tee -a "$LOG"
+    exit 5
+fi
+
 say() { echo "[campaign $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 
 say "=== TPU campaign start ==="
