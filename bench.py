@@ -42,10 +42,16 @@ Environment knobs:
                           before it could print anything)
   TPULSAR_BENCH_CPU_FALLBACK   "0" to skip the reduced-scale CPU run
                           when the TPU is unhealthy (default on)
-  TPULSAR_BENCH_AOT_BUDGET     AOT-gate time cap, s (default 600): the
-                          campaign's quick-datapoint step raises it and
-                          loops on aot_gate_deferred records, each rerun
-                          resuming compiles from the persistent cache
+  TPULSAR_BENCH_AOT_BUDGET     internal AOT-gate time cap, s (default
+                          600).  The campaign no longer leans on this:
+                          its quick-datapoint step now runs the full
+                          tools/aot_gate_loop.sh first and starts
+                          bench with TPULSAR_BENCH_AOT=0
+  TPULSAR_BENCH_STALL     seconds without a stage heartbeat (or a new
+                          bench_partial pass record) before the
+                          measured child is declared hung and killed
+                          early (default 1200, floor 300); the hard
+                          deadline still applies regardless
   TPULSAR_BENCH_AOT       "0" to skip the mandatory compile-only AOT
                           memory gate (tools/aot_check.py) that runs
                           between the health probe and any full-scale
@@ -72,6 +78,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -277,6 +284,18 @@ def run_measured() -> None:
     """The measured search (runs inside the deadline-guarded child).
     Prints progress to stderr, appends per-pass records to
     bench_partial.jsonl, and prints the result JSON to stdout."""
+    # The parent's kill sequence leads with SIGTERM + grace: convert
+    # it into SystemExit so the stack unwinds and the device runtime
+    # tears its session down instead of dying mid-RPC (the default
+    # disposition is as abrupt as SIGKILL).  A child hung inside a C
+    # call won't run this until the call returns — that case still
+    # ends with the parent's SIGKILL.
+    import signal
+
+    def _on_sigterm(signum, frame):
+        raise SystemExit("SIGTERM: parent deadline/stall")
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     cfg_raw = os.environ.get("TPULSAR_BENCH_CONFIG", "").strip()
     if cfg_raw:
         try:
@@ -449,12 +468,37 @@ def run_child(deadline: float, extra_env: dict | None = None
               ) -> tuple[str, dict | None]:
     """Run the measured search in a subprocess under `deadline`.
     Returns (status, result): ("ok", json) on success, ("timeout",
-    None) if killed at the deadline, ("crash", None) on nonzero exit
-    or unparseable output — the distinction matters for the evidence
-    record (a 10 s ImportError is not a deadline overrun)."""
+    None) if killed at the deadline, ("stall", None) if killed early
+    because no stage heartbeat arrived for TPULSAR_BENCH_STALL
+    seconds (hung dispatch), ("crash", None) on nonzero exit or
+    unparseable output — the distinction matters for the evidence
+    record (a 10 s ImportError is not a deadline overrun, and a
+    stall kill is not a deadline kill)."""
     env = dict(os.environ)
     if extra_env:
         env.update(extra_env)
+    # Always stage-trace the measured child: when a pass blocks inside
+    # a remote device dispatch, the per-pass progress callback never
+    # fires, and the trace lines on stderr are the only record of
+    # WHICH stage the deadline kill interrupted.
+    env.setdefault("TPULSAR_STAGE_TRACE", "1")
+    # Stage heartbeat: lets this parent tell a *stalled* child (hung
+    # remote dispatch) from a slow but progressing one.  Killing a
+    # progressing child mid-dispatch wedges the chip for hours (it
+    # did at 04:14 on 2026-07-31), so elapsed time alone must never
+    # trigger the kill before the hard deadline.
+    env.setdefault(
+        "TPULSAR_STAGE_HEARTBEAT",
+        os.path.join(tempfile.gettempdir(), f"tpulsar_hb_{os.getpid()}"))
+    # Monitor the path the CHILD will actually beat (setdefault keeps
+    # a pre-existing env value — monitoring our own default then would
+    # see a permanently missing heartbeat and false-stall-kill a
+    # healthy run).
+    hb_path = env["TPULSAR_STAGE_HEARTBEAT"]
+    try:
+        os.remove(hb_path)
+    except OSError:
+        pass
     # Truncate the partial-evidence file BEFORE the child spawns: the
     # child only truncates it after `import jax` completes, so a child
     # killed while importing (the sick-runtime hang) would otherwise
@@ -470,16 +514,61 @@ def run_child(deadline: float, extra_env: dict | None = None
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--measured"],
         env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
-    try:
-        out, _ = proc.communicate(timeout=deadline)
-    except subprocess.TimeoutExpired:
-        _log(f"measured run exceeded deadline {deadline:.0f} s — killing")
-        proc.kill()
+
+    # Supervise: poll instead of one blocking communicate().  Kill
+    # early on a genuine STALL (no stage heartbeat for STALL_S — a
+    # hung dispatch never heartbeats again, waiting out the full
+    # deadline just delays recovery), and at the hard deadline
+    # regardless.  Kill sequence is SIGTERM + grace, then SIGKILL:
+    # the runtime gets a chance to tear the device session down
+    # cleanly before the hard kill that wedges the chip.
+    # Stall threshold: heartbeats land only at stage begin/end and at
+    # pass boundaries (bench_partial records), so one long scope — a
+    # whole-phase fold/sift, or an in-line compile after the begin
+    # beat — is silent for its full duration.  The floor keeps a
+    # mis-set env from killing through ordinary scope silence; in-line
+    # CPU compiles of the lo-stage program have taken ~10 min on this
+    # 1-core host, hence the 1200 s default.
+    stall_s = max(300.0, float(os.environ.get("TPULSAR_BENCH_STALL",
+                                              "1200")))
+    t_start = time.time()
+
+    def _hb_age() -> float:
+        ages = []
+        for p in (hb_path, PARTIAL_PATH):
+            try:
+                ages.append(time.time() - os.path.getmtime(p))
+            except OSError:
+                pass
+        return min(ages) if ages else time.time() - t_start
+
+    reason = None
+    while True:
         try:
-            proc.communicate(timeout=10)
+            out, _ = proc.communicate(timeout=15)
+            break
         except subprocess.TimeoutExpired:
-            pass
-        return "timeout", None
+            elapsed = time.time() - t_start
+            if elapsed > deadline:
+                reason = f"deadline {deadline:.0f} s"
+            elif _hb_age() > stall_s:
+                reason = (f"stall: no stage heartbeat for "
+                          f"{_hb_age():.0f} s (hung dispatch)")
+            else:
+                continue
+            _log(f"measured run exceeded {reason} — killing "
+                 f"(SIGTERM, 30 s grace, then SIGKILL)")
+            proc.terminate()
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            return ("stall" if reason.startswith("stall") else "timeout",
+                    None)
     if proc.returncode != 0:
         _log(f"measured run failed rc={proc.returncode}")
         return "crash", None
@@ -802,7 +891,7 @@ def main() -> None:
                             "stage_s": rr.get("stage_s")})
                         _log(f"rung {rung}: {rr['value']} s, "
                              f"{rr.get('dm_trials')} trials")
-                    elif st == "timeout":
+                    elif st in ("timeout", "stall"):
                         # Rung shapes are NOT warmed by the AOT gate
                         # (it compiles full-scale programs), so a rung
                         # overrun is most likely cold-compile cost,
@@ -837,11 +926,13 @@ def main() -> None:
             if result is None:
                 partial = _read_partial()
                 elapsed = round(time.time() - t_start, 2)
-                err = (f"timed_out_after_{eff_deadline:.0f}s"
-                       if status == "timeout" else "measured_run_crashed")
+                err = {"timeout": f"timed_out_after_{eff_deadline:.0f}s",
+                       "stall": "stalled_no_stage_heartbeat",
+                       }.get(status, "measured_run_crashed")
                 result = {
                     "metric": "mock_beam_full_plan_search_wallclock",
-                    "value": elapsed if status == "timeout" else -1.0,
+                    "value": elapsed if status in ("timeout", "stall")
+                    else -1.0,
                     "unit": "s",
                     "vs_baseline": 0.0,
                     "error": err,

@@ -91,7 +91,7 @@ def test_jobpool_once_with_added_files(tmp_path, capsys, _iso_config):
 
 
 @pytest.mark.slow
-def test_full_pipeline_cycle(tmp_path, capsys, _iso_config):
+def test_full_pipeline_cycle(tmp_path, capsys, monkeypatch, _iso_config):
     """The whole pipeline through the real CLI entry points: manual
     ingest -> job pool submits a REAL search worker through the local
     queue -> pool polls it to 'processed' -> uploader parses the
@@ -110,8 +110,27 @@ def test_full_pipeline_cycle(tmp_path, capsys, _iso_config):
                            merged=True)
     main(["--db", db, "add-files"] + fns)
 
+    # Bound the worker's DM window (searching.dm_max -> SearchParams
+    # -> ddplan.trim_plan): the untrimmed generated plan for this toy
+    # beam is ~4200 trials and ~200 s of worker wall-clock on one
+    # core, which under suite contention overran the poll deadline
+    # (2026-07-31 flake).  The injected pulsar is at DM 20; a 60-DM
+    # window keeps the search real (multi-pass, sifting sees DM
+    # neighbours) at ~1/20 the trials.  The worker is a subprocess:
+    # it loads settings from TPULSAR_CONFIG, not this process's
+    # set_settings, so write the override to a file.
+    _iso_config.searching.dm_max = 60.0
+    cfg_file = tmp_path / "worker_config.yaml"
+    cfg_file.write_text(
+        "searching:\n  dm_max: 60.0\n"
+        "processing:\n"
+        f"  base_working_directory: {_iso_config.processing.base_working_directory}\n"
+        f"  base_results_directory: {_iso_config.processing.base_results_directory}\n"
+        f"basic:\n  log_dir: {_iso_config.basic.log_dir}\n")
+    monkeypatch.setenv("TPULSAR_CONFIG", str(cfg_file))
+
     t = JobTracker(db)
-    deadline = time.time() + 300
+    deadline = time.time() + 420
     status = None
     while time.time() < deadline:
         assert main(["--db", db, "jobpool", "--once"]) == 0

@@ -117,3 +117,91 @@ def test_choose_n_exact_examples():
     assert choose_n(30000) == 30720          # 64 * 480
     assert choose_n(100) == 128
     assert choose_n(0) == 64
+
+
+# ------------------------------------------------------------ trim_plan
+
+def test_trim_plan_default_window_is_noop():
+    """The PALFA survey plans are untouched by the default [0, 1000)
+    window: every pass STARTS below 1000 and trimming is whole-pass
+    (a narrower window would desynchronize production runs from the
+    reference's plan tables)."""
+    from tpulsar.plan.ddplan import survey_plan, trim_plan
+
+    for backend in ("mock", "wapp"):
+        steps = survey_plan(backend)
+        assert trim_plan(steps, 0.0, 1000.0) == steps
+
+
+def test_trim_plan_low_window():
+    """[0, 60] on the Mock plan keeps only whole passes of step 1
+    that intersect the window."""
+    from tpulsar.plan.ddplan import survey_plan, trim_plan
+
+    steps = trim_plan(survey_plan("mock"), 0.0, 60.0)
+    assert len(steps) == 1
+    s = steps[0]
+    assert s.lodm == 0.0
+    # sub_dmstep = 7.6; passes start at 0, 7.6, ... -> last start
+    # below 60 is 53.2 (index 7)
+    assert s.numpasses == 8
+    assert s.hidm == pytest.approx(60.8)
+    # every requested DM inside the window is still searched
+    dms = s.all_dms()
+    assert dms.min() == 0.0 and dms.max() >= 60.0 - s.dmstep
+
+
+def test_trim_plan_mid_window_spans_steps():
+    from tpulsar.plan.ddplan import survey_plan, trim_plan
+
+    steps = trim_plan(survey_plan("mock"), 300.0, 500.0)
+    # steps 2 (212.8..443.2) and 3 (443.2..534.4) intersect
+    assert len(steps) == 2
+    s2, s3 = steps
+    assert s2.lodm == pytest.approx(289.6)   # whole-pass: 212.8 + 4*19.2
+    assert s2.hidm >= 443.2 - 1e-6
+    assert s3.lodm == pytest.approx(443.2)
+    assert s3.hidm >= 500.0
+    # the window is fully covered, no gaps at the seam
+    assert s2.hidm == pytest.approx(s3.lodm)
+
+
+def test_trim_plan_empty_and_plan_for_raises():
+    from tpulsar.plan.ddplan import plan_for, survey_plan, trim_plan
+
+    assert trim_plan(survey_plan("mock"), 2000.0, 3000.0) == []
+
+    # plan_for must RAISE (not return an empty plan) when the DM
+    # window excludes every pass — an empty plan would send the
+    # executor into a zero-pass search that "succeeds" with no trials
+    class _Si:
+        num_channels = 96
+        dt = 6.4e-5
+        fctr = 1400.0
+        BW = 100.0
+        spectra_per_subint = 2048
+        backend = "mock"
+
+    with pytest.raises(ValueError, match="no passes"):
+        plan_for(_Si(), lodm=2000.0, hidm=3000.0)
+
+
+def test_searching_dm_window_reaches_params():
+    """config.searching.dm_min/dm_max flow into SearchParams (the
+    worker's from_config path)."""
+    from tpulsar.config import TpulsarConfig
+    from tpulsar.search.executor import SearchParams
+
+    cfg = TpulsarConfig()
+    cfg.searching.dm_max = 60.0
+    p = SearchParams.from_config(cfg.searching)
+    assert p.dm_max == 60.0 and p.dm_min == 0.0
+
+
+def test_trim_plan_default_no_cap():
+    """The documented no-cap default (hidm=inf) keeps every pass."""
+    from tpulsar.plan.ddplan import survey_plan, trim_plan
+
+    steps = survey_plan("mock")
+    assert trim_plan(steps) == steps
+    assert trim_plan(steps, lodm=500.0)[-1] == steps[-1]

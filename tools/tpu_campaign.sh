@@ -39,45 +39,58 @@ fi
 
 say() { echo "[campaign $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 
-say "=== TPU campaign start ==="
-
-# 1. health probe
-timeout 150 python -c "
+# Hang-proof health probe (subprocess + timeout, non-cpu platform
+# required so a silent CPU fallback can't masquerade as a healthy
+# chip).  probe_or_abort MSG RC: abort the campaign with RC when the
+# chip is wedged — one definition, so a probe tweak can't silently
+# miss one of the call sites.
+probe_ok() {
+    timeout 150 python -c "
 import tpulsar, json, sys
 r = tpulsar.probe_device_subprocess(timeout=120)
 print(json.dumps(r))
 sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
 " >> "$LOG" 2>&1
-if [ $? -ne 0 ]; then
-    say "ABORT: probe unhealthy"
-    exit 1
-fi
+}
+probe_or_abort() {
+    probe_ok || { say "ABORT: $1"; exit "$2"; }
+}
+
+say "=== TPU campaign start ==="
+
+# 1. health probe
+probe_or_abort "probe unhealthy" 1
 say "probe healthy"
 
-# 2. Quick datapoint at 25% scale: the reduced-shape programs compile
-#    in minutes, so this produces the round's first real TPU number
-#    (accel stage on, per-stage breakdown, bench_partial evidence)
-#    long before the full-scale gate finishes.  bench.py runs its own
-#    fast AOT gate for these shapes (TPULSAR_BENCH_AOT default on).
-#    Retry while the record says aot_gate_deferred: each rerun's gate
-#    resumes from the warmed compilation cache (quarter-scale accel
-#    compiles are ~10 min each on this host, more than one gate
-#    budget), and the measured run only happens once the gate passes.
-for qattempt in 1 2 3 4; do
-    say "quick datapoint: 25%-scale measured run (attempt $qattempt)"
-    TPULSAR_BENCH_SCALE=0.25 TPULSAR_BENCH_LADDER=0 \
-    TPULSAR_BENCH_AOT_BUDGET=1200 TPULSAR_BENCH_CPU_FALLBACK=0 \
+# 2. Quick datapoint at 25% scale.  FULL gate first (not the fast
+#    maximal-footprint one): the 2026-07-31 03:49 attempt showed the
+#    fast gate leaves every per-pass program (subband/dedisperse/SP/
+#    FFT) uncompiled, and the measured child then sat >25 min silent
+#    in its first in-line remote compile — indistinguishable from a
+#    hang until the deadline kill wedged the chip.  The full gate is
+#    compile-only, streams per-program [ok] lines to the log (a hung
+#    compile is localized by name), and leaves the measured run fully
+#    cached so its stage trace measures execution, not compilation.
+say "quick datapoint: full AOT gate at 25% scale (compile-only)"
+bash tools/aot_gate_loop.sh "$LOG" 900 --scale 0.25 --accel > /dev/null
+qrc=$?
+if [ $qrc -ne 0 ]; then
+    # Do NOT abort the whole campaign: the full-scale gate (step 3)
+    # resumes from the same cache and the ladder/focused steps are
+    # independent evidence.  Only the quick measured run is skipped
+    # (running it against an unconverged gate is the in-line-compile
+    # blindness of the 03:49 attempt).
+    say "quick datapoint SKIPPED: quarter-scale gate rc=$qrc (2=stopped converging, else compile failure/hang)"
+else
+    say "quick datapoint: 25%-scale measured run (cache warm)"
+    TPULSAR_BENCH_SCALE=0.25 TPULSAR_BENCH_LADDER=0 TPULSAR_BENCH_AOT=0 \
+    TPULSAR_BENCH_CPU_FALLBACK=0 \
     TPULSAR_BENCH_TOTAL_BUDGET=2700 TPULSAR_BENCH_DEADLINE=1500 \
     timeout 2900 python bench.py > "$OUT/quick_quarter.json" 2>>"$LOG"
     say "quick 25%: $(tail -c 600 "$OUT/quick_quarter.json")"
-    grep -q '"aot_gate_deferred"' "$OUT/quick_quarter.json" || break
-done
+fi
 
-timeout 150 python -c "
-import tpulsar, sys
-r = tpulsar.probe_device_subprocess(timeout=120)
-sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
-" >> "$LOG" 2>&1 || { say "ABORT: chip unhealthy after quick datapoint"; exit 6; }
+probe_or_abort "chip unhealthy after quick datapoint" 6
 
 # 3. AOT gate (compile-only; also the cache warmer).  NEVER
 # SIGTERM-kill this mid-compile: killing the PJRT client during an
@@ -105,11 +118,7 @@ timeout 2600 python bench.py > "$OUT/headline.json" 2>>"$LOG"
 say "headline: $(tail -c 600 "$OUT/headline.json")"
 
 # stop early if the chip wedged mid-campaign
-timeout 150 python -c "
-import tpulsar, sys
-r = tpulsar.probe_device_subprocess(timeout=120)
-sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
-" >> "$LOG" 2>&1 || { say "ABORT: chip unhealthy after headline"; exit 3; }
+probe_or_abort "chip unhealthy after headline" 3
 
 # 5. focused configs
 for cfg in 1 4 3; do
@@ -118,11 +127,7 @@ for cfg in 1 4 3; do
     TPULSAR_BENCH_DEADLINE=1200 \
     timeout 1700 python bench.py > "$OUT/config$cfg.json" 2>>"$LOG"
     say "config $cfg: $(tail -c 400 "$OUT/config$cfg.json")"
-    timeout 150 python -c "
-import tpulsar, sys
-r = tpulsar.probe_device_subprocess(timeout=120)
-sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
-" >> "$LOG" 2>&1 || { say "ABORT: chip unhealthy after config $cfg"; exit 4; }
+    probe_or_abort "chip unhealthy after config $cfg" 4
 done
 
 say "focused config 5 (8-beam steady state)"

@@ -109,6 +109,10 @@ class SearchingConfig:
     nsub: int = 96
     datatype: str = "mock"
     low_T_to_search: float = 0.0       # seconds; 0 = search everything
+    dm_min: float = 0.0                # DM trial window, trimmed from
+    dm_max: float = 0.0                # the plan at whole-pass
+    #                                    granularity (DDplan2b's -l/-d
+    #                                    range args); dm_max 0 = no cap
 
 
 @dataclasses.dataclass

@@ -274,13 +274,42 @@ def largest_divisor_leq(n: int, k: int) -> int:
     return 1
 
 
+def trim_plan(steps: list[DedispStep], lodm: float = 0.0,
+              hidm: float = float("inf")) -> list[DedispStep]:
+    """Restrict a plan to the DM window [lodm, hidm] at whole-pass
+    granularity (a pass is the atomic unit of work: one subband
+    formation + its dms_per_pass trials — splitting a pass would
+    change the subdm the subbands are formed at and desynchronize the
+    plan from the reference's pass structure).  Passes that intersect
+    the window at all are kept whole.  The reference exposes the same
+    control as DDplan2b's -l/-d DM range arguments."""
+    out = []
+    for s in steps:
+        if s.hidm <= lodm or s.lodm >= hidm:
+            continue
+        first = max(0, int((lodm - s.lodm) // s.sub_dmstep))
+        # last pass whose start lies below hidm (int(ceil(inf)) would
+        # raise, so the no-cap default keeps every trailing pass)
+        last = s.numpasses - 1 if np.isinf(hidm) else \
+            min(s.numpasses - 1,
+                int(np.ceil((hidm - s.lodm) / s.sub_dmstep)) - 1)
+        if last < first:
+            continue
+        out.append(dataclasses.replace(
+            s, lodm=round(s.lodm + first * s.sub_dmstep, 6),
+            numpasses=last - first + 1))
+    return out
+
+
 def plan_for(si, lodm: float = 0.0, hidm: float = 1000.0,
              numsub: int = 96, survey: str | None = None
              ) -> tuple[list[DedispStep], Observation, int]:
     """The plan the executor will actually run for an observation:
-    survey plan when requested (or the backend has one and no explicit
-    range narrows it), else a generated plan — with nsub corrected to
-    divide the channel count.  Returns (steps, obs, nsub)."""
+    survey plan when requested (or the backend has one), else a
+    generated plan — with nsub corrected to divide the channel count
+    and the result trimmed to [lodm, hidm] at whole-pass granularity.
+    Returns (steps, obs, nsub).  Raises ValueError when the DM window
+    excludes every pass."""
     nsub = numsub if si.num_channels % numsub == 0 else \
         largest_divisor_leq(si.num_channels, numsub)
     obs = Observation(dt=si.dt, fctr=si.fctr, bw=abs(si.BW),
@@ -291,6 +320,10 @@ def plan_for(si, lodm: float = 0.0, hidm: float = 1000.0,
         steps = survey_plan(backend)
     except ValueError:
         steps = generate_ddplan(obs, lodm, hidm, numsub=nsub)
+    steps = trim_plan(steps, lodm, hidm)
+    if not steps:
+        raise ValueError(
+            f"DM window [{lodm}, {hidm}] leaves no passes to search")
     return steps, obs, nsub
 
 

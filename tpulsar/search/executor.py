@@ -118,6 +118,11 @@ class SearchParams:
     #                                 this (reference set_up_job guard,
     #                                 PALFA2_presto_search.py:450);
     #                                 0 = search everything
+    dm_min: float = 0.0             # DM trial window: the plan is
+    dm_max: float = 0.0             # trimmed to [dm_min, dm_max] at
+    #                                 whole-pass granularity
+    #                                 (ddplan.trim_plan; DDplan2b's
+    #                                 -l/-d args); dm_max 0 = no cap
 
     def __post_init__(self):
         for field in ("seq_shard", "block_quantize"):
@@ -153,7 +158,9 @@ class SearchParams:
                 low_dm_cutoff=searching.sifting_low_dm_cutoff),
             to_prepfold_sigma=searching.to_prepfold_sigma,
             max_cands_to_fold=searching.max_cands_to_fold,
-            low_T_to_search_s=searching.low_T_to_search)
+            low_T_to_search_s=searching.low_T_to_search,
+            dm_min=searching.dm_min,
+            dm_max=searching.dm_max)
 
 
 class TooShortToSearchError(ValueError):
@@ -216,7 +223,10 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     nsub = params.nsub if si.num_channels % params.nsub == 0 else \
         ddplan.largest_divisor_leq(si.num_channels, params.nsub)
     if plan is None:
-        plan, _obs, nsub = ddplan.plan_for(si, numsub=params.nsub)
+        plan, _obs, nsub = ddplan.plan_for(
+            si, lodm=params.dm_min,
+            hidm=params.dm_max if params.dm_max > 0 else 1000.0,
+            numsub=params.nsub)
 
     # ---------------------------------------------------------- read + RFI
     f32_bytes = int(si.N) * si.num_channels * 4

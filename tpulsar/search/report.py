@@ -10,11 +10,39 @@ written at the end of the search (write_report, :336-372).  The
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 import time
 
 
 STAGES = ("rfifind", "subbanding", "dedispersing", "single-pulse",
           "FFT", "lo-accelsearch", "hi-accelsearch", "sifting", "folding")
+
+# TPULSAR_STAGE_TRACE=1: print begin/end of every timed stage to
+# stderr, flushed.  A run that blocks inside a remote device dispatch
+# leaves no per-pass progress record (the callback fires only at pass
+# end), so without this there is no way to tell WHICH stage a wedged
+# pass is stuck in — the exact blind spot of the 2026-07-31 04:xx TPU
+# hang (bench log: nothing between `rfifind done` and the deadline
+# kill, 25 min later).
+_TRACE = os.environ.get("TPULSAR_STAGE_TRACE", "") == "1"
+
+# TPULSAR_STAGE_HEARTBEAT=<path>: touch <path> at every stage begin/
+# end.  A supervising parent distinguishes a *stalled* child (no
+# heartbeat for many minutes -> hung dispatch, kill it) from a slow
+# but progressing one (heartbeat fresh -> let it run): killing a
+# healthy child mid-dispatch wedges the chip for hours, so the parent
+# must never kill on elapsed time alone.
+_HEARTBEAT = os.environ.get("TPULSAR_STAGE_HEARTBEAT", "")
+
+
+def _beat() -> None:
+    if _HEARTBEAT:
+        try:
+            with open(_HEARTBEAT, "w") as fh:
+                fh.write(str(time.time()))
+        except OSError:
+            pass
 
 
 class StageTimers:
@@ -26,10 +54,20 @@ class StageTimers:
     def timing(self, stage: str):
         self.times.setdefault(stage, 0.0)
         start = time.time()
+        _beat()
+        if _TRACE:
+            print(f"[stage-trace +{start - self._t0:8.1f}s] begin "
+                  f"{stage}", file=sys.stderr, flush=True)
         try:
             yield
         finally:
-            self.times[stage] += time.time() - start
+            end = time.time()
+            self.times[stage] += end - start
+            _beat()
+            if _TRACE:
+                print(f"[stage-trace +{end - self._t0:8.1f}s] end   "
+                      f"{stage} ({end - start:.1f} s)",
+                      file=sys.stderr, flush=True)
 
     @property
     def total(self) -> float:
