@@ -648,6 +648,54 @@ def run_aot_gate(timeout: float, accel: bool, scale: float,
     return rec
 
 
+def _acquire_campaign_lock() -> "object | None":
+    """Serialize chip access with tools/tpu_campaign.sh via its
+    .campaign.lock flock.  Two clients of the single axon chip corrupt
+    both measurements (and a contended tunnel can present as a hung
+    probe -> a FALSE tpu_unhealthy record), so when a campaign holds
+    the lock this bench WAITS — up to TPULSAR_BENCH_LOCK_WAIT s
+    (default 5400) — rather than racing it; a finished campaign also
+    leaves the compilation cache warm, making the wait a net win.
+    Returns the held file object (keep a reference until exit).  If
+    the wait times out, running anyway would contend with the active
+    campaign — corrupting BOTH measurements and possibly recording a
+    false tpu_unhealthy — so this emits an explicit error record and
+    exits instead.  Benches spawned BY the campaign set
+    TPULSAR_CAMPAIGN_LOCK_HELD=1 to skip this (their parent already
+    holds the lock; a fresh flock here would deadlock on it)."""
+    if os.environ.get("TPULSAR_CAMPAIGN_LOCK_HELD", "") == "1":
+        return None
+    import fcntl
+    path = os.path.join(_REPO, ".campaign.lock")
+    fh = open(path, "w")
+    wait_s = float(os.environ.get("TPULSAR_BENCH_LOCK_WAIT", "10800"))
+    t0 = time.time()
+    logged = False
+    while True:
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return fh
+        except OSError:
+            if time.time() - t0 > wait_s:
+                _log(f"campaign lock still held after {wait_s:.0f} s")
+                print(json.dumps({
+                    "metric": "mock_beam_full_plan_search_wallclock",
+                    "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+                    "error": "campaign_lock_timeout",
+                    "detail": "a measurement campaign held "
+                              ".campaign.lock for the whole wait; "
+                              "refusing to contend for the single "
+                              "chip (see bench_runs/ for the "
+                              "campaign's own records)"}),
+                      flush=True)
+                raise SystemExit(0)
+            if not logged:
+                _log("a measurement campaign holds .campaign.lock — "
+                     f"waiting up to {wait_s:.0f} s for it to finish")
+                logged = True
+            time.sleep(30)
+
+
 def main() -> None:
     if "--measured" in sys.argv:
         run_measured()
@@ -657,6 +705,7 @@ def main() -> None:
             float(os.environ.get("TPULSAR_BENCH_PROBE_TIMEOUT", "180")))
         print(json.dumps(rec if rec else {"ok": False}))
         return
+    _campaign_lock = _acquire_campaign_lock()  # noqa: F841 — held till exit
 
     try:
         _bench_dtype_name()   # fail fast, before any TPU spend

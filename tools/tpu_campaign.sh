@@ -36,6 +36,10 @@ if ! flock -n 9; then
         | tee -a "$LOG"
     exit 5
 fi
+# Benches spawned by THIS campaign must not try to take the lock we
+# already hold (bench.py waits on it to avoid racing a campaign for
+# the single chip — see _acquire_campaign_lock)
+export TPULSAR_CAMPAIGN_LOCK_HELD=1
 
 say() { echo "[campaign $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 
@@ -108,6 +112,19 @@ if [ $aot_rc -ne 0 ]; then
     exit 2
 fi
 say "aot_check passed (full-scale programs compiled)"
+
+# 3b. Gate the ladder rung scales too (compile-only): rung shapes are
+#     distinct programs, and an in-line remote compile inside a rung's
+#     measured child is silent until its cap kills it mid-compile —
+#     the wedge mode this campaign exists to avoid.  A rung-gate
+#     failure skips nothing downstream (the headline's full-scale
+#     programs are already gated); worst case the rungs compile
+#     in-line under the stall supervisor.
+for rung in 0.5 0.1; do
+    say "rung gate: compile-only at scale $rung"
+    bash tools/aot_gate_loop.sh "$LOG" 900 --scale "$rung" --accel > /dev/null \
+        || say "rung $rung gate incomplete (rungs may compile in-line)"
+done
 
 # 4. headline ladder bench (generous self-run budgets; the driver's
 #    own run later reuses the warmed cache)

@@ -16,11 +16,18 @@ LOG="$(pwd)/tpu_watch.log"
 
 echo "[watch $(date +%H:%M:%S)] start, period ${PERIOD}s" >> "$LOG"
 while true; do
-    if timeout 180 python -c "
+    # Probe WHILE HOLDING the campaign lock (released before the
+    # campaign runs — it takes its own).  A second tunnel client can
+    # hang a campaign's/bench's dispatches and corrupt its
+    # measurement, and a check-then-probe without the lock leaves a
+    # 180 s window for exactly that race.
+    flock -n -E 99 "$(pwd)/.campaign.lock" timeout 180 python -c "
 import tpulsar, sys
 r = tpulsar.probe_device_subprocess(timeout=150)
 sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
-" >> "$LOG" 2>&1; then
+" >> "$LOG" 2>&1
+    prc=$?
+    if [ $prc -eq 0 ]; then
         echo "[watch $(date +%H:%M:%S)] chip healthy -> campaign" >> "$LOG"
         bash tools/tpu_campaign.sh >> "$LOG" 2>&1
         rc=$?
@@ -29,7 +36,10 @@ sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
         # chip re-wedged before the campaign's own probe) must re-arm
         # the watcher, which is the whole point of running one
         [ $rc -eq 0 ] && exit 0
+    elif [ $prc -eq 99 ]; then
+        echo "[watch $(date +%H:%M:%S)] lock held (campaign/bench running) — sleeping" >> "$LOG"
+    else
+        echo "[watch $(date +%H:%M:%S)] still wedged" >> "$LOG"
     fi
-    echo "[watch $(date +%H:%M:%S)] still wedged" >> "$LOG"
     sleep "$PERIOD"
 done
