@@ -654,7 +654,7 @@ def _acquire_campaign_lock() -> "object | None":
     both measurements (and a contended tunnel can present as a hung
     probe -> a FALSE tpu_unhealthy record), so when a campaign holds
     the lock this bench WAITS — up to TPULSAR_BENCH_LOCK_WAIT s
-    (default 5400) — rather than racing it; a finished campaign also
+    (default 10800) — rather than racing it; a finished campaign also
     leaves the compilation cache warm, making the wait a net win.
     Returns the held file object (keep a reference until exit).  If
     the wait times out, running anyway would contend with the active

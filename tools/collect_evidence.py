@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Collect on-chip campaign evidence into a committed record.
+
+bench_runs/ is gitignored working space; this folds whatever records a
+campaign produced (quick datapoint, headline ladder, focused configs,
+SP-detrend A/B) plus the Pallas smoke verdict from the campaign log
+into ONE committed JSON file at the repo root, so the evidence
+survives even when the campaign finishes after the session that
+launched it is gone.  Safe to run repeatedly (pure read -> rewrite).
+
+Usage: python tools/collect_evidence.py [--round N] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse_last_json_line(path: str):
+    """Last parseable JSON line of a bench stdout capture (bench may
+    log human lines around the one-line result contract)."""
+    try:
+        with open(path) as fh:
+            lines = fh.read().strip().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def _pallas_verdict(log_path: str) -> dict | None:
+    """The campaign's step-6 smoke verdict: last 'pallas smoke:' line
+    and its following detail line."""
+    try:
+        with open(log_path) as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    smokes = re.findall(r"pallas smoke: (\S+)", text)
+    details = re.findall(r"detail: (.+)", text)
+    if not smokes:
+        return None
+    return {"ok": smokes[-1] == "True",
+            "detail": details[-1][:400] if details else None}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", default=os.environ.get("TPULSAR_ROUND", "3"))
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    out_path = args.out or os.path.join(
+        REPO, f"BENCH_campaign_r{int(args.round):02d}.json")
+
+    runs_dir = os.path.join(REPO, "bench_runs")
+    record: dict = {"collected_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime()),
+                    "runs": {}}
+    if os.path.isdir(runs_dir):
+        for fn in sorted(os.listdir(runs_dir)):
+            if not fn.endswith(".json"):
+                continue
+            parsed = _parse_last_json_line(os.path.join(runs_dir, fn))
+            if parsed is not None:
+                record["runs"][fn[:-5]] = parsed
+    pallas = _pallas_verdict(os.path.join(REPO, "tpu_campaign.log"))
+    if pallas is not None:
+        record["pallas_smoke"] = pallas
+    if not record["runs"] and pallas is None:
+        print("no evidence to collect")
+        return
+    with open(out_path, "w") as fh:
+        json.dump(record, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(out_path)
+
+
+if __name__ == "__main__":
+    main()
