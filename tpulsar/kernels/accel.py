@@ -469,20 +469,42 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
     vals = np.empty((ndms, nstages, topk), np.float32)
     rbins = np.empty((ndms, nstages, topk), np.int32)
     zidx = np.empty((ndms, nstages, topk), np.int32)
+    # Dispatch asynchronously and sync in WINDOWS, not per chunk: at
+    # full scale plane_dm_chunk is 1 (the z-plane per DM is ~2.5 GB),
+    # so a blocking np.asarray after every chunk costs one full
+    # host<->device round-trip per DM trial — ~1100 serialized
+    # round-trips per beam on a tunneled runtime where latency, not
+    # compute, is the bill.  JAX execution is async: enqueue a window
+    # of chunk programs (they run back-to-back on device; outputs are
+    # KB-scale top-k blocks, temps don't stack because execution is
+    # sequential), then fetch the whole window in one sync.
+    SYNC_WINDOW = 32
+
+    def _drain(pending):
+        for s0, nrows, tup in jax.device_get(pending):
+            vals[s0:s0 + nrows] = tup[0]
+            rbins[s0:s0 + nrows] = tup[1]
+            zidx[s0:s0 + nrows] = tup[2]
+        pending.clear()
+
     if use_batch:
+        pending: list = []
         try:
             for c0 in range(0, ndms, dm_chunk):
                 # clamp so the (possibly short) last chunk re-covers
                 # earlier rows instead of triggering a second compile
                 s0 = min(c0, ndms - dm_chunk)
-                v, r, zi = chunk_fn(spectra, bank_fft, s0, dm_chunk)
-                vals[s0:s0 + dm_chunk] = np.asarray(v)
-                rbins[s0:s0 + dm_chunk] = np.asarray(r)
-                zidx[s0:s0 + dm_chunk] = np.asarray(zi)
+                pending.append(
+                    (s0, dm_chunk, chunk_fn(spectra, bank_fft, s0,
+                                            dm_chunk)))
+                if len(pending) >= SYNC_WINDOW:
+                    _drain(pending)
+            _drain(pending)
         except jax.errors.JaxRuntimeError as exc:
             # The runtime rejected the batched shapes (the catchable
-            # failure mode; a hang is only caught by the subprocess
-            # gate).  Downgrade for the rest of the process.
+            # failure mode, surfacing at dispatch or at the window
+            # sync; a hang is only caught by the subprocess gate).
+            # Downgrade for the rest of the process.
             global _BATCH_OK
             _BATCH_OK = False
             use_batch = False
@@ -495,12 +517,14 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                           f"runtime ({exc}); using per-DM fallback")
     if not use_batch:
         # Per-DM fallback: exactly the shapes of the proven
-        # single-spectrum path ((nz, seg) iffts, no DM batch axis).
+        # single-spectrum path ((nz, seg) iffts, no DM batch axis),
+        # same windowed async dispatch.
+        pending = []
         for i in range(ndms):
-            v, r, zi = row_fn(spectra, bank_fft, i)
-            vals[i] = np.asarray(v)
-            rbins[i] = np.asarray(r)
-            zidx[i] = np.asarray(zi)
+            pending.append((i, 1, row_fn(spectra, bank_fft, i)))
+            if len(pending) >= SYNC_WINDOW:
+                _drain(pending)
+        _drain(pending)
     zs = np.asarray(bank.zs)
     return {h: (vals[:, si_, :], rbins[:, si_, :], zs[zidx[:, si_, :]])
             for si_, h in enumerate(stages)}
