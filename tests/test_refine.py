@@ -94,3 +94,31 @@ def test_response_matches_integer_template():
         # same shape up to a global phase: compare |values|
         np.testing.assert_allclose(np.abs(got), np.abs(tpl),
                                    atol=0.02)
+
+
+def test_windowed_view_matches_full_spectrum_at_edges():
+    """The prefetched-window view must reproduce the full-array
+    refinement EXACTLY wherever power_at's edge clamps engage: a
+    low-frequency candidate (k0 clamped up to 1) and a top-edge
+    candidate (k0 clamped down to nbins - w - 1).  Round-3 review
+    caught the low-edge case crashing with IndexError."""
+    import numpy as np
+
+    from tpulsar.search.refine import (_WindowedSpectrum,
+                                       _harmonic_windows, refine_peak)
+
+    rng = np.random.default_rng(7)
+    nbins = 4096
+    spec = (rng.normal(size=nbins) + 1j * rng.normal(size=nbins)
+            ).astype(np.complex64)
+
+    for r0, z0, numharm in ((20.0, 0.0, 1),      # lower clamp
+                            (100.0, 180.0, 1),   # wide template, low r
+                            (nbins - 10.0, 0.0, 1),   # upper clamp
+                            (500.0, 4.0, 4)):    # harmonics
+        spans = _harmonic_windows(r0, z0, numharm, nbins)
+        view = _WindowedSpectrum(
+            nbins, [(lo, spec[lo:hi]) for lo, hi in spans])
+        got = refine_peak(view, r0, z0, numharm=numharm)
+        want = refine_peak(spec, r0, z0, numharm=numharm)
+        assert got == want

@@ -124,6 +124,54 @@ def refine_peak(spec: np.ndarray, r0: float, z0: float,
     return r, z, best
 
 
+class _WindowedSpectrum:
+    """Host view of selected [lo, hi) windows of a device-resident
+    spectrum.  Supports exactly the access pattern power_at uses —
+    ``spec[k0:kend]`` with the slice fully inside one prefetched
+    window, plus ``.shape`` — so refinement transfers a few hundred
+    bins per candidate harmonic instead of the full whitened spectrum
+    (~17 MB per DM group at survey scale; with up to
+    max_cands_to_fold groups that was hundreds of MB over the device
+    tunnel per beam)."""
+
+    def __init__(self, nbins: int,
+                 windows: list[tuple[int, np.ndarray]]) -> None:
+        self.shape = (nbins,)
+        self._wins = windows
+
+    def __getitem__(self, sl: slice) -> np.ndarray:
+        for lo, arr in self._wins:
+            if lo <= sl.start and sl.stop <= lo + len(arr):
+                return arr[sl.start - lo: sl.stop - lo]
+        raise IndexError(
+            f"slice [{sl.start}:{sl.stop}) outside prefetched windows")
+
+
+def _harmonic_windows(r0: float, z0: float, numharm: int,
+                      nbins: int) -> list[tuple[int, int]]:
+    """[lo, hi) bin ranges covering every slice power_at can request
+    while refine_peak explores |r - r0| <= 1, |z - z0| <= DZ at
+    harmonics 1..numharm, including power_at's edge clamps."""
+    from tpulsar.kernels.accel import template_width
+
+    out = []
+    for h in range(1, numharm + 1):
+        w_max = template_width(abs(h * (abs(z0) + DZ)))
+        raw_lo = int(round(h * (r0 - 1))) - w_max // 2 - 2
+        # power_at's upper clamp can relocate k0 down to
+        # nbins - w - 1 for centers near the top edge
+        lo = min(raw_lo, nbins - w_max - 2)
+        hi = int(round(h * (r0 + 1))) + w_max // 2 + 2
+        if raw_lo < 1:
+            # ... and its LOWER clamp (k0 = max(1, ...)) relocates k0
+            # up to 1 for low-frequency candidates, stretching the
+            # slice to [1, 1 + w): the window must reach that far
+            # even though the nominal center sits below w/2
+            hi = max(hi, 1 + w_max + 1)
+        out.append((max(0, lo), min(nbins, max(hi, lo + w_max + 2))))
+    return out
+
+
 def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
                       keep_mask=None) -> None:
     """Refine a list of sifting.Candidate IN PLACE.
@@ -135,7 +183,13 @@ def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
     series' scale: r0 = freq_hz * T_s.  Power, r, z, freq and period
     fields are updated; sigma itself is the caller's to recompute
     (it owns the trials correction).
+
+    Device traffic: the whitened spectrum stays on device; only the
+    harmonic windows around each candidate (a few hundred bins each)
+    are fetched, in ONE device_get per DM group.
     """
+    import jax
+
     import jax.numpy as jnp
 
     from tpulsar.kernels import fourier as fr
@@ -152,10 +206,25 @@ def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
         powers, wpow = fr.whitened_powers(
             spec, jnp.asarray(keep_mask) if keep_mask is not None
             else None)
-        wspec = np.asarray(fr.scale_spectrum(spec, powers, wpow))[0]
+        wspec_dev = fr.scale_spectrum(spec, powers, wpow)[0]
+        nbins = int(wspec_dev.shape[0])
+        ranges: list[tuple[int, int]] = []
+        cand_spans: list[list[tuple[int, int]]] = []
         for c in group:
+            spans = _harmonic_windows(c.freq_hz * T_s, c.z,
+                                      c.numharm, nbins)
+            cand_spans.append(spans)
+            ranges.extend(spans)
+        segs = jax.device_get([wspec_dev[lo:hi] for lo, hi in ranges])
+        windows = [(lo, np.asarray(seg))
+                   for (lo, _hi), seg in zip(ranges, segs)]
+        i = 0
+        for c, spans in zip(group, cand_spans):
+            view = _WindowedSpectrum(
+                nbins, windows[i: i + len(spans)])
+            i += len(spans)
             r0 = c.freq_hz * T_s
-            r, z, power = refine_peak(wspec, r0, c.z,
+            r, z, power = refine_peak(view, r0, c.z,
                                       numharm=c.numharm)
             c.r, c.z, c.power = r, z, power
             c.freq_hz = r / T_s
