@@ -115,14 +115,23 @@ def main() -> int:
     failures: list[str] = []
     deferred: list[str] = []
 
-    def check(name: str, fn, *shaped_args, **kw):
+    def check(name: str, jitted, *shaped_args, **kw):
+        """AOT-compile `jitted` — which MUST be the very jitted
+        callable the runtime invokes (same function, same static
+        values), NOT a wrapping lambda: a wrapper lowers to a
+        different HLO module (jit__lambda vs jit_<fn>) and its
+        persistent-cache entry never serves the measured run.  Proven
+        live on 2026-07-31: after a passing lambda-style gate, the
+        measured child recompiled jit__cell_stats_chan and
+        jit_apply_mask_chan from scratch, then sat >25 min in the next
+        uncached compile until the deadline kill wedged the chip."""
         if args.deadline and time.monotonic() - t0 > args.deadline:
             deferred.append(name)
             print(f"  [defer] {name}: deadline reached; re-run to "
                   "resume from the warm cache", flush=True)
             return
         try:
-            compiled = jax.jit(fn, **kw).lower(*shaped_args).compile()
+            compiled = jitted.lower(*shaped_args, **kw).compile()
             print(f"  [ok] {name}: {_mem_stats(compiled)}", flush=True)
         except Exception as e:
             failures.append(name)
@@ -135,12 +144,15 @@ def main() -> int:
     S = jax.ShapeDtypeStruct
     blk = S((NCHAN, nsamp), blk_dtype)
     nblocks = nsamp // 2048
+    from functools import partial as _partial
+
+    _gen_jit = _partial(jax.jit, static_argnames=("n", "nc", "dtype"))(
+        bench_mod.gen_block_chunk)
 
     print("synth:", flush=True)
-    check("make_block_chunk",
-          lambda key, dc: bench_mod.gen_block_chunk(
-              key, dc, n=nsamp, nc=120, dtype=blk_dtype),
-          S((2,), jnp.uint32), S((120,), jnp.float32))
+    check("make_block_chunk", _gen_jit,
+          S((2,), jnp.uint32), S((120,), jnp.float32),
+          n=nsamp, nc=120, dtype=blk_dtype)
 
     if args.config in (1, 3, 4):
         # Focused-config gate: compile the exact programs
@@ -159,103 +171,91 @@ def main() -> int:
         print(f"config {args.config} (ndms={ndms}, T={nsamp}):",
               flush=True)
         if args.config == 1:
-            check("cell_stats_chan",
-                  lambda d: rfi_k._cell_stats_chan(d, 2048), blk)
-            check("apply_mask_chan",
-                  lambda d, m, f: rfi_k.apply_mask_chan(d, m, f, 2048),
+            check("cell_stats_chan", rfi_k._cell_stats_chan,
+                  blk, block_len=2048)
+            check("apply_mask_chan", rfi_k.apply_mask_chan,
                   blk, S((nblocks, NCHAN), jnp.bool_),
-                  S((NCHAN,), jnp.float32))
-        check("form_subbands",
-              lambda d, s: dd._form_subbands_jit(d, s, 96, 1, pad1),
-              blk, S((NCHAN,), jnp.int32))
-        check("dedisperse_scan",
-              lambda sb, sh: dd._dedisperse_subbands_scan(sb, sh, pad2),
+                  S((NCHAN,), jnp.float32), block_len=2048)
+        check("form_subbands", dd._form_subbands_jit,
+              blk, S((NCHAN,), jnp.int32),
+              nsub=96, downsamp=1, pad=pad1)
+        check("dedisperse_scan", dd._dedisperse_subbands_scan,
               S((96, nsamp), jnp.float32),
-              S((ndms, 96), jnp.int32))
+              S((ndms, 96), jnp.int32), pad=pad2)
         if args.config == 4:
             # estimator resolved exactly as the measured run resolves
             # it (TPULSAR_SP_DETREND is inherited by this subprocess)
             # — a different estimator is a different static-arg
             # program and must not reach the chip ungated
-            check("sp_boxcars",
-                  lambda s: sp_k.boxcar_search(sp_k.normalize_series(
-                      s, estimator=sp_k.detrend_estimator())),
-                  S((ndms, nsamp), jnp.float32))
+            sers = S((ndms, nsamp), jnp.float32)
+            check("sp_normalize", sp_k.normalize_series, sers,
+                  estimator=sp_k.detrend_estimator())
+            check("sp_boxcars", sp_k.boxcar_search, sers)
         if args.config == 3:
             from tpulsar.kernels import accel as ak
             nbins = nsamp // 2 + 1
-            def _spec_scaled(s):
-                spec = fr.complex_spectrum(s)
-                powers, wpow = fr.whitened_powers(spec)
-                return fr.scale_spectrum(spec, powers, wpow)
-
-            check("spectrum+whiten+scale", _spec_scaled,
-                  S((ndms, nsamp), jnp.float32))
+            sers = S((ndms, nsamp), jnp.float32)
+            pows = S((ndms, nbins), jnp.float32)
+            check("complex_spectrum", fr.complex_spectrum, sers)
+            check("whiten_powers", fr.whiten_powers, pows,
+                  edges=tuple(int(e) for e in fr._block_edges(nbins)))
             bank = ak.build_template_bank(200.0)
             nz = len(bank.zs)
             dmc = min(ndms, ak.plane_dm_chunk(nbins, nz))
             print(f"accel z200 (nz={nz}, nbins={nbins}, "
                   f"dm_chunk={dmc}):", flush=True)
-
-            # accel_search_batch's chunk_fn: full spectra argument +
-            # dynamic slice (the argument buffer is part of the gated
-            # footprint)
-            def _accel_chunk200(full, bf, c0):
-                import jax.lax as lax
-                block = lax.dynamic_slice_in_dim(full, c0, dmc, axis=0)
-                return ak._accel_block_topk(block, bf, bank.seg,
-                                            bank.step, bank.width, nz,
-                                            16, 64)
-
-            check("accel_chunk_z200", _accel_chunk200,
-                  S((ndms, nbins), jnp.complex64),
-                  S(bank.bank_fft.shape, jnp.complex64),
-                  S((), jnp.int32))
-
-            # per-DM fallback row program (see the headline gate)
-            def _accel_row200(full, bf, i):
-                import jax.lax as lax
-                spec = lax.dynamic_slice_in_dim(full, i, 1, axis=0)[0]
-                return ak._accel_plane_topk(spec, bf, bank.seg,
-                                            bank.step, bank.width, nz,
-                                            16, 64)
-
-            check("accel_row_z200", _accel_row200,
-                  S((ndms, nbins), jnp.complex64),
-                  S(bank.bank_fft.shape, jnp.complex64),
-                  S((), jnp.int32))
+            spec_sh = S((ndms, nbins), jnp.complex64)
+            bank_sh = S(bank.bank_fft.shape, jnp.complex64)
+            i32 = S((), jnp.int32)
+            # accel_search_batch's chunk/row programs: full spectra
+            # argument + dynamic slice (the argument buffer is part
+            # of the gated footprint)
+            check("accel_chunk_z200", ak.accel_chunk_topk,
+                  spec_sh, bank_sh, i32, nrows=dmc, seg=bank.seg,
+                  step=bank.step, width=bank.width, nz=nz,
+                  max_numharm=16, topk=64)
+            check("accel_row_z200", ak.accel_row_topk,
+                  spec_sh, bank_sh, i32, seg=bank.seg,
+                  step=bank.step, width=bank.width, nz=nz,
+                  max_numharm=16, topk=64)
         return _finish(failures, deferred)
 
     print("rfi:", flush=True)
-    check("cell_stats_chan", lambda d: rfi_k._cell_stats_chan(d, 2048),
-          blk)
-    check("apply_mask_chan",
-          lambda d, m, f: rfi_k.apply_mask_chan(d, m, f, 2048),
-          blk, S((nblocks, NCHAN), jnp.bool_), S((NCHAN,), jnp.float32))
+    check("cell_stats_chan", rfi_k._cell_stats_chan, blk,
+          block_len=2048)
+    check("apply_mask_chan", rfi_k.apply_mask_chan,
+          blk, S((nblocks, NCHAN), jnp.bool_), S((NCHAN,), jnp.float32),
+          block_len=2048)
 
     from tpulsar.search import executor as ex
 
-    # per-step geometry: (step, T_ds, ndms, pad1, pad2, nfft, chunk)
-    # — --fast gates only the maximal-footprint entries
+    # per-step geometry: (step, T_ds, ndms, pad_pairs, nfft, chunk).
+    # pad_pairs spans EVERY pass of the step: the pad bucket grows
+    # with the pass sub-DM, so a step's later passes use larger
+    # buckets than its first — gating only the first pass left most
+    # passes' block programs to compile in-line on the chip.
+    # --fast gates only the maximal-footprint entries.
     geoms = []
     for step in plan:
         T_ds = nsamp // step.downsamp
-        ppass = next(iter(step.passes()))
-        ch_sh, sub_sh = dd.plan_pass_shifts(
-            freqs, step.numsub, ppass.subdm, np.asarray(ppass.dms),
-            TSAMP, step.downsamp)
+        pad_pairs = set()
+        ndms = step.dms_per_pass
+        for ppass in step.passes():
+            ch_sh, sub_sh = dd.plan_pass_shifts(
+                freqs, step.numsub, ppass.subdm, np.asarray(ppass.dms),
+                TSAMP, step.downsamp)
+            ndms = sub_sh.shape[0]
+            pad_pairs.add((dd._pad_bucket(int(ch_sh.max(initial=0))),
+                           dd._pad_bucket(int(sub_sh.max(initial=0)))))
         nfft = ddplan.choose_n(T_ds)
         # the executor's own chunk arithmetic (budget + even split),
         # with run_hi_accel mirroring the measured run's accel setting
         # — with the hi stage off it budgets a ~4/3 LARGER chunk, and
         # the gate must compile that exact shape
         chunk = ex.pass_chunk_size(
-            ndms=sub_sh.shape[0], nfft=nfft,
+            ndms=ndms, nfft=nfft,
             params=ex.SearchParams(run_hi_accel=args.accel))
-        geoms.append((step, T_ds, sub_sh.shape[0],
-                      dd._pad_bucket(int(ch_sh.max(initial=0))),
-                      dd._pad_bucket(int(sub_sh.max(initial=0))),
-                      nfft, chunk))
+        geoms.append((step, T_ds, ndms, pad_pairs, nfft, chunk))
 
     if args.fast:
         # ds=1 dominates every higher-downsamp variant of the block
@@ -265,97 +265,128 @@ def main() -> int:
         # choose_n padding can make those maxima land on different
         # steps — gate both (deduped) so neither program family can
         # hide an ungated maximal footprint
-        block_geoms = [g for g in geoms if g[0].downsamp == 1][:1]
+        block_geoms = [
+            (s, t, n, {max(pp)}, f, c)
+            for s, t, n, pp, f, c in geoms if s.downsamp == 1][:1]
         sp_geoms = list({id(g): g for g in (
-            max(geoms, key=lambda g: g[6] * g[1]),    # chunk*T_ds
-            max(geoms, key=lambda g: g[6] * g[5]),    # chunk*nfft
+            max(geoms, key=lambda g: g[5] * g[1]),    # chunk*T_ds
+            max(geoms, key=lambda g: g[5] * g[4]),    # chunk*nfft
         )}.values())
     else:
         block_geoms = sp_geoms = geoms
 
-    for step, T_ds, ndms, pad1, pad2, nfft, chunk in block_geoms:
+    for step, T_ds, ndms, pad_pairs, nfft, chunk in block_geoms:
         print(f"step downsamp={step.downsamp} (T'={T_ds}, "
-              f"ndms={ndms}):", flush=True)
-        check(f"form_subbands ds={step.downsamp}",
-              lambda d, s, _n=step.numsub, _ds=step.downsamp, _p=pad1:
-              dd._form_subbands_jit(d, s, _n, _ds, _p),
-              blk, S((NCHAN,), jnp.int32))
-        check(f"dedisperse_scan ds={step.downsamp}",
-              lambda sb, sh, _p=pad2:
-              dd._dedisperse_subbands_scan(sb, sh, _p),
-              S((step.numsub, T_ds), jnp.float32),
-              S((ndms, step.numsub), jnp.int32))
-    for step, T_ds, ndms, pad1, pad2, nfft, chunk in sp_geoms:
-        # estimator resolved exactly as the measured run resolves it
-        # (TPULSAR_SP_DETREND inherited by this subprocess)
-        check(f"sp_boxcars ds={step.downsamp}",
-              lambda s: sp_k.boxcar_search(sp_k.normalize_series(
-                  s, estimator=sp_k.detrend_estimator())),
-              S((chunk, T_ds), jnp.float32))
-        # the full lo-stage program the executor runs: whiten ->
-        # scale -> interbin (half-bin grid) -> all harmonic stages,
-        # with stage list and topk from SearchParams (a hardcoded
-        # copy would drift from a configured run)
-        _sp = ex.SearchParams(run_hi_accel=args.accel)
-
-        def _lo_stages(s, _n=nfft):
-            spec = fr.complex_spectrum(fr.pad_series(s, _n))
-            powers, wpow = fr.whitened_powers(spec)
-            wspec = fr.scale_spectrum(spec, powers, wpow)
-            return fr.all_stage_candidates(
-                fr.interbin_powers(wspec),
-                tuple(fr.harmonic_stages(_sp.lo_accel_numharm)),
-                _sp.topk_per_stage)
-
-        check(f"spectrum+lo-stages ds={step.downsamp}", _lo_stages,
-              S((chunk, T_ds), jnp.float32))
-
+              f"ndms={ndms}, pads={sorted(pad_pairs)}):", flush=True)
+        for pad1, pad2 in sorted(pad_pairs):
+            check(f"form_subbands ds={step.downsamp} pad={pad1}",
+                  dd._form_subbands_jit, blk, S((NCHAN,), jnp.int32),
+                  nsub=step.numsub, downsamp=step.downsamp, pad=pad1)
+            check(f"dedisperse_scan ds={step.downsamp} pad={pad2}",
+                  dd._dedisperse_subbands_scan,
+                  S((step.numsub, T_ds), jnp.float32),
+                  S((ndms, step.numsub), jnp.int32), pad=pad2)
+    _sp = ex.SearchParams(run_hi_accel=args.accel)
     if args.accel:
         from tpulsar.kernels import accel as ak
-        bank = ak.build_template_bank(50.0)
+        bank = ak.build_template_bank(float(_sp.hi_accel_zmax))
         nz = len(bank.zs)
-        nfft = ddplan.choose_n(nsamp)
+        bank_sh = S(bank.bank_fft.shape, jnp.complex64)
+        i32 = S((), jnp.int32)
+    for step, T_ds, ndms, _pads, nfft, chunk in sp_geoms:
         nbins = nfft // 2 + 1
-        # the executor hands accel_search_batch the budgeted pass
-        # chunk's spectra; inside, chunk_fn dynamic-slices
-        # plane_dm_chunk rows at a time — compile THAT program (full
-        # spectra argument + slice), not a pre-sliced stand-in, so
-        # the argument buffers are part of the gated footprint.
-        # ndms comes from the plan itself (the ds=1 step's pass
-        # width), not a hardcoded copy that can drift.
-        ds1 = next(s for s in plan if s.downsamp == 1)
-        spec_rows = ex.pass_chunk_size(
-            ds1.dms_per_pass, nfft, ex.SearchParams(run_hi_accel=True))
-        dmc = min(spec_rows, ak.plane_dm_chunk(nbins, nz))
-        print(f"accel (nz={nz}, nbins={nbins}, spec_rows={spec_rows}, "
-              f"dm_chunk={dmc}):", flush=True)
+        # The executor's chunk loop (range(0, ndms, chunk)) produces
+        # TWO row counts per step when chunk doesn't divide
+        # dms_per_pass: the full chunk and the remainder — each a
+        # distinct compiled program for every stage.  The 03:49-style
+        # silent in-line compiles that survived the first direct-lower
+        # gate were exactly the remainder-shape programs.
+        sizes = [min(chunk, ndms)]
+        if chunk < ndms and ndms % chunk:
+            sizes.append(ndms % chunk)
+        for rows in sizes:
+            sers = S((rows, T_ds), jnp.float32)
+            tag = f"ds={step.downsamp} rows={rows}"
+            # estimator resolved exactly as the measured run resolves
+            # it (TPULSAR_SP_DETREND inherited by this subprocess).
+            # Each entry is the runtime's own jitted callable at the
+            # executor's exact shapes/statics — see check()'s
+            # docstring for why a wrapping lambda breaks the
+            # cache-warming property the campaign depends on.
+            check(f"sp_normalize {tag}",
+                  sp_k.normalize_series, sers,
+                  estimator=sp_k.detrend_estimator())
+            check(f"sp_boxcars {tag}",
+                  sp_k.boxcar_search,
+                  sers, tuple(_sp.sp_widths), sp_k.DEFAULT_TOPK)
+            check(f"pad_series {tag}", fr.pad_series,
+                  sers, nfft=nfft)
+            check(f"complex_spectrum {tag}",
+                  fr.complex_spectrum, S((rows, nfft), jnp.float32))
+            check(f"whiten_powers {tag}", fr.whiten_powers,
+                  S((rows, nbins), jnp.float32),
+                  edges=tuple(int(e) for e in fr._block_edges(nbins)))
+            check(f"interbin_powers {tag}",
+                  fr.interbin_powers, S((rows, nbins), jnp.complex64))
+            check(f"lo_stages {tag}",
+                  fr.all_stage_candidates,
+                  S((rows, 2 * nbins), jnp.float32),
+                  tuple(fr.harmonic_stages(_sp.lo_accel_numharm)),
+                  _sp.topk_per_stage)
+            if args.accel:
+                # the hi stage runs at EVERY step geometry (the
+                # executor calls _hi_accel_pass inside the chunk loop
+                # of every pass), so each (rows, nbins) pair is its
+                # own program
+                dmc = min(rows, ak.plane_dm_chunk(nbins, nz))
+                spec_sh = S((rows, nbins), jnp.complex64)
+                check(f"accel_chunk {tag}",
+                      ak.accel_chunk_topk, spec_sh, bank_sh, i32,
+                      nrows=dmc, seg=bank.seg, step=bank.step,
+                      width=bank.width, nz=nz,
+                      max_numharm=_sp.hi_accel_numharm,
+                      topk=_sp.topk_per_stage)
+                check(f"accel_row {tag}",
+                      ak.accel_row_topk, spec_sh, bank_sh, i32,
+                      seg=bank.seg, step=bank.step, width=bank.width,
+                      nz=nz, max_numharm=_sp.hi_accel_numharm,
+                      topk=_sp.topk_per_stage)
 
-        def _accel_chunk(full, bf, c0):
-            import jax.lax as lax
-            block = lax.dynamic_slice_in_dim(full, c0, dmc, axis=0)
-            return ak._accel_block_topk(block, bf, bank.seg, bank.step,
-                                        bank.width, nz, 8, 32)
-
-        check("accel_chunk_topk", _accel_chunk,
-              S((spec_rows, nbins), jnp.complex64),
-              S(bank.bank_fft.shape, jnp.complex64),
-              S((), jnp.int32))
-
-        # the per-DM fallback (accel_search_batch's row_fn): the path
-        # the child takes when the batch smoke fails or the runtime
-        # downgrades mid-run — it must be gated too, or an ungated
-        # program reaches the chip exactly when things already look
-        # shaky
-        def _accel_row(full, bf, i):
-            import jax.lax as lax
-            spec = lax.dynamic_slice_in_dim(full, i, 1, axis=0)[0]
-            return ak._accel_plane_topk(spec, bf, bank.seg, bank.step,
-                                        bank.width, nz, 8, 32)
-
-        check("accel_row_topk", _accel_row,
-              S((spec_rows, nbins), jnp.complex64),
-              S(bank.bank_fft.shape, jnp.complex64),
-              S((), jnp.int32))
+    # Refinement + fold prep: each fold-worthy candidate gets ONE
+    # full-resolution DM series (_dedisperse_single: single-DM
+    # subband + dedisperse at ds=1) and a rows=1 spectral family
+    # (refine_candidates) — distinct programs from the chunked pass
+    # shapes above.  The single-DM pad is a power-of-two bucket of
+    # the candidate DM's max shift, so sampling the survey DM range
+    # covers every bucket a real candidate can produce.
+    print("refinement/fold prep (single-DM, full resolution):",
+          flush=True)
+    nfft_full = ddplan.choose_n(nsamp)
+    nbins_full = nfft_full // 2 + 1
+    check("pad_series rows=1", fr.pad_series,
+          S((1, nsamp), jnp.float32), nfft=nfft_full)
+    check("complex_spectrum rows=1", fr.complex_spectrum,
+          S((1, nfft_full), jnp.float32))
+    check("whiten_powers rows=1", fr.whiten_powers,
+          S((1, nbins_full), jnp.float32),
+          edges=tuple(int(e) for e in fr._block_edges(nbins_full)))
+    # Dense sweep: pad buckets are powers of two, so the LOW buckets
+    # occupy DM intervals much narrower than a coarse sample spacing
+    # (the (256, 512) pair lives in DM ~15-31 alone) — 2048 samples
+    # bound the missable interval to ~0.5 DM, far below any bucket's
+    # width.
+    pads = set()
+    for dmval in np.linspace(0.0, plan[-1].hidm, 2048):
+        ch, sb = dd.plan_pass_shifts(freqs, 96, float(dmval),
+                                     [float(dmval)], TSAMP, 1)
+        pads.add((dd._pad_bucket(int(ch.max(initial=0))),
+                  dd._pad_bucket(int(sb.max(initial=0)))))
+    for p1, p2 in sorted(pads):
+        check(f"form_subbands 1dm pad={p1}", dd._form_subbands_jit,
+              blk, S((NCHAN,), jnp.int32), nsub=96, downsamp=1, pad=p1)
+        check(f"dedisperse_1dm pad={p2}", dd._dedisperse_subbands_scan,
+              S((96, nsamp), jnp.float32), S((1, 96), jnp.int32),
+              pad=p2)
 
     return _finish(failures, deferred)
 

@@ -427,6 +427,34 @@ def _batch_path_usable() -> bool:
     return _BATCH_OK
 
 
+@partial(jax.jit, static_argnames=("nrows", "seg", "step", "width",
+                                   "nz", "max_numharm", "topk"))
+def accel_chunk_topk(full, bf, c0, nrows, seg, step, width, nz,
+                     max_numharm, topk):
+    """One DM chunk of the batched search: dynamic-slice `nrows` rows
+    at c0 out of the full spectra block, then _accel_block_topk.
+    Module-level (not a closure inside accel_search_batch) so
+    tools/aot_check.py can AOT-compile the EXACT runtime program —
+    a wrapper lambda lowers to a different HLO module and the
+    persistent-cache entry never serves the measured run."""
+    block = jax.lax.dynamic_slice_in_dim(full, c0, nrows, axis=0)
+    return _accel_block_topk(block, bf, seg, step, width, nz,
+                             max_numharm, topk)
+
+
+@partial(jax.jit, static_argnames=("seg", "step", "width", "nz",
+                                   "max_numharm", "topk"))
+def accel_row_topk(full, bf, i, seg, step, width, nz, max_numharm,
+                   topk):
+    """Per-DM fallback row program (see accel_chunk_topk on why this
+    is module-level).  Row extraction stays inside jit: eager
+    host-side slicing of complex device arrays is rejected by some
+    TPU runtimes."""
+    spec = jax.lax.dynamic_slice_in_dim(full, i, 1, axis=0)[0]
+    return _accel_plane_topk(spec, bf, seg, step, width, nz,
+                             max_numharm, topk)
+
+
 def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                        max_numharm: int = 8, topk: int = 64,
                        dm_chunk: int | None = None):
@@ -450,19 +478,16 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
     dm_chunk = min(dm_chunk, ndms)
     use_batch = _batch_path_usable()
 
-    @partial(jax.jit, static_argnames=("nrows",))
     def chunk_fn(full, bf, c0, nrows):
-        block = jax.lax.dynamic_slice_in_dim(full, c0, nrows, axis=0)
-        return _accel_block_topk(block, bf, bank.seg, bank.step,
-                                 bank.width, nz, max_numharm, topk)
+        return accel_chunk_topk(full, bf, np.int32(c0), nrows=nrows,
+                                seg=bank.seg, step=bank.step,
+                                width=bank.width, nz=nz,
+                                max_numharm=max_numharm, topk=topk)
 
-    @jax.jit
     def row_fn(full, bf, i):
-        # Row extraction stays inside jit: eager host-side slicing of
-        # complex device arrays is rejected by some TPU runtimes.
-        spec = jax.lax.dynamic_slice_in_dim(full, i, 1, axis=0)[0]
-        return _accel_plane_topk(spec, bf, bank.seg, bank.step,
-                                 bank.width, nz, max_numharm, topk)
+        return accel_row_topk(full, bf, np.int32(i), seg=bank.seg,
+                              step=bank.step, width=bank.width, nz=nz,
+                              max_numharm=max_numharm, topk=topk)
 
     stages = harmonic_stages(max_numharm)
     nstages = len(stages)

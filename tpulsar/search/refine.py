@@ -124,6 +124,38 @@ def refine_peak(spec: np.ndarray, r0: float, z0: float,
     return r, z, best
 
 
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+def _gather_windows(spec_dev, lows: np.ndarray, width: int):
+    """Fetch len(lows) windows of `width` bins each from a
+    device-resident 1-D spectrum in ONE jitted gather + ONE
+    device_get.  Eager per-window slicing of a complex device array
+    is rejected by some TPU runtimes (see accel.accel_row_topk), and
+    each distinct (lo, hi) pair would otherwise lower its own tiny
+    slice program — unbounded data-dependent compiles.  lows count
+    and width are pow2-bucketed by the caller so the program set
+    stays small."""
+    import jax
+    import jax.numpy as jnp
+
+    global _GATHER_JIT
+    if _GATHER_JIT is None:
+        def _gather(spec, lo_arr, width):
+            idx = lo_arr[:, None] + jnp.arange(width)[None, :]
+            idx = jnp.clip(idx, 0, spec.shape[0] - 1)
+            return jnp.take(spec, idx, axis=0)
+
+        _GATHER_JIT = jax.jit(_gather, static_argnames=("width",))
+    return np.asarray(jax.device_get(
+        _GATHER_JIT(spec_dev, jnp.asarray(lows, np.int32),
+                    width=width)))
+
+
+_GATHER_JIT = None
+
+
 class _WindowedSpectrum:
     """Host view of selected [lo, hi) windows of a device-resident
     spectrum.  Supports exactly the access pattern power_at uses —
@@ -188,8 +220,6 @@ def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
     harmonic windows around each candidate (a few hundred bins each)
     are fetched, in ONE device_get per DM group.
     """
-    import jax
-
     import jax.numpy as jnp
 
     from tpulsar.kernels import fourier as fr
@@ -215,9 +245,18 @@ def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
                                       c.numharm, nbins)
             cand_spans.append(spans)
             ranges.extend(spans)
-        segs = jax.device_get([wspec_dev[lo:hi] for lo, hi in ranges])
-        windows = [(lo, np.asarray(seg))
-                   for (lo, _hi), seg in zip(ranges, segs)]
+        # One jitted gather of pow2-bucketed (count, width), then one
+        # transfer: eager complex slicing is rejected by some TPU
+        # runtimes, and per-window slice programs would be unbounded
+        # data-dependent compiles.
+        width = _pow2(max(hi - lo for lo, hi in ranges))
+        nwin = _pow2(len(ranges))
+        lows = np.fromiter((lo for lo, _ in ranges), np.int32,
+                           len(ranges))
+        lows = np.pad(lows, (0, nwin - len(ranges)))
+        fetched = _gather_windows(wspec_dev, lows, width)
+        windows = [(lo, fetched[i][: min(width, nbins - lo)])
+                   for i, (lo, _hi) in enumerate(ranges)]
         i = 0
         for c, spans in zip(group, cand_spans):
             view = _WindowedSpectrum(
