@@ -319,13 +319,14 @@ def main() -> int:
             check(f"sp_boxcars {tag}",
                   sp_k.boxcar_search,
                   sers, tuple(_sp.sp_widths), sp_k.DEFAULT_TOPK)
-            check(f"pad_series {tag}", fr.pad_series,
+            # the fused pad->rfft->whiten->scale stage program, both
+            # with and without a zaplist keep-mask (search_beam always
+            # passes a zaplist; bench's search_block does not)
+            check(f"whitened_spectrum {tag}", fr.whitened_spectrum,
                   sers, nfft=nfft)
-            check(f"complex_spectrum {tag}",
-                  fr.complex_spectrum, S((rows, nfft), jnp.float32))
-            check(f"whiten_powers {tag}", fr.whiten_powers,
-                  S((rows, nbins), jnp.float32),
-                  edges=tuple(int(e) for e in fr._block_edges(nbins)))
+            check(f"whitened_spectrum_masked {tag}",
+                  fr.whitened_spectrum_masked,
+                  sers, S((nbins,), jnp.bool_), nfft=nfft)
             check(f"interbin_powers {tag}",
                   fr.interbin_powers, S((rows, nbins), jnp.complex64))
             check(f"lo_stages {tag}",
@@ -363,13 +364,11 @@ def main() -> int:
           flush=True)
     nfft_full = ddplan.choose_n(nsamp)
     nbins_full = nfft_full // 2 + 1
-    check("pad_series rows=1", fr.pad_series,
+    check("whitened_spectrum rows=1", fr.whitened_spectrum,
           S((1, nsamp), jnp.float32), nfft=nfft_full)
-    check("complex_spectrum rows=1", fr.complex_spectrum,
-          S((1, nfft_full), jnp.float32))
-    check("whiten_powers rows=1", fr.whiten_powers,
-          S((1, nbins_full), jnp.float32),
-          edges=tuple(int(e) for e in fr._block_edges(nbins_full)))
+    check("whitened_spectrum_masked rows=1",
+          fr.whitened_spectrum_masked, S((1, nsamp), jnp.float32),
+          S((nbins_full,), jnp.bool_), nfft=nfft_full)
     # Dense sweep: pad buckets are powers of two, so the LOW buckets
     # occupy DM intervals much narrower than a coarse sample spacing
     # (the (256, 512) pair lives in DM ~15-31 alone) — 2048 samples

@@ -195,6 +195,31 @@ def scale_spectrum(spec: jnp.ndarray, powers: jnp.ndarray,
                            ).astype(spec.dtype)
 
 
+@partial(jax.jit, static_argnames=("nfft",))
+def whitened_spectrum(series: jnp.ndarray, nfft: int) -> jnp.ndarray:
+    """pad -> rfft -> whiten -> scale as ONE compiled program.
+
+    The executor's FFT stage previously ran this as four jitted calls
+    plus ~6 eager elementwise ops — each eager op its own tiny
+    remote-compiled program on a tunneled runtime, and each
+    materializing a (rows, nbins)-sized intermediate in HBM.  Fusing
+    lets XLA keep the whitening math in registers and gives
+    tools/aot_check.py ONE program per shape family to gate."""
+    spec = complex_spectrum(pad_series(series, nfft))
+    powers, wpow = whitened_powers(spec)
+    return scale_spectrum(spec, powers, wpow)
+
+
+@partial(jax.jit, static_argnames=("nfft",))
+def whitened_spectrum_masked(series: jnp.ndarray, keep: jnp.ndarray,
+                             nfft: int) -> jnp.ndarray:
+    """whitened_spectrum with a zaplist keep-mask (separate program:
+    the mask multiply changes the HLO)."""
+    spec = complex_spectrum(pad_series(series, nfft))
+    powers, wpow = whitened_powers(spec, keep)
+    return scale_spectrum(spec, powers, wpow)
+
+
 @jax.jit
 def interbin_powers(wspec: jnp.ndarray) -> jnp.ndarray:
     """Half-bin detection grid from a whitened complex spectrum —

@@ -478,18 +478,18 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                         nbins = nfft // 2 + 1
                         keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
                             if zaplist is not None else None
-                        # One rfft + one whitening estimate per chunk;
-                        # the whitened COMPLEX spectrum is shared by
-                        # the lo stage (interbinned powers) and the hi
-                        # stage (correlation input).  Zapped bins have
-                        # wpow==0 so they vanish from both.
-                        spec = fr.complex_spectrum(
-                            fr.pad_series(series, nfft))
-                        powers, wpow = fr.whitened_powers(
-                            spec,
-                            jnp.asarray(keep) if keep is not None else None)
-                        wspec = fr.scale_spectrum(spec, powers, wpow)
-                        del spec, powers, wpow
+                        # One fused pad->rfft->whiten->scale program
+                        # per chunk; the whitened COMPLEX spectrum is
+                        # shared by the lo stage (interbinned powers)
+                        # and the hi stage (correlation input).
+                        # Zapped bins have wpow==0 so they vanish
+                        # from both.
+                        wspec = (fr.whitened_spectrum_masked(
+                                     series, jnp.asarray(keep),
+                                     nfft=nfft)
+                                 if keep is not None else
+                                 fr.whitened_spectrum(series,
+                                                      nfft=nfft))
                     with timers.timing("lo-accelsearch"):
                         # half-bin detection grid (PRESTO ACCEL_DR=0.5
                         # via interbinning) — bin indices are in
@@ -998,10 +998,11 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
             series = dd.dedisperse_subbands(
                 subb, jnp.asarray(np.asarray(sub_shifts)
                                   [lo: lo + len(dm_chunk)]))
-            cspec = fr.complex_spectrum(fr.pad_series(series, nfft))
-            powers, wpow = fr.whitened_powers(
-                cspec, jnp.asarray(keep.astype(np.float32)))
-            wspec = fr.scale_spectrum(cspec, powers, wpow)
+            # bool mask, NOT float32: the bool-mask program is the one
+            # the AOT gate pre-compiles (whitened_powers casts
+            # internally, so the result is identical)
+            wspec = fr.whitened_spectrum_masked(
+                series, jnp.asarray(keep), nfft=nfft)
             cands.extend(_hi_accel_pass(wspec, dm_chunk, T_s, params))
     events = sp_k.events_from_topk(
         sp_snr[:, :ndms], sp_idx[:, :ndms], dms, dt_ds,

@@ -232,11 +232,11 @@ def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
         if dm not in series_by_dm:
             continue
         series = jnp.asarray(series_by_dm[dm])[None, :]
-        spec = fr.complex_spectrum(fr.pad_series(series, nfft))
-        powers, wpow = fr.whitened_powers(
-            spec, jnp.asarray(keep_mask) if keep_mask is not None
-            else None)
-        wspec_dev = fr.scale_spectrum(spec, powers, wpow)[0]
+        if keep_mask is not None:
+            wspec_dev = fr.whitened_spectrum_masked(
+                series, jnp.asarray(keep_mask), nfft=nfft)[0]
+        else:
+            wspec_dev = fr.whitened_spectrum(series, nfft=nfft)[0]
         nbins = int(wspec_dev.shape[0])
         ranges: list[tuple[int, int]] = []
         cand_spans: list[list[tuple[int, int]]] = []
