@@ -127,3 +127,33 @@ def test_sigma_from_power_reference_values():
     # threshold inversion round-trips
     thr = fr.power_threshold(6.0, 8)
     assert abs(fr.sigma_from_power(thr, 8) - 6.0) < 1e-3
+
+
+def test_whitened_spectrum_fusion_matches_sequence():
+    """The fused pad->rfft->whiten->scale program must reproduce the
+    separate-call sequence to float32 rounding (XLA refuses the math
+    across the fusion boundary, so bit-identity is not expected),
+    with and without a zaplist keep-mask."""
+    import numpy as np
+    import jax.numpy as jnp
+    from tpulsar.kernels import fourier as fr
+
+    rng = np.random.default_rng(3)
+    series = jnp.asarray(rng.normal(size=(3, 1000)).astype(np.float32))
+    nfft = 1024
+    nbins = nfft // 2 + 1
+
+    spec = fr.complex_spectrum(fr.pad_series(series, nfft))
+    powers, wpow = fr.whitened_powers(spec)
+    want = np.asarray(fr.scale_spectrum(spec, powers, wpow))
+    got = np.asarray(fr.whitened_spectrum(series, nfft=nfft))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    keep = np.ones(nbins, bool)
+    keep[100:120] = False
+    powers, wpow = fr.whitened_powers(spec, jnp.asarray(keep))
+    want = np.asarray(fr.scale_spectrum(spec, powers, wpow))
+    got = np.asarray(fr.whitened_spectrum_masked(
+        series, jnp.asarray(keep), nfft=nfft))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    assert np.all(got[:, 100:120] == 0)
