@@ -134,6 +134,21 @@ def boxcar_search(norm_series: jnp.ndarray,
     return jnp.stack(all_snrs), jnp.stack(all_idx)
 
 
+def device_search(series: jnp.ndarray,
+                  widths: tuple[int, ...] = DEFAULT_WIDTHS,
+                  topk: int = DEFAULT_TOPK,
+                  estimator: str | None = None):
+    """The DEVICE half of the SP search: normalize + boxcar top-k.
+    Returns the (snrs, idx) device arrays WITHOUT syncing — callers
+    that batch host transfers (the executor defers all of a pass's
+    chunks to one device_get) feed these to events_from_topk later.
+    One definition so the single-device executor, single_pulse_search,
+    and the AOT gate stay in lockstep on the exact jitted programs."""
+    norm = normalize_series(series,
+                            estimator=detrend_estimator(estimator))
+    return boxcar_search(norm, tuple(widths), topk)
+
+
 def single_pulse_search(series: jnp.ndarray, dms: np.ndarray, dt: float,
                         threshold: float = 5.0,
                         widths: tuple[int, ...] = DEFAULT_WIDTHS,
@@ -146,9 +161,7 @@ def single_pulse_search(series: jnp.ndarray, dms: np.ndarray, dt: float,
     best width — mirroring the reference's .singlepulse output columns
     (PRESTO single_pulse_search format).
     """
-    norm = normalize_series(series,
-                            estimator=detrend_estimator(estimator))
-    snrs, idx = boxcar_search(norm, tuple(widths), topk)
+    snrs, idx = device_search(series, widths, topk, estimator)
     return events_from_topk(snrs, idx, dms, dt, threshold, widths)
 
 

@@ -33,6 +33,7 @@ import dataclasses
 import os
 import tarfile
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -451,7 +452,29 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
             else:
                 chunk_sz = pass_chunk_size(
                     len(dms), ddplan.choose_n(subb.shape[1]), params)
+                # SP and lo-stage device outputs are DEFERRED to one
+                # device_get per pass (below): the per-chunk blocking
+                # np.asarray cost one host<->device round-trip per
+                # output on a tunneled runtime where latency, not
+                # compute, dominates.  Only top-k-sized blocks are
+                # held, so the deferral is KBs per chunk.  The hi
+                # stage stays inline: its internal windowed drain is
+                # the per-chunk sync that bounds device memory.
+                pending: list[tuple] = []
                 for lo in range(0, len(dms), chunk_sz):
+                    if len(pending) >= 2:
+                        # Backpressure: without any host sync in the
+                        # loop (hi off), async dispatch would let
+                        # every chunk's full-size series/wspec buffers
+                        # be enqueued concurrently — pass_chunk_size
+                        # budgets for ~one chunk resident.  Blocking
+                        # on the chunk-before-last's lo output bounds
+                        # it to two chunks in flight while still
+                        # overlapping dispatch with compute (with hi
+                        # on the accel drain already finished it;
+                        # this is then instant).
+                        with timers.timing("pipeline-wait"):
+                            jax.block_until_ready(pending[-2][4])
                     dm_chunk = dms[lo: lo + chunk_sz]
                     with timers.timing("dedispersing"):
                         series = dd.dedisperse_subbands(
@@ -466,13 +489,12 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                     T_s = nfft * dt_ds
 
                     with timers.timing("single-pulse"):
-                        ev = sp_k.single_pulse_search(
-                            series, dm_chunk, dt_ds,
-                            threshold=params.sp_threshold,
-                            widths=params.sp_widths,
+                        # the device half of single_pulse_search
+                        # (same two jitted programs); the host half
+                        # (events_from_topk) runs at pass end
+                        sp_pair = sp_k.device_search(
+                            series, tuple(params.sp_widths),
                             estimator=params.sp_detrend)
-                        if len(ev):
-                            sp_chunks.append(ev)
 
                     with timers.timing("FFT"):
                         nbins = nfft // 2 + 1
@@ -499,16 +521,43 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                             tuple(fr.harmonic_stages(
                                 params.lo_accel_numharm)),
                             params.topk_per_stage)
-                        all_cands.extend(sifting.make_candidates(
-                            res, dm_chunk, T_s, _lo_sigma_fn(nbins),
-                            sigma_min=params.sifting.sigma_threshold,
-                            bin_scale=0.5))
 
+                    hi_cands: list = []
                     if params.run_hi_accel and params.hi_accel_zmax > 0:
                         with timers.timing("hi-accelsearch"):
-                            all_cands.extend(_hi_accel_pass(
-                                wspec, dm_chunk, T_s, params))
+                            hi_cands = _hi_accel_pass(
+                                wspec, dm_chunk, T_s, params)
                     del wspec
+                    pending.append((dm_chunk, T_s, nbins, sp_pair,
+                                    res, hi_cands))
+
+                # ---- pass end: one transfer per stage family
+                # (charged to its own timer: the first get blocks on
+                # ALL the pass's queued device work, so attributing
+                # it to a compute stage would skew stage_s), then the
+                # host halves in chunk order (candidate/event
+                # ordering is unchanged from the per-chunk layout)
+                with timers.timing("pipeline-drain"):
+                    sp_host = jax.device_get(
+                        [p[3] for p in pending])
+                    lo_host = jax.device_get([p[4] for p in pending])
+                for (dm_chunk, T_s, nbins, _sp, _res,
+                     hi_cands), (snrs, idx), res_h in zip(
+                         pending, sp_host, lo_host):
+                    with timers.timing("single-pulse"):
+                        ev = sp_k.events_from_topk(
+                            snrs, idx, dm_chunk, dt_ds,
+                            threshold=params.sp_threshold,
+                            widths=tuple(params.sp_widths))
+                        if len(ev):
+                            sp_chunks.append(ev)
+                    with timers.timing("lo-accelsearch"):
+                        all_cands.extend(sifting.make_candidates(
+                            res_h, dm_chunk, T_s, _lo_sigma_fn(nbins),
+                            sigma_min=params.sifting.sigma_threshold,
+                            bin_scale=0.5))
+                    all_cands.extend(hi_cands)
+                del pending
             del subb
             if checkpoint_dir:
                 _save_pass_checkpoint(
