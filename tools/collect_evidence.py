@@ -58,11 +58,20 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", default=os.environ.get("TPULSAR_ROUND", "3"))
     ap.add_argument("--out", default=None)
+    ap.add_argument("--runs-dir", default=None,
+                    help="records directory (default bench_runs/; the "
+                         "campaign's drill mode points this at its "
+                         "isolated drill dir)")
+    ap.add_argument("--log", default=None,
+                    help="campaign log to scrape the Pallas verdict "
+                         "from (default tpu_campaign.log; the drill "
+                         "passes its own log so its evidence path is "
+                         "actually rehearsed)")
     args = ap.parse_args()
     out_path = args.out or os.path.join(
         REPO, f"BENCH_campaign_r{int(args.round):02d}.json")
 
-    runs_dir = os.path.join(REPO, "bench_runs")
+    runs_dir = args.runs_dir or os.path.join(REPO, "bench_runs")
     record: dict = {"collected_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                   time.gmtime()),
                     "runs": {}}
@@ -73,7 +82,8 @@ def main() -> None:
             parsed = _parse_last_json_line(os.path.join(runs_dir, fn))
             if parsed is not None:
                 record["runs"][fn[:-5]] = parsed
-    pallas = _pallas_verdict(os.path.join(REPO, "tpu_campaign.log"))
+    pallas = _pallas_verdict(args.log or
+                             os.path.join(REPO, "tpu_campaign.log"))
     if pallas is not None:
         record["pallas_smoke"] = pallas
     if not record["runs"] and pallas is None:

@@ -26,13 +26,55 @@ cd "$(dirname "$0")/.."
 REPO=$(pwd)
 LOG="$REPO/tpu_campaign.log"
 OUT="$REPO/bench_runs"
+
+# TPULSAR_CAMPAIGN_DRILL=1: rehearse the WHOLE campaign script on the
+# CPU backend at tiny scales — probe acceptance, lock, gate loops,
+# every bench step, the evidence trap — so a script bug cannot waste
+# the one healthy-chip window.  Drill output is isolated
+# (bench_runs_drill/, no git commit) and never mixes with real
+# evidence.
+DRILL=${TPULSAR_CAMPAIGN_DRILL:-0}
+if [ "$DRILL" = "1" ]; then
+    export JAX_PLATFORMS=cpu
+    unset PALLAS_AXON_POOL_IPS
+    OUT="$REPO/bench_runs_drill"
+    LOG="$REPO/tpu_campaign_drill.log"
+    QUICK_SCALE=0.03; QUICK_GATE_DL=300; QUICK_BUDGET=400
+    QUICK_DL=300;     QUICK_TO=500
+    FULL_GATE_ARGS="--scale 0.06 --accel"; FULL_GATE_DL=500
+    RUNG_LIST=""
+    HEAD_ENV="TPULSAR_BENCH_SCALE=0.06 TPULSAR_BENCH_LADDER=0"
+    HEAD_BUDGET=500;  HEAD_DL=400;  HEAD_TO=600
+    CFG_ENV="TPULSAR_BENCH_SCALE=0.06"
+    CFG_BUDGET=250;   CFG_DL=200;   CFG_TO=350
+    CFG4AB_BUDGET=250; CFG4AB_DL=200; CFG4AB_TO=350
+    CFG5_ENV="TPULSAR_BENCH_SCALE=0.03 TPULSAR_BENCH_NBEAMS=2"
+    CFG5_BUDGET=400;  CFG5_DL=350;  CFG5_TO=500
+else
+    QUICK_SCALE=0.25; QUICK_GATE_DL=900; QUICK_BUDGET=2700
+    QUICK_DL=1500;    QUICK_TO=2900
+    FULL_GATE_ARGS="--accel"; FULL_GATE_DL=1800
+    RUNG_LIST="0.5 0.1"
+    HEAD_ENV=""
+    HEAD_BUDGET=2400; HEAD_DL=1500; HEAD_TO=2600
+    CFG_ENV=""
+    CFG_BUDGET=1500;  CFG_DL=1200;  CFG_TO=1700
+    CFG4AB_BUDGET=1200; CFG4AB_DL=900; CFG4AB_TO=1400
+    CFG5_ENV=""
+    CFG5_BUDGET=3000; CFG5_DL=2700; CFG5_TO=3200
+fi
 mkdir -p "$OUT"
 
 # one campaign at a time: two concurrent campaigns (watcher + manual)
-# would contend for the single chip and corrupt both measurements
-exec 9> "$REPO/.campaign.lock"
+# would contend for the single chip and corrupt both measurements.
+# A DRILL never touches the chip, so it takes its own lock — holding
+# the real one would make the watcher skip probing and delay a real
+# campaign if the chip healed mid-drill.
+LOCKFILE="$REPO/.campaign.lock"
+[ "$DRILL" = "1" ] && LOCKFILE="$REPO/.campaign_drill.lock"
+exec 9> "$LOCKFILE"
 if ! flock -n 9; then
-    echo "[campaign] another campaign holds $REPO/.campaign.lock; exiting" \
+    echo "[campaign] another campaign holds $LOCKFILE; exiting" \
         | tee -a "$LOG"
     exit 5
 fi
@@ -51,6 +93,14 @@ collected=0
 collect_evidence() {
     [ "$collected" -eq 1 ] && return 0
     collected=1
+    if [ "$DRILL" = "1" ]; then
+        # drill evidence goes to an uncommitted scratch file — it
+        # must never be mistaken for on-chip measurements
+        python tools/collect_evidence.py --runs-dir "$OUT" \
+            --log "$LOG" \
+            --out /tmp/drill_campaign_evidence.json >>"$LOG" 2>&1
+        return 0
+    fi
     out=$(python tools/collect_evidence.py 2>>"$LOG") || return 0
     [ -f "$out" ] || return 0
     f=$(basename "$out")
@@ -76,13 +126,26 @@ say() { echo "[campaign $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 # miss one of the call sites.
 probe_ok() {
     timeout 150 python -c "
-import tpulsar, json, sys
-r = tpulsar.probe_device_subprocess(timeout=120)
+import os, tpulsar, json, sys
+drill = os.environ.get('TPULSAR_CAMPAIGN_DRILL', '') == '1'
+r = tpulsar.probe_device_subprocess(timeout=120, force_cpu=drill)
 print(json.dumps(r))
-sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
+sys.exit(0 if r.get('ok') and (drill or r.get('platform') != 'cpu')
+         else 1)
 " >> "$LOG" 2>&1
 }
 probe_or_abort() {
+    if [ "$DRILL" = "1" ] && \
+            ! flock -w 200 "$REPO/.campaign.lock" true; then
+        # a REAL campaign started on the healed chip: the drill must
+        # yield the single CPU core or its load inflates the real
+        # campaign's wall-clock records.  -w 200 (not -n): the
+        # watcher's health probe holds this lock for up to ~155 s
+        # each cycle, and a transient probe hold must not abort the
+        # drill — only a campaign's hours-long hold should.
+        say "DRILL YIELDS: a real campaign holds .campaign.lock"
+        exit 8
+    fi
     probe_ok || { say "ABORT: $1"; exit "$2"; }
 }
 
@@ -101,8 +164,9 @@ say "probe healthy"
 #    compile-only, streams per-program [ok] lines to the log (a hung
 #    compile is localized by name), and leaves the measured run fully
 #    cached so its stage trace measures execution, not compilation.
-say "quick datapoint: full AOT gate at 25% scale (compile-only)"
-bash tools/aot_gate_loop.sh "$LOG" 900 --scale 0.25 --accel > /dev/null
+say "quick datapoint: full AOT gate at scale $QUICK_SCALE (compile-only)"
+bash tools/aot_gate_loop.sh "$LOG" "$QUICK_GATE_DL" \
+    --scale "$QUICK_SCALE" --accel > /dev/null
 qrc=$?
 if [ $qrc -ne 0 ]; then
     # Do NOT abort the whole campaign: the full-scale gate (step 3)
@@ -112,12 +176,14 @@ if [ $qrc -ne 0 ]; then
     # blindness of the 03:49 attempt).
     say "quick datapoint SKIPPED: quarter-scale gate rc=$qrc (2=stopped converging, else compile failure/hang)"
 else
-    say "quick datapoint: 25%-scale measured run (cache warm)"
-    TPULSAR_BENCH_SCALE=0.25 TPULSAR_BENCH_LADDER=0 TPULSAR_BENCH_AOT=0 \
-    TPULSAR_BENCH_CPU_FALLBACK=0 \
-    TPULSAR_BENCH_TOTAL_BUDGET=2700 TPULSAR_BENCH_DEADLINE=1500 \
-    timeout 2900 python bench.py > "$OUT/quick_quarter.json" 2>>"$LOG"
-    say "quick 25%: $(tail -c 600 "$OUT/quick_quarter.json")"
+    say "quick datapoint: scale-$QUICK_SCALE measured run (cache warm)"
+    env TPULSAR_BENCH_SCALE="$QUICK_SCALE" TPULSAR_BENCH_LADDER=0 \
+        TPULSAR_BENCH_AOT=0 TPULSAR_BENCH_CPU_FALLBACK=0 \
+        TPULSAR_BENCH_TOTAL_BUDGET="$QUICK_BUDGET" \
+        TPULSAR_BENCH_DEADLINE="$QUICK_DL" \
+        timeout "$QUICK_TO" python bench.py \
+        > "$OUT/quick_quarter.json" 2>>"$LOG"
+    say "quick: $(tail -c 600 "$OUT/quick_quarter.json")"
 fi
 
 probe_or_abort "chip unhealthy after quick datapoint" 6
@@ -131,7 +197,7 @@ probe_or_abort "chip unhealthy after quick datapoint" 6
 # The outer timeout is only a catastrophic backstop sized far above
 # any observed single compile (accel: >7 min each on this 1-core
 # host).
-bash tools/aot_gate_loop.sh "$LOG" 1800 --accel > /dev/null
+bash tools/aot_gate_loop.sh "$LOG" "$FULL_GATE_DL" $FULL_GATE_ARGS > /dev/null
 aot_rc=$?
 if [ $aot_rc -ne 0 ]; then
     say "ABORT: aot gate rc=$aot_rc (2=stopped converging, else compile failure/crash) — full-scale programs must not run"
@@ -146,7 +212,7 @@ say "aot_check passed (full-scale programs compiled)"
 #     failure skips nothing downstream (the headline's full-scale
 #     programs are already gated); worst case the rungs compile
 #     in-line under the stall supervisor.
-for rung in 0.5 0.1; do
+for rung in $RUNG_LIST; do
     say "rung gate: compile-only at scale $rung"
     bash tools/aot_gate_loop.sh "$LOG" 900 --scale "$rung" --accel > /dev/null \
         || say "rung $rung gate incomplete (rungs may compile in-line)"
@@ -155,9 +221,10 @@ done
 # 4. headline ladder bench (generous self-run budgets; the driver's
 #    own run later reuses the warmed cache)
 say "headline bench (ladder + full scale, accel on)"
-TPULSAR_BENCH_TOTAL_BUDGET=2400 TPULSAR_BENCH_DEADLINE=1500 \
-TPULSAR_BENCH_FULL_RESERVE=600 TPULSAR_BENCH_AOT=0 \
-timeout 2600 python bench.py > "$OUT/headline.json" 2>>"$LOG"
+env $HEAD_ENV TPULSAR_BENCH_TOTAL_BUDGET="$HEAD_BUDGET" \
+    TPULSAR_BENCH_DEADLINE="$HEAD_DL" \
+    TPULSAR_BENCH_FULL_RESERVE=600 TPULSAR_BENCH_AOT=0 \
+    timeout "$HEAD_TO" python bench.py > "$OUT/headline.json" 2>>"$LOG"
 say "headline: $(tail -c 600 "$OUT/headline.json")"
 
 # stop early if the chip wedged mid-campaign
@@ -166,31 +233,43 @@ probe_or_abort "chip unhealthy after headline" 3
 # 5. focused configs
 for cfg in 1 4 3; do
     say "focused config $cfg"
-    TPULSAR_BENCH_CONFIG=$cfg TPULSAR_BENCH_TOTAL_BUDGET=1500 \
-    TPULSAR_BENCH_DEADLINE=1200 \
-    timeout 1700 python bench.py > "$OUT/config$cfg.json" 2>>"$LOG"
+    env $CFG_ENV TPULSAR_BENCH_CONFIG=$cfg \
+        TPULSAR_BENCH_TOTAL_BUDGET="$CFG_BUDGET" \
+        TPULSAR_BENCH_DEADLINE="$CFG_DL" \
+        timeout "$CFG_TO" python bench.py \
+        > "$OUT/config$cfg.json" 2>>"$LOG"
     say "config $cfg: $(tail -c 400 "$OUT/config$cfg.json")"
     probe_or_abort "chip unhealthy after config $cfg" 4
 done
 
 say "focused config 5 (8-beam steady state)"
-TPULSAR_BENCH_CONFIG=5 TPULSAR_BENCH_TOTAL_BUDGET=3000 \
-TPULSAR_BENCH_DEADLINE=2700 TPULSAR_BENCH_FULL_RESERVE=900 \
-timeout 3200 python bench.py > "$OUT/config5.json" 2>>"$LOG"
+env $CFG5_ENV TPULSAR_BENCH_CONFIG=5 \
+    TPULSAR_BENCH_TOTAL_BUDGET="$CFG5_BUDGET" \
+    TPULSAR_BENCH_DEADLINE="$CFG5_DL" TPULSAR_BENCH_FULL_RESERVE=900 \
+    timeout "$CFG5_TO" python bench.py > "$OUT/config5.json" 2>>"$LOG"
 say "config 5: $(tail -c 400 "$OUT/config5.json")"
 
 # 5b. SP detrend A/B (config 4 again with the sort-free estimator:
 #     on CPU the exact-median sort is ~3.5x the whole boxcar ladder;
 #     this run decides whether the TPU default should change)
 say "focused config 4 A/B: clipped_mean detrend"
-TPULSAR_BENCH_CONFIG=4 TPULSAR_SP_DETREND=clipped_mean \
-TPULSAR_BENCH_TOTAL_BUDGET=1200 TPULSAR_BENCH_DEADLINE=900 \
-timeout 1400 python bench.py > "$OUT/config4_clipped.json" 2>>"$LOG"
+env $CFG_ENV TPULSAR_BENCH_CONFIG=4 TPULSAR_SP_DETREND=clipped_mean \
+    TPULSAR_BENCH_TOTAL_BUDGET="$CFG4AB_BUDGET" \
+    TPULSAR_BENCH_DEADLINE="$CFG4AB_DL" \
+    timeout "$CFG4AB_TO" python bench.py \
+    > "$OUT/config4_clipped.json" 2>>"$LOG"
 say "config 4 clipped: $(tail -c 400 "$OUT/config4_clipped.json")"
 
 # 6. Pallas diagnosis: run the smoke in a subprocess and capture the
 #    REAL error text (fix-or-retire decision input)
 say "pallas smoke diagnosis"
+if [ "$DRILL" = "1" ]; then
+    # step 6 deletes and repopulates the SHARED pallas smoke cache;
+    # a CPU interpret-mode 'ok' written there would let a later real
+    # TPU run enable the kernel without ever probing the real
+    # lowering — the exact hang the subprocess smoke exists to catch
+    say "pallas step SKIPPED in drill (would poison the shared smoke cache with a CPU verdict)"
+else
 timeout 400 python -c "
 import os, sys; sys.path.insert(0, '$REPO')
 from tpulsar.kernels import pallas_dd
@@ -206,4 +285,5 @@ ok = pallas_dd.smoke_test_ok()
 print('pallas smoke:', ok)
 print('detail:', pallas_dd.LAST_SMOKE_DETAIL)
 " >> "$LOG" 2>&1
+fi
 say "=== TPU campaign done ==="

@@ -29,7 +29,10 @@ sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
     prc=$?
     if [ $prc -eq 0 ]; then
         echo "[watch $(date +%H:%M:%S)] chip healthy -> campaign" >> "$LOG"
-        bash tools/tpu_campaign.sh >> "$LOG" 2>&1
+        # never inherit a drill flag from the arming shell: a CPU
+        # drill firing here would silently burn the healthy-chip
+        # window producing no real evidence
+        env -u TPULSAR_CAMPAIGN_DRILL bash tools/tpu_campaign.sh >> "$LOG" 2>&1
         rc=$?
         echo "[watch $(date +%H:%M:%S)] campaign finished rc=$rc" >> "$LOG"
         # only disarm on a completed campaign — an abort (e.g. the
