@@ -50,6 +50,14 @@ if [ "$DRILL" = "1" ]; then
     CFG4AB_BUDGET=250; CFG4AB_DL=200; CFG4AB_TO=350
     CFG5_ENV="TPULSAR_BENCH_SCALE=0.03 TPULSAR_BENCH_NBEAMS=2"
     CFG5_BUDGET=400;  CFG5_DL=350;  CFG5_TO=500
+    HEAD_RESERVE=60;  CFG5_RESERVE=60
+    QUICK_OUT=quick_drill.json
+    # drill benches take the REAL lock (not LOCK_HELD-exempt): the
+    # lock is what serializes CPU load with a real campaign.  210 s
+    # outlasts the watcher's ~155 s probe holds but is far below a
+    # campaign, so a held-by-campaign lock makes the bench emit its
+    # campaign_lock_timeout record and the next probe_or_abort yields.
+    export TPULSAR_BENCH_LOCK_WAIT=210
 else
     QUICK_SCALE=0.25; QUICK_GATE_DL=900; QUICK_BUDGET=2700
     QUICK_DL=1500;    QUICK_TO=2900
@@ -62,6 +70,8 @@ else
     CFG4AB_BUDGET=1200; CFG4AB_DL=900; CFG4AB_TO=1400
     CFG5_ENV=""
     CFG5_BUDGET=3000; CFG5_DL=2700; CFG5_TO=3200
+    HEAD_RESERVE=600; CFG5_RESERVE=900
+    QUICK_OUT=quick_quarter.json
 fi
 mkdir -p "$OUT"
 
@@ -78,10 +88,13 @@ if ! flock -n 9; then
         | tee -a "$LOG"
     exit 5
 fi
-# Benches spawned by THIS campaign must not try to take the lock we
-# already hold (bench.py waits on it to avoid racing a campaign for
-# the single chip — see _acquire_campaign_lock)
-export TPULSAR_CAMPAIGN_LOCK_HELD=1
+# Benches spawned by a REAL campaign must not try to take the lock
+# we already hold (bench.py waits on it to avoid racing a campaign
+# for the single chip — see _acquire_campaign_lock).  DRILL benches
+# do NOT get the exemption: they hold .campaign_drill.lock only, and
+# taking the real lock per bench step is what keeps drill CPU load
+# serialized against a real campaign that starts mid-step.
+[ "$DRILL" = "1" ] || export TPULSAR_CAMPAIGN_LOCK_HELD=1
 
 # Whatever evidence landed, fold it into a COMMITTED record on every
 # exit (abort included): bench_runs/ is gitignored working space, and
@@ -182,8 +195,8 @@ else
         TPULSAR_BENCH_TOTAL_BUDGET="$QUICK_BUDGET" \
         TPULSAR_BENCH_DEADLINE="$QUICK_DL" \
         timeout "$QUICK_TO" python bench.py \
-        > "$OUT/quick_quarter.json" 2>>"$LOG"
-    say "quick: $(tail -c 600 "$OUT/quick_quarter.json")"
+        > "$OUT/$QUICK_OUT" 2>>"$LOG"
+    say "quick: $(tail -c 600 "$OUT/$QUICK_OUT")"
 fi
 
 probe_or_abort "chip unhealthy after quick datapoint" 6
@@ -223,7 +236,7 @@ done
 say "headline bench (ladder + full scale, accel on)"
 env $HEAD_ENV TPULSAR_BENCH_TOTAL_BUDGET="$HEAD_BUDGET" \
     TPULSAR_BENCH_DEADLINE="$HEAD_DL" \
-    TPULSAR_BENCH_FULL_RESERVE=600 TPULSAR_BENCH_AOT=0 \
+    TPULSAR_BENCH_FULL_RESERVE="$HEAD_RESERVE" TPULSAR_BENCH_AOT=0 \
     timeout "$HEAD_TO" python bench.py > "$OUT/headline.json" 2>>"$LOG"
 say "headline: $(tail -c 600 "$OUT/headline.json")"
 
@@ -245,7 +258,8 @@ done
 say "focused config 5 (8-beam steady state)"
 env $CFG5_ENV TPULSAR_BENCH_CONFIG=5 \
     TPULSAR_BENCH_TOTAL_BUDGET="$CFG5_BUDGET" \
-    TPULSAR_BENCH_DEADLINE="$CFG5_DL" TPULSAR_BENCH_FULL_RESERVE=900 \
+    TPULSAR_BENCH_DEADLINE="$CFG5_DL" \
+    TPULSAR_BENCH_FULL_RESERVE="$CFG5_RESERVE" \
     timeout "$CFG5_TO" python bench.py > "$OUT/config5.json" 2>>"$LOG"
 say "config 5: $(tail -c 400 "$OUT/config5.json")"
 
