@@ -327,11 +327,9 @@ def main() -> int:
             check(f"whitened_spectrum_masked {tag}",
                   fr.whitened_spectrum_masked,
                   sers, S((nbins,), jnp.bool_), nfft=nfft)
-            check(f"interbin_powers {tag}",
-                  fr.interbin_powers, S((rows, nbins), jnp.complex64))
             check(f"lo_stages {tag}",
-                  fr.all_stage_candidates,
-                  S((rows, 2 * nbins), jnp.float32),
+                  fr.lo_stage_candidates,
+                  S((rows, nbins), jnp.complex64),
                   tuple(fr.harmonic_stages(_sp.lo_accel_numharm)),
                   _sp.topk_per_stage)
             if args.accel:

@@ -336,6 +336,17 @@ def all_stage_candidates(powers: jnp.ndarray, stages: tuple[int, ...],
     return {h: stage_candidates(powers, h, topk) for h in stages}
 
 
+@partial(jax.jit, static_argnames=("stages", "topk"))
+def lo_stage_candidates(wspec: jnp.ndarray, stages: tuple[int, ...],
+                        topk: int) -> dict:
+    """interbin + every harmonic stage's top-k as ONE program: the
+    interbinned half-bin power grid is (rows, 2*nbins) float32 —
+    ~2.5 GB at survey scale — and fusing keeps it out of HBM as a
+    materialized intermediate between two separately compiled
+    programs."""
+    return all_stage_candidates(interbin_powers(wspec), stages, topk)
+
+
 # ----------------------------------------------------------- significance
 
 def sigma_from_power(summed_power, numharm: int, numindep: int = 1):

@@ -515,9 +515,11 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                     with timers.timing("lo-accelsearch"):
                         # half-bin detection grid (PRESTO ACCEL_DR=0.5
                         # via interbinning) — bin indices are in
-                        # half-bin units, hence bin_scale=0.5
-                        res = fr.all_stage_candidates(
-                            fr.interbin_powers(wspec),
+                        # half-bin units, hence bin_scale=0.5; one
+                        # fused program so the (rows, 2*nbins)
+                        # interbinned grid never round-trips HBM
+                        res = fr.lo_stage_candidates(
+                            wspec,
                             tuple(fr.harmonic_stages(
                                 params.lo_accel_numharm)),
                             params.topk_per_stage)
