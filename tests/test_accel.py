@@ -121,3 +121,67 @@ def test_forced_fallback_matches_batch(monkeypatch):
         np.testing.assert_allclose(batched[h][0], fallback[h][0], rtol=2e-4)
         np.testing.assert_array_equal(batched[h][1], fallback[h][1])
         np.testing.assert_array_equal(batched[h][2], fallback[h][2])
+
+
+def test_bf16_plane_optin_matches_f32(monkeypatch):
+    """TPULSAR_ACCEL_PLANE_DTYPE=bf16 halves the plane's HBM
+    footprint for on-chip A/B.  Exercise the REAL opt-in path (env +
+    module reload) and require: bf16 plane dtype in the shipped
+    correlation, float32 accumulation, the same winning (z, r) cell,
+    < 1% relative power difference, and a larger plane_dm_chunk."""
+    import importlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpulsar.kernels import accel as ak
+
+    rng = np.random.default_rng(1)
+    spec = (rng.normal(size=4000) + 1j * rng.normal(size=4000)
+            ).astype(np.complex64)
+    spec[777] += 30.0            # strong tone
+    bank = ak.build_template_bank(8.0, seg=1 << 11)
+
+    def summed_with(dtype_name):
+        monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", dtype_name)
+        mod = importlib.reload(ak)
+        plane = mod._correlate_segments(
+            jnp.asarray(spec), jnp.asarray(bank.bank_fft), bank.seg,
+            bank.step, bank.width)
+        assert plane.dtype == mod.PLANE_DTYPE
+        out = np.asarray(mod._harmonic_sum_plane(
+            plane, 2, len(bank.zs)))
+        chunk = mod.plane_dm_chunk(1 << 21, len(bank.zs))
+        return out, chunk
+
+    try:
+        summed_f32, chunk_f32 = summed_with("f32")
+        summed_b16, chunk_b16 = summed_with("bf16")
+    finally:
+        monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", "f32")
+        importlib.reload(ak)
+
+    assert summed_b16.dtype == np.float32   # f32 accumulation
+    assert (np.unravel_index(summed_b16.argmax(), summed_b16.shape)
+            == np.unravel_index(summed_f32.argmax(), summed_f32.shape))
+    rel = abs(summed_b16.max() - summed_f32.max()) / summed_f32.max()
+    assert rel < 0.01, rel
+    assert chunk_b16 > chunk_f32   # the HBM saving is real
+
+
+def test_plane_dtype_env_rejects_unknown(monkeypatch):
+    """A typo'd dtype env must raise at import, not silently fall
+    back to f32 (an A/B would then compare f32 against itself)."""
+    import importlib
+
+    import pytest
+
+    from tpulsar.kernels import accel as ak
+
+    monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", "bfloat16")
+    try:
+        with pytest.raises(ValueError, match="f32.*bf16"):
+            importlib.reload(ak)
+    finally:
+        monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", "f32")
+        importlib.reload(ak)

@@ -122,8 +122,8 @@ def _correlate_segments(spectrum: jnp.ndarray, bank_fft: jnp.ndarray,
     """Overlap-save matched filter of one complex spectrum against
     the half-bin template bank.
 
-    spectrum: (nbins,) complex64.  Returns (nz, 2*nbins) float32
-    powers on the numbetween=2 HALF-BIN grid: plane index 2r
+    spectrum: (nbins,) complex64.  Returns (nz, 2*nbins)
+    PLANE_DTYPE powers on the numbetween=2 HALF-BIN grid: plane index 2r
     corresponds to spectrum bin r (PRESTO searches the accel plane at
     ACCEL_DR = 0.5; a dr=1 grid loses up to ~64% of a half-bin
     signal's power to scalloping).
@@ -146,8 +146,9 @@ def _correlate_segments(spectrum: jnp.ndarray, bank_fft: jnp.ndarray,
         seg_data = jax.lax.dynamic_slice(padded, (s0,), (seg,))
         f = jnp.fft.fft(_interleave_zeros(seg_data))
         corr = jnp.fft.ifft(f[None, :] * bank_fft, axis=-1)
-        return jnp.abs(corr[:, 2 * width - 1:
-                            2 * width - 1 + 2 * step]) ** 2
+        return (jnp.abs(corr[:, 2 * width - 1:
+                             2 * width - 1 + 2 * step]) ** 2
+                ).astype(PLANE_DTYPE)
 
     planes = jax.lax.map(one_seg, starts)          # (nsegs, nz, 2*step)
     plane = jnp.transpose(planes, (1, 0, 2)).reshape(
@@ -173,12 +174,14 @@ def _harmonic_sum_plane(plane: jnp.ndarray, numharm: int, nz: int) -> jnp.ndarra
     center = (nz - 1) // 2
     nr = plane.shape[1]
     L = nr // numharm
-    acc = plane[:, :L]
+    # accumulate in float32 regardless of the plane's storage dtype
+    # (bf16 storage must not degrade into bf16 accumulation)
+    acc = plane[:, :L].astype(jnp.float32)
     for h in range(2, numharm + 1):
         zi = jnp.arange(nz)
         zi_h = jnp.clip(center + (zi - center) * h, 0, nz - 1)
         rows = plane[zi_h]                 # (nz, nr) rows at harmonic z
-        acc = acc + rows[:, ::h][:, :L]
+        acc = acc + rows[:, ::h][:, :L].astype(jnp.float32)
     return acc
 
 
@@ -211,6 +214,24 @@ def _accel_plane_topk(spectrum, bank_fft, seg, step, width, nz,
 PLANE_HBM_BUDGET = int(float(os.environ.get(
     "TPULSAR_ACCEL_HBM_GB", "4")) * (1 << 30))
 
+# TPULSAR_ACCEL_PLANE_DTYPE=bf16: store the (nz, 2*nbins) correlation
+# power plane in bfloat16 instead of float32.  OPT-IN, for on-chip
+# A/B only: it halves the hi-accel stage's dominant HBM footprint
+# (doubling plane_dm_chunk at survey scale, so half the dispatches),
+# at ~0.4% relative power error — harmonic sums still ACCUMULATE in
+# float32, only plane storage narrows.  Default float32 preserves
+# PRESTO-parity numerics exactly.
+_PLANE_DTYPE_NAME = os.environ.get("TPULSAR_ACCEL_PLANE_DTYPE",
+                                   "f32").strip().lower()
+if _PLANE_DTYPE_NAME not in ("f32", "bf16"):
+    raise ValueError(
+        f"TPULSAR_ACCEL_PLANE_DTYPE must be 'f32' or 'bf16', got "
+        f"{_PLANE_DTYPE_NAME!r} (a silently ignored value would make "
+        "an on-chip A/B compare f32 against itself)")
+PLANE_DTYPE = jnp.bfloat16 if _PLANE_DTYPE_NAME == "bf16" \
+    else jnp.float32
+PLANE_ITEMSIZE = jnp.dtype(PLANE_DTYPE).itemsize
+
 # z-templates correlated per inverse-FFT call in the batched path;
 # bounds the (nd*nsegs*Z_CHUNK, seg) intermediate.
 Z_CHUNK = 4
@@ -227,15 +248,17 @@ def plane_dm_chunk(nbins: int, nz: int, max_chunk: int = 32) -> int:
     correlation planes + per-stage intermediates fit the HBM budget
     (round-1 used a fixed chunk of 4 -> ~318 dispatches per beam).
 
-    Live bytes per DM in the batched path: the float32 plane (once in
-    the per-z-chunk pieces and once more while jnp.concatenate builds
-    the full plane), the summed/zmax stage intermediates (~1x plane),
-    and the complex64 overlap-save intermediates (segs + their FFT at
-    ~16 B/bin plus the (Z_CHUNK, seg) product/ifft at ~≈65 B/bin with
-    batch padding slop)."""
+    Live bytes per DM in the batched path: the PLANE_DTYPE plane
+    (once in the per-z-chunk pieces and once more while
+    jnp.concatenate builds the full plane), the summed/zmax stage
+    intermediates (ALWAYS float32 — _harmonic_sum_plane accumulates
+    in f32 even for a bf16 plane), and the complex64 overlap-save
+    intermediates (segs + their FFT at ~16 B/bin plus the
+    (Z_CHUNK, seg) product/ifft at ~65 B/bin with batch padding
+    slop)."""
     # x2 throughout: the numbetween=2 plane is 2*nbins wide and the
     # interpolated iffts are 2*seg long
-    per_dm = nz * nbins * 4 * 3 * 2 + nbins * 192
+    per_dm = nz * nbins * 2 * (2 * PLANE_ITEMSIZE + 4) + nbins * 192
     return max(1, min(max_chunk, PLANE_HBM_BUDGET // max(per_dm, 1)))
 
 
@@ -253,7 +276,8 @@ def _correlate_block(specs: jnp.ndarray, bank_fft: jnp.ndarray,
                      nz: int) -> jnp.ndarray:
     """Overlap-save correlation of a DM block against the whole bank.
 
-    specs: (nd, nbins) complex64 -> (nd, nz, nbins) float32 powers.
+    specs: (nd, nbins) complex64 -> (nd, nz, nbins) PLANE_DTYPE
+    powers.
 
     Everything is expressed as rank-2 FFTs over flattened, padded
     batches and a static Python loop over z chunks: no vmap-of-scan,
@@ -280,8 +304,9 @@ def _correlate_block(specs: jnp.ndarray, bank_fft: jnp.ndarray,
                       FFT_BATCH_PAD), axis=-1)[: nd * nsegs * zc]
         corr = corr.reshape(nd, nsegs, zc, 2 * seg)
         # linear-valid region and alignment: see _correlate_segments
-        pw = jnp.abs(corr[..., 2 * width - 1:
-                          2 * width - 1 + 2 * step]) ** 2
+        pw = (jnp.abs(corr[..., 2 * width - 1:
+                           2 * width - 1 + 2 * step]) ** 2
+              ).astype(PLANE_DTYPE)
         # (nd, zc, nsegs*2*step)
         planes.append(jnp.transpose(pw, (0, 2, 1, 3)).reshape(
             nd, zc, nsegs * 2 * step))
