@@ -62,8 +62,13 @@ else
     QUICK_SCALE=0.25; QUICK_GATE_DL=900; QUICK_BUDGET=2700
     QUICK_DL=1500;    QUICK_TO=2900
     FULL_GATE_ARGS="--accel"; FULL_GATE_DL=1800
-    RUNG_LIST="0.5 0.1"
-    HEAD_ENV=""
+    # No rung gates / no ladder in the real campaign: the 25% quick
+    # datapoint already is the stepping stone, and with the full gate
+    # + stall supervision the ladder's two extra measured runs (plus
+    # two compile-only gates) cost ~1.5 h of a possibly short
+    # healthy-chip window for little added evidence.
+    RUNG_LIST=""
+    HEAD_ENV="TPULSAR_BENCH_LADDER=0"
     HEAD_BUDGET=2400; HEAD_DL=1500; HEAD_TO=2600
     CFG_ENV=""
     CFG_BUDGET=1500;  CFG_DL=1200;  CFG_TO=1700
