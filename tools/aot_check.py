@@ -367,6 +367,16 @@ def main() -> int:
     check("whitened_spectrum_masked rows=1",
           fr.whitened_spectrum_masked, S((1, nsamp), jnp.float32),
           S((nbins_full,), jnp.bool_), nfft=nfft_full)
+    # refine_candidates' window gather: the one runtime device
+    # program that used to sit outside this gate (round-3 advisor
+    # finding).  Its (count, width) space is now closed — count is
+    # always refine._NWIN, width one of refine._WIDTH_BUCKETS — so
+    # gate every member against the full-resolution spectrum shape.
+    from tpulsar.search import refine as _refine
+    for w in _refine._WIDTH_BUCKETS:
+        check(f"refine_gather width={w}", _refine._gather_jit(),
+              S((nbins_full,), jnp.complex64),
+              S((_refine._NWIN,), jnp.int32), width=w)
     # Dense sweep: pad buckets are powers of two, so the LOW buckets
     # occupy DM intervals much narrower than a coarse sample spacing
     # (the (256, 512) pair lives in DM ~15-31 alone) — 2048 samples

@@ -56,7 +56,7 @@ def _pallas_verdict(log_path: str) -> dict | None:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", default=os.environ.get("TPULSAR_ROUND", "3"))
+    ap.add_argument("--round", default=os.environ.get("TPULSAR_ROUND", "4"))
     ap.add_argument("--out", default=None)
     ap.add_argument("--runs-dir", default=None,
                     help="records directory (default bench_runs/; the "

@@ -130,10 +130,14 @@ collect_evidence() {
     git diff --cached --quiet -- "$f" || git commit -q -m \
         "Record on-chip campaign evidence ($f)" -- "$f" >>"$LOG" 2>&1
 }
-# INT/TERM included: a default-SIGTERM kill of the campaign tree is
-# the common abort mode, and bash does not run an EXIT trap on an
-# untrapped fatal signal
-trap collect_evidence EXIT INT TERM
+# INT/TERM trapped separately and TERMINALLY: bash does not run an
+# EXIT trap on an untrapped fatal signal, but a non-exiting INT/TERM
+# trap is worse — bash would resume the script after the handler, so
+# an aborted campaign would keep running chip steps with the
+# collected=1 latch suppressing all later evidence collection.
+trap collect_evidence EXIT
+trap 'collect_evidence; exit 130' INT
+trap 'collect_evidence; exit 143' TERM
 
 say() { echo "[campaign $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 

@@ -336,9 +336,14 @@ def _budget_dm_chunk(nfft: int, hi: bool, budget: int) -> int:
     harmonic-sum intermediate (2x f32, ~nfft bins = 4*nfft each).
     `hi` keeps a modest surcharge for the accel stage's top-k
     bookkeeping riding alongside (the big accel planes have their own
-    budget, accel.plane_dm_chunk)."""
+    budget, accel.plane_dm_chunk).  With hi OFF the pass loop keeps
+    TWO chunks in flight (backpressure blocks on the chunk-before-
+    last), so the second chunk's series + scaled spectrum (4 + 4
+    bytes/bin/trial) ride alongside — budget for them, or the
+    transient overcommit is ~25% on a device where a runtime OOM
+    wedges the chip for hours (round-3 advisor finding)."""
     per_trial = (4 + 4 + 4 + 2 + 2 + 4 + 4 + 4
-                 + (2 if hi else 0)) * nfft
+                 + (2 if hi else 8)) * nfft
     return max(4, int(budget // per_trial))
 
 

@@ -128,15 +128,42 @@ def _pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
 
-def _gather_windows(spec_dev, lows: np.ndarray, width: int):
-    """Fetch len(lows) windows of `width` bins each from a
-    device-resident 1-D spectrum in ONE jitted gather + ONE
-    device_get.  Eager per-window slicing of a complex device array
-    is rejected by some TPU runtimes (see accel.accel_row_topk), and
-    each distinct (lo, hi) pair would otherwise lower its own tiny
-    slice program — unbounded data-dependent compiles.  lows count
-    and width are pow2-bucketed by the caller so the program set
-    stays small."""
+# The gather program set must be CLOSED so tools/aot_check.py can
+# compile-gate every member before a measured on-chip run (an in-line
+# remote compile inside the measured child is this project's
+# documented wedge hazard): window count is always _NWIN (callers
+# chunk + pad), width comes from _WIDTH_BUCKETS.  512 covers typical
+# lo-stage candidates (template_width <= 256 plus slack); 8192 covers
+# the worst survey case (h=16 at z0=zmax=200 -> template_width 4096
+# plus harmonic slack); 2048 keeps the common hi-z cases off the
+# 8192 transfer size.
+_NWIN = 64
+_WIDTH_BUCKETS = (512, 2048, 8192)
+
+
+def _width_bucket(span: int) -> int:
+    for w in _WIDTH_BUCKETS:
+        if span <= w:
+            return w
+    # Beyond-survey fallback: correct, but the resulting gather
+    # program is OUTSIDE the AOT-gated set — on the tunneled TPU
+    # runtime that means a silent in-line remote compile inside the
+    # measured run (the documented wedge hazard).  Shout so the
+    # campaign log can localize the hang.
+    import logging
+
+    logging.getLogger("tpulsar.refine").warning(
+        "refine window span %d exceeds every gated width bucket %s; "
+        "this gather will compile in-line (ungated program)",
+        span, _WIDTH_BUCKETS)
+    return _pow2(span)
+
+
+def _gather_jit():
+    """The (lazily created) jitted window gather, exposed so
+    tools/aot_check.py can lower the exact runtime callable (the
+    lambda-wrapping pitfall of round 3 produced different persistent-
+    cache keys than the runtime's own calls)."""
     import jax
     import jax.numpy as jnp
 
@@ -148,9 +175,7 @@ def _gather_windows(spec_dev, lows: np.ndarray, width: int):
             return jnp.take(spec, idx, axis=0)
 
         _GATHER_JIT = jax.jit(_gather, static_argnames=("width",))
-    return np.asarray(jax.device_get(
-        _GATHER_JIT(spec_dev, jnp.asarray(lows, np.int32),
-                    width=width)))
+    return _GATHER_JIT
 
 
 _GATHER_JIT = None
@@ -245,16 +270,32 @@ def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
                                       c.numharm, nbins)
             cand_spans.append(spans)
             ranges.extend(spans)
-        # One jitted gather of pow2-bucketed (count, width), then one
-        # transfer: eager complex slicing is rejected by some TPU
-        # runtimes, and per-window slice programs would be unbounded
-        # data-dependent compiles.
-        width = _pow2(max(hi - lo for lo, hi in ranges))
-        nwin = _pow2(len(ranges))
-        lows = np.fromiter((lo for lo, _ in ranges), np.int32,
-                           len(ranges))
-        lows = np.pad(lows, (0, nwin - len(ranges)))
-        fetched = _gather_windows(wspec_dev, lows, width)
+        # Jitted gathers in fixed _NWIN chunks at a bucketed width:
+        # eager per-window slicing of a complex device array is
+        # rejected by some TPU runtimes (see accel.accel_row_topk),
+        # and per-window slice programs would be unbounded
+        # data-dependent compiles — the fixed (count, width) buckets
+        # keep the program set closed so the AOT gate covers it.
+        # All chunks are dispatched async, then ONE device_get drains
+        # them together (the tunnel's latency, not compute,
+        # dominates; a blocking get per chunk would serialize
+        # ceil(n/64) round-trips).
+        import jax
+
+        width = _width_bucket(max(hi - lo for lo, hi in ranges))
+        lows_all = np.fromiter((lo for lo, _ in ranges), np.int32,
+                               len(ranges))
+        gather = _gather_jit()
+        chunks_dev = []
+        for s in range(0, len(ranges), _NWIN):
+            lows = lows_all[s: s + _NWIN]
+            lows = np.pad(lows, (0, _NWIN - len(lows)))
+            chunks_dev.append(gather(wspec_dev,
+                                     jnp.asarray(lows, np.int32),
+                                     width=width))
+        fetched = np.concatenate(
+            [np.asarray(c) for c in jax.device_get(chunks_dev)],
+            axis=0)
         windows = [(lo, fetched[i][: min(width, nbins - lo)])
                    for i, (lo, _hi) in enumerate(ranges)]
         i = 0
