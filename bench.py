@@ -773,13 +773,28 @@ def main() -> None:
                                  force_cpu=True)
         if cpu_probe is None:
             return
+        # hi-accel ON: it is 85%+ of the real workload's wall-clock,
+        # so an accel-off fallback number says nothing about the hot
+        # path (round-3 verdict weak #5).  Measured 2026-07-31 on
+        # this 1-core host: accel-on CPU = 199.7 s at scale 0.0833,
+        # 73 s at 0.02.  The cap can be far below 600 s when earlier
+        # phases (slow probe, AOT gate down to the reserve) ate the
+        # budget — shrink the scale rather than lose the evidence
+        # child to a SIGKILL, and only as a last resort drop accel.
+        cap = min(deadline, 600.0, remaining())
+        if cap >= 320.0:
+            fb_scale, fb_accel = "0.0833", "1"
+        elif cap >= 130.0:
+            fb_scale, fb_accel = "0.02", "1"
+        else:
+            fb_scale, fb_accel = "0.02", "0"
+        fb_scale = os.environ.get("TPULSAR_BENCH_CPU_SCALE", fb_scale)
         _, fb = run_child(
-            min(deadline, 600.0, remaining()),
+            cap,
             extra_env={
                 "JAX_PLATFORMS": "cpu",
-                "TPULSAR_BENCH_SCALE":
-                    os.environ.get("TPULSAR_BENCH_CPU_SCALE", "0.0833"),
-                "TPULSAR_BENCH_ACCEL": "0",
+                "TPULSAR_BENCH_SCALE": fb_scale,
+                "TPULSAR_BENCH_ACCEL": fb_accel,
                 # the evidence run is ALWAYS one reduced-scale
                 # headline beam: never inherit a focused config or a
                 # multi-beam batch into the CPU fallback
@@ -792,9 +807,9 @@ def main() -> None:
         if fb is not None:
             rec["cpu_fallback"] = {
                 "value_s": fb["value"],
-                "scale": float(os.environ.get(
-                    "TPULSAR_BENCH_CPU_SCALE", "0.0833")),
-                "accel_stage": False,
+                "scale": float(fb_scale),
+                "accel_stage": bool(fb.get("accel_stage",
+                                           fb_accel == "1")),
                 "dm_trials": fb.get("dm_trials"),
                 "dm_trials_per_sec": fb.get("dm_trials_per_sec"),
                 "injected_pulsar_recovered":
