@@ -148,7 +148,7 @@ def test_bf16_plane_optin_matches_f32(monkeypatch):
         plane = mod._correlate_segments(
             jnp.asarray(spec), jnp.asarray(bank.bank_fft), bank.seg,
             bank.step, bank.width)
-        assert plane.dtype == mod.PLANE_DTYPE
+        assert plane.dtype == mod.plane_dtype()
         out = np.asarray(mod._harmonic_sum_plane(
             plane, 2, len(bank.zs)))
         chunk = mod.plane_dm_chunk(1 << 21, len(bank.zs))
@@ -185,3 +185,75 @@ def test_plane_dtype_env_rejects_unknown(monkeypatch):
     finally:
         monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", "f32")
         importlib.reload(ak)
+
+
+def test_native_host_path_matches_xla(monkeypatch):
+    """The CPU product path (native plane consumer,
+    tpulsar/native/accel_host.cpp) must be BIT-identical to the pure
+    XLA _accel_block_topk extraction — same f32 addition order, same
+    tie-breaking, same padding — across bank/shape/stage variants,
+    including a non-pow2 nbins and a topk larger than the block
+    count."""
+    import jax.numpy as jnp
+
+    from tpulsar import native
+    from tpulsar.kernels import accel as ak
+    from tpulsar.kernels.fourier import BLOCK_R, harmonic_stages
+
+    if native.load() is None:
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(11)
+    cases = [(8.0, 6000, 3, 8, 16), (20.0, 1 << 13, 2, 16, 64),
+             (8.0, 700, 1, 4, 64)]
+    for zmax, nbins, nd, mh, topk in cases:
+        bank = ak.build_template_bank(zmax, seg=1 << 11)
+        nz = len(bank.zs)
+        specs = jnp.asarray(
+            (rng.normal(size=(nd, nbins))
+             + 1j * rng.normal(size=(nd, nbins))).astype(np.complex64))
+        bf = jnp.asarray(bank.bank_fft)
+        want = ak._accel_block_topk(specs, bf, bank.seg, bank.step,
+                                    bank.width, nz, mh, topk)
+        stages = harmonic_stages(mh)
+        # plane-layout kernel
+        plane = np.asarray(ak._correlate_block(
+            specs, bf, bank.seg, bank.step, bank.width, nz))
+        got_p = native.accel_stage_topk(plane, stages, BLOCK_R, topk)
+        # raw-pieces kernel (the product path's actual input layout)
+        pieces = np.asarray(ak._correlate_pieces(
+            specs, bf, seg=bank.seg, step=bank.step, width=bank.width,
+            nz=nz))
+        got_s = native.accel_stage_topk_segs(
+            pieces, bank.width, 2 * nbins, stages, BLOCK_R, topk)
+        for got in (got_p, got_s):
+            assert got is not None
+            for i, w in enumerate(want):
+                np.testing.assert_array_equal(got[i], np.asarray(w))
+
+
+def test_native_search_batch_equals_forced_xla(monkeypatch):
+    """accel_search_batch via the native CPU path returns exactly the
+    forced-XLA result (the executor consumes this surface)."""
+    import jax.numpy as jnp
+
+    from tpulsar import native
+    from tpulsar.kernels import accel as ak
+
+    if native.load() is None:
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(12)
+    bank = ak.build_template_bank(10.0, seg=1 << 11)
+    specs = jnp.asarray(
+        (rng.normal(size=(5, 5000))
+         + 1j * rng.normal(size=(5, 5000))).astype(np.complex64))
+    monkeypatch.delenv("TPULSAR_ACCEL_NATIVE", raising=False)
+    got = ak.accel_search_batch(specs, bank, max_numharm=8, topk=16,
+                                dm_chunk=2)
+    monkeypatch.setenv("TPULSAR_ACCEL_NATIVE", "0")
+    want = ak.accel_search_batch(specs, bank, max_numharm=8, topk=16,
+                                 dm_chunk=2)
+    assert set(got) == set(want)
+    for h in want:
+        for i in range(3):
+            np.testing.assert_array_equal(np.asarray(got[h][i]),
+                                          np.asarray(want[h][i]))

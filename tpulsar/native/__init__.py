@@ -17,7 +17,8 @@ import threading
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "unpack.cpp")
+_SRCS = [os.path.join(_HERE, "unpack.cpp"),
+         os.path.join(_HERE, "accel_host.cpp")]
 _LIB = os.path.join(_HERE, "_tpulsar_native.so")
 
 _lock = threading.Lock()
@@ -26,11 +27,24 @@ _tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           _SRC, "-o", _LIB]
+    # -ffp-contract=off: -march=native would otherwise let the
+    # compiler contract a*b+c into FMA, changing float rounding vs
+    # the NumPy oracles (and the XLA path) these kernels must match
+    # bit-for-bit
+    cmd = ["g++", "-O3", "-march=native", "-ffp-contract=off",
+           "-shared", "-fPIC", "-std=c++17", *_SRCS, "-o", _LIB]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=120)
+                           timeout=240)
+        if r.returncode != 0:
+            # -march=native can be unavailable in odd toolchains;
+            # retry portable before giving up (keeping
+            # -ffp-contract=off: FMA-baseline targets would otherwise
+            # contract a*b+c and break the bit-parity invariant)
+            cmd = ["g++", "-O3", "-ffp-contract=off", "-shared",
+                   "-fPIC", "-std=c++17", *_SRCS, "-o", _LIB]
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=240)
         return r.returncode == 0 and os.path.exists(_LIB)
     except (OSError, subprocess.TimeoutExpired):
         return False
@@ -44,8 +58,9 @@ def load() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or (
-                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        if not os.path.exists(_LIB) or any(
+                os.path.getmtime(_LIB) < os.path.getmtime(s)
+                for s in _SRCS):
             if not _build():
                 return None
         try:
@@ -66,6 +81,18 @@ def load() -> ctypes.CDLL | None:
         lib.tpulsar_unpack4_q8.argtypes = [
             u8p, u8p, ctypes.c_size_t, ctypes.c_size_t, f32p, f32p]
         lib.tpulsar_unpack4_q8.restype = None
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.tpulsar_accel_stage_topk.argtypes = [
+            f32p, ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+            i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            f32p, i32p, i32p]
+        lib.tpulsar_accel_stage_topk.restype = None
+        lib.tpulsar_accel_stage_topk_segs.argtypes = [
+            f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            f32p, i32p, i32p]
+        lib.tpulsar_accel_stage_topk_segs.restype = None
         _lib = lib
         return _lib
 
@@ -104,6 +131,64 @@ def unpack4_quantize(raw: np.ndarray, a: np.ndarray,
     out = np.empty((nspec, nchan), dtype=np.uint8)
     lib.tpulsar_unpack4_q8(raw, out, nspec, nchan, a, b)
     return out
+
+
+def accel_stage_topk(plane: np.ndarray, stages, block_r: int,
+                     topk: int):
+    """Harmonic-stage sums + per-stage block-max top-k over a
+    correlation power plane, bit-identical to the XLA path in
+    kernels/accel.py (_harmonic_stage_maxes + fourier.blockmax_topk)
+    but cache-tiled for host DRAM bandwidth.
+
+    plane: (nd, nz, nr) float32.  Returns (vals, rbins, zidx) each
+    (nd, nstages, topk), or None if the native library is
+    unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    if plane.dtype != np.float32 or plane.ndim != 3:
+        return None
+    stages = np.ascontiguousarray(stages, dtype=np.int32)
+    if stages.size == 0 or stages[0] != 1:
+        return None     # the kernel seeds its accumulator at stage 1
+    plane = np.ascontiguousarray(plane)
+    nd, nz, nr = plane.shape
+    ns = int(stages.size)
+    vals = np.empty((nd, ns, topk), np.float32)
+    rbins = np.empty((nd, ns, topk), np.int32)
+    zidx = np.empty((nd, ns, topk), np.int32)
+    lib.tpulsar_accel_stage_topk(plane, nd, nz, nr, stages, ns,
+                                 int(block_r), int(topk),
+                                 vals, rbins, zidx)
+    return vals, rbins, zidx
+
+
+def accel_stage_topk_segs(pieces: np.ndarray, width: int, nr: int,
+                          stages, block_r: int, topk: int):
+    """accel_stage_topk over the RAW overlap-save piece layout
+    (nd, nsegs, nz, 2*step) — the plane's transpose/concat/pad never
+    happens; the valid-region alignment is applied in index space
+    (plane col c -> piece [(c-width)//(2*step), z, (c-width)%(2*step)],
+    zero for c < width).  Returns (vals, rbins, zidx) each
+    (nd, nstages, topk), or None if unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    if pieces.dtype != np.float32 or pieces.ndim != 4:
+        return None
+    stages = np.ascontiguousarray(stages, dtype=np.int32)
+    if stages.size == 0 or stages[0] != 1:
+        return None     # the kernel seeds its accumulator at stage 1
+    pieces = np.ascontiguousarray(pieces)
+    nd, nsegs, nz, two_step = pieces.shape
+    ns = int(stages.size)
+    vals = np.empty((nd, ns, topk), np.float32)
+    rbins = np.empty((nd, ns, topk), np.int32)
+    zidx = np.empty((nd, ns, topk), np.int32)
+    lib.tpulsar_accel_stage_topk_segs(
+        pieces, nd, nsegs, nz, two_step, int(width), int(nr),
+        stages, ns, int(block_r), int(topk), vals, rbins, zidx)
+    return vals, rbins, zidx
 
 
 def unpack4_calibrate(raw: np.ndarray, scales: np.ndarray,
