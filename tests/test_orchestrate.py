@@ -736,3 +736,104 @@ def test_moab_recovery_ignores_dying_previous_attempt(tmp_path):
     qm = _moab(fake, tmp_path)
     with pytest.raises(QueueManagerNonFatalError):
         qm.submit([], str(tmp_path / "out"), job_id=6)
+
+
+# ----------------------------------------------------------- PBS backend
+
+_PBSNODES_OUT = """node1
+     state = free
+     np = 8
+     properties = search,gpu
+     jobs = 0/11.srv, 1/12.srv
+
+node2
+     state = free
+     np = 16
+     properties = search
+     jobs = 0/13.srv
+
+node3
+     state = down
+     np = 64
+     properties = search
+
+node4
+     state = free
+     np = 4
+     properties = other
+"""
+
+
+def _pbs_fake_run(nodes_out=_PBSNODES_OUT):
+    calls = []
+
+    def fake(cmd, **kw):
+        calls.append(cmd)
+
+        class R:
+            returncode = 0
+            stderr = ""
+            stdout = ""
+        r = R()
+        if cmd[0] == "pbsnodes":
+            r.stdout = nodes_out
+        elif cmd[0] == "qsub":
+            r.stdout = "99.srv\n"
+        elif cmd[0] == "qstat":
+            r.stdout = ""
+        return r
+
+    fake.calls = calls
+    return fake
+
+
+def test_pbs_submit_node_picks_most_free_cpus(tmp_path):
+    """Reference parity (pbs.py:86-107): among free nodes carrying
+    the property and under the per-node cap, the node with the most
+    free CPUs wins — node2 (16-1=15) over node1 (8-2=6); node3 is
+    down, node4 lacks the property."""
+    from tpulsar.orchestrate.queue_managers.pbs import PBSManager
+
+    fake = _pbs_fake_run()
+    qm = PBSManager(script="job.sh", node_property="search",
+                    max_jobs_per_node=4,
+                    state_file=str(tmp_path / "st.json"), runner=fake)
+    assert qm._get_submit_node() == "node2"
+    qid = qm.submit(["a.fits"], str(tmp_path / "out"), 1)
+    assert qid == "99.srv"
+    qsub = next(c for c in fake.calls if c[0] == "qsub")
+    assert "nodes=node2:ppn=1" in " ".join(qsub)
+
+
+def test_pbs_per_node_cap_and_no_node(tmp_path):
+    """A per-node job cap excludes busy nodes (pbs.py:110-126), and
+    can_submit goes False when nothing qualifies."""
+    from tpulsar.orchestrate.queue_managers.pbs import PBSManager
+
+    fake = _pbs_fake_run()
+    qm = PBSManager(script="job.sh", node_property="search",
+                    max_jobs_per_node=1,
+                    state_file=str(tmp_path / "st.json"), runner=fake)
+    # node1 has 2 jobs (>= cap 1), node2 has 1 (>= cap 1): none left
+    assert qm._get_submit_node() is None
+    assert qm.can_submit() is False
+
+    qm2 = PBSManager(script="job.sh", node_property="search",
+                     max_jobs_per_node=2,
+                     state_file=str(tmp_path / "st2.json"), runner=fake)
+    assert qm2._get_submit_node() == "node2"
+    assert qm2.can_submit() is True
+
+
+def test_pbs_without_node_selection_keeps_generic_spec(tmp_path):
+    """No property/cap configured: submission stays nodes=1:ppn=N
+    (no pbsnodes dependency)."""
+    from tpulsar.orchestrate.queue_managers.pbs import PBSManager
+
+    fake = _pbs_fake_run()
+    qm = PBSManager(script="job.sh",
+                    state_file=str(tmp_path / "st.json"), runner=fake)
+    qm.submit(["a.fits"], str(tmp_path / "out"), 2)
+    qsub = next(c for c in fake.calls if c[0] == "qsub")
+    assert "nodes=1:ppn=1" in " ".join(qsub)
+    assert not any(c[0] == "pbsnodes" for c in fake.calls)

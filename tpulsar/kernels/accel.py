@@ -123,7 +123,7 @@ def _correlate_segments(spectrum: jnp.ndarray, bank_fft: jnp.ndarray,
     the half-bin template bank.
 
     spectrum: (nbins,) complex64.  Returns (nz, 2*nbins)
-    PLANE_DTYPE powers on the numbetween=2 HALF-BIN grid: plane index 2r
+    plane_dtype() powers on the numbetween=2 HALF-BIN grid: plane index 2r
     corresponds to spectrum bin r (PRESTO searches the accel plane at
     ACCEL_DR = 0.5; a dr=1 grid loses up to ~64% of a half-bin
     signal's power to scalloping).
@@ -323,7 +323,7 @@ def plane_dm_chunk(nbins: int, nz: int, max_chunk: int = 32) -> int:
     correlation planes + per-stage intermediates fit the HBM budget
     (round-1 used a fixed chunk of 4 -> ~318 dispatches per beam).
 
-    Live bytes per DM in the batched path: the PLANE_DTYPE plane
+    Live bytes per DM in the batched path: the plane_dtype() plane
     (once in the per-z-chunk pieces and once more while
     jnp.concatenate builds the full plane), the summed/zmax stage
     intermediates (ALWAYS float32 — _harmonic_sum_plane accumulates

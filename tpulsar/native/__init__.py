@@ -19,7 +19,33 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_HERE, "unpack.cpp"),
          os.path.join(_HERE, "accel_host.cpp")]
-_LIB = os.path.join(_HERE, "_tpulsar_native.so")
+
+
+def _host_tag() -> str:
+    """Per-host build tag: -march=native produces a CPU-specific .so,
+    and this package lives on shared filesystems across heterogeneous
+    cluster nodes (the PBS/Slurm deployments) — a binary built on an
+    AVX-512 login node must not be dlopen'd into SIGILL on an older
+    worker.  Tag by the host's CPU flag set so each micro-architecture
+    builds (and caches) its own library."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for ln in fh:
+                if ln.startswith(("flags", "Features")):
+                    flags = ln
+                    break
+    except OSError:
+        pass
+    h = hashlib.sha1(
+        (platform.machine() + flags).encode()).hexdigest()[:10]
+    return h
+
+
+_LIB = os.path.join(_HERE, f"_tpulsar_native_{_host_tag()}.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None

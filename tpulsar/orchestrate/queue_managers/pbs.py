@@ -26,6 +26,8 @@ class PBSManager(CLIQueueBackend):
     def __init__(self, script: str, queue_name: str = "",
                  max_jobs_running: int = 50, max_jobs_queued: int = 1,
                  job_basename: str = "tpulsar", ppn: int = 1,
+                 node_property: str = "",
+                 max_jobs_per_node: int | None = None,
                  state_file: str | None = None,
                  runner=subprocess.run):
         self.script = script
@@ -34,16 +36,83 @@ class PBSManager(CLIQueueBackend):
         self.max_jobs_queued = max_jobs_queued
         self.job_basename = job_basename
         self.ppn = ppn
+        self.node_property = node_property
+        self.max_jobs_per_node = max_jobs_per_node
         self._run = runner
         self._stderr = SubmitRegistry(state_file)
+
+    _NODE_CACHE_TTL = 10.0
+
+    def _get_submit_node(self) -> str | None:
+        """Free-CPU-based node choice (the reference selects the free
+        node with the most unused CPUs, honouring a per-node job cap
+        and a node property filter — pbs.py:86-107,110-126 via the
+        PBSQuery library; here parsed from `pbsnodes` ASCII output so
+        the backend stays subprocess-only).  None when no node
+        qualifies.  The verdict is cached for a few seconds: the pool
+        polls can_submit() and then submit() immediately re-selects,
+        and two pbsnodes round-trips per cycle would double the load
+        on the queue server."""
+        import time as _time
+
+        cached = getattr(self, "_node_cache", None)
+        if cached is not None and _time.monotonic() - cached[0] \
+                < self._NODE_CACHE_TTL:
+            return cached[1]
+        r = self._run(["pbsnodes"], capture_output=True, text=True)
+        if r.returncode != 0:
+            raise QueueManagerNonFatalError(
+                f"pbsnodes failed: {(r.stderr or '').strip()}")
+        best, best_free = None, -1
+        for block in re.split(r"\n\s*\n", r.stdout):
+            lines = [ln for ln in block.splitlines() if ln.strip()]
+            if not lines:
+                continue
+            name = lines[0].strip()
+            attrs = {}
+            for ln in lines[1:]:
+                if "=" in ln:
+                    k, _, v = ln.partition("=")
+                    attrs[k.strip()] = v.strip()
+            if attrs.get("state") != "free":
+                continue
+            props = [p.strip()
+                     for p in attrs.get("properties", "").split(",")]
+            if self.node_property and self.node_property not in props:
+                continue
+            jobs_val = attrs.get("jobs", "")
+            # unique job ids: pbsnodes lists one slot entry per CPU
+            # ('0/11.srv, 1/11.srv' is ONE 2-ppn job, not two)
+            njobs = len({j.strip().split("/")[-1]
+                         for j in jobs_val.split(",") if j.strip()})
+            cap = self.max_jobs_per_node
+            if cap is not None and njobs >= cap:
+                continue
+            try:
+                np_cpus = int(attrs.get("np", "0"))
+            except ValueError:
+                continue
+            free = np_cpus - njobs
+            if free > best_free:
+                best, best_free = name, free
+        self._node_cache = (_time.monotonic(), best)
+        return best
 
     def submit(self, datafiles: list[str], outdir: str, job_id: int) -> str:
         os.makedirs(outdir, exist_ok=True)
         errpath = os.path.join(outdir, f"job{job_id}.stderr")
+        node_spec = "1"
+        if self.max_jobs_per_node is not None or self.node_property:
+            node = self._get_submit_node()
+            if node is None:
+                raise QueueManagerNonFatalError(
+                    "no PBS node qualifies (state, property, or "
+                    "per-node job cap)")
+            node_spec = node
         cmd = ["qsub", "-V",
                "-v", f"DATAFILES={';'.join(datafiles)},OUTDIR={outdir}",
                "-N", f"{self.job_basename}{job_id}",
-               "-l", f"nodes=1:ppn={self.ppn}",
+               "-l", f"nodes={node_spec}:ppn={self.ppn}",
                "-o", os.path.join(outdir, f"job{job_id}.stdout"),
                "-e", errpath]
         if self.queue_name:
@@ -76,8 +145,17 @@ class PBSManager(CLIQueueBackend):
 
     def can_submit(self) -> bool:
         queued, running = self.status()
-        return (running < self.max_jobs_running
-                and queued < self.max_jobs_queued)
+        if not (running < self.max_jobs_running
+                and queued < self.max_jobs_queued):
+            return False
+        if self.max_jobs_per_node is not None or self.node_property:
+            # reference can_submit also requires a qualifying node
+            # (pbs.py:110-126)
+            try:
+                return self._get_submit_node() is not None
+            except QueueManagerNonFatalError:
+                return False
+        return True
 
     def is_running(self, queue_id: str) -> bool:
         try:
