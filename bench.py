@@ -253,6 +253,13 @@ def run_focused_config(cfg: int) -> None:
         powers, wpow = fr.whitened_powers(spec)
         wspec = fr.scale_spectrum(spec, powers, wpow)
         jax.block_until_ready(wspec)   # upstream work must not leak
+        # Free the upstream buffers BEFORE timing: with the full
+        # 3.8 GB beam + subbands + series resident, XLA:CPU's
+        # allocator degrades ~4x on the accel program's multi-GB
+        # buffers (measured 2026-07-31: 10.5 s/trial free vs ~53
+        # s/trial with the beam block held).  The real executor
+        # releases pass buffers the same way.
+        del data, subb, series, spec, powers, wpow
         t0 = time.time()               # into the accel-only timing
         bank = ak.build_template_bank(200.0)
         res = ak.accel_search_batch(wspec, bank, max_numharm=16,
@@ -531,6 +538,14 @@ def run_child(deadline: float, extra_env: dict | None = None
     # 1-core host, hence the 1200 s default.
     stall_s = max(300.0, float(os.environ.get("TPULSAR_BENCH_STALL",
                                               "1200")))
+    if env.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # The stall kill exists to protect the CHIP (a hung remote
+        # dispatch wedges it for hours).  A CPU-pinned child has no
+        # chip to protect, and its full-scale in-line compiles are
+        # legitimately silent for 20-40 min on this 1-core host — a
+        # stall kill there only destroys evidence (it killed two
+        # full-scale config-3 runs on 2026-07-31 before this floor).
+        stall_s = max(stall_s, 3600.0)
     t_start = time.time()
 
     def _hb_age() -> float:

@@ -1,0 +1,47 @@
+# Single source of truth for the measurement campaign's per-step
+# scales, deadlines, and budgets (round-3 advisor: bench.py and
+# tpu_campaign.sh kept these in lockstep by hand).  Sourced by
+# tools/tpu_campaign.sh; values flow into bench.py ONLY via the
+# TPULSAR_BENCH_* environment (bench has no copy of them).
+#
+# Calling convention: set DRILL=0|1 before sourcing.
+#
+# Real-campaign sizing rationale lives with the numbers:
+#  - QUICK_*: 25%-scale measured datapoint lands within ~15 min of
+#    chip recovery, before the long full-scale compiles begin.
+#  - *_DL (deadline) < *_TO (outer timeout): the child's own deadline
+#    fires first and exits cleanly; the outer timeout is only a
+#    catastrophic backstop (a SIGKILL mid-remote-compile wedges the
+#    chip for hours).
+#  - No ladder rungs in the real campaign: the 25% quick datapoint is
+#    the stepping stone (see tpu_campaign.sh step 3b comment).
+
+if [ "${DRILL:-0}" = "1" ]; then
+    QUICK_SCALE=0.03; QUICK_GATE_DL=300; QUICK_BUDGET=400
+    QUICK_DL=300;     QUICK_TO=500
+    FULL_GATE_ARGS="--scale 0.06 --accel"; FULL_GATE_DL=500
+    RUNG_LIST=""
+    HEAD_ENV="TPULSAR_BENCH_SCALE=0.06 TPULSAR_BENCH_LADDER=0"
+    HEAD_BUDGET=500;  HEAD_DL=400;  HEAD_TO=600
+    CFG_ENV="TPULSAR_BENCH_SCALE=0.06"
+    CFG_BUDGET=250;   CFG_DL=200;   CFG_TO=350
+    CFG4AB_BUDGET=250; CFG4AB_DL=200; CFG4AB_TO=350
+    CFG5_ENV="TPULSAR_BENCH_SCALE=0.03 TPULSAR_BENCH_NBEAMS=2"
+    CFG5_BUDGET=400;  CFG5_DL=350;  CFG5_TO=500
+    HEAD_RESERVE=60;  CFG5_RESERVE=60
+    QUICK_OUT=quick_drill.json
+else
+    QUICK_SCALE=0.25; QUICK_GATE_DL=900; QUICK_BUDGET=2700
+    QUICK_DL=1500;    QUICK_TO=2900
+    FULL_GATE_ARGS="--accel"; FULL_GATE_DL=1800
+    RUNG_LIST=""
+    HEAD_ENV="TPULSAR_BENCH_LADDER=0"
+    HEAD_BUDGET=2400; HEAD_DL=1500; HEAD_TO=2600
+    CFG_ENV=""
+    CFG_BUDGET=1500;  CFG_DL=1200;  CFG_TO=1700
+    CFG4AB_BUDGET=1200; CFG4AB_DL=900; CFG4AB_TO=1400
+    CFG5_ENV=""
+    CFG5_BUDGET=3000; CFG5_DL=2700; CFG5_TO=3200
+    HEAD_RESERVE=600; CFG5_RESERVE=900
+    QUICK_OUT=quick_quarter.json
+fi

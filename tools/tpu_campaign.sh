@@ -39,45 +39,27 @@ if [ "$DRILL" = "1" ]; then
     unset PALLAS_AXON_POOL_IPS
     OUT="$REPO/bench_runs_drill"
     LOG="$REPO/tpu_campaign_drill.log"
-    QUICK_SCALE=0.03; QUICK_GATE_DL=300; QUICK_BUDGET=400
-    QUICK_DL=300;     QUICK_TO=500
-    FULL_GATE_ARGS="--scale 0.06 --accel"; FULL_GATE_DL=500
-    RUNG_LIST=""
-    HEAD_ENV="TPULSAR_BENCH_SCALE=0.06 TPULSAR_BENCH_LADDER=0"
-    HEAD_BUDGET=500;  HEAD_DL=400;  HEAD_TO=600
-    CFG_ENV="TPULSAR_BENCH_SCALE=0.06"
-    CFG_BUDGET=250;   CFG_DL=200;   CFG_TO=350
-    CFG4AB_BUDGET=250; CFG4AB_DL=200; CFG4AB_TO=350
-    CFG5_ENV="TPULSAR_BENCH_SCALE=0.03 TPULSAR_BENCH_NBEAMS=2"
-    CFG5_BUDGET=400;  CFG5_DL=350;  CFG5_TO=500
-    HEAD_RESERVE=60;  CFG5_RESERVE=60
-    QUICK_OUT=quick_drill.json
     # drill benches take the REAL lock (not LOCK_HELD-exempt): the
     # lock is what serializes CPU load with a real campaign.  210 s
     # outlasts the watcher's ~155 s probe holds but is far below a
     # campaign, so a held-by-campaign lock makes the bench emit its
     # campaign_lock_timeout record and the next probe_or_abort yields.
     export TPULSAR_BENCH_LOCK_WAIT=210
-else
-    QUICK_SCALE=0.25; QUICK_GATE_DL=900; QUICK_BUDGET=2700
-    QUICK_DL=1500;    QUICK_TO=2900
-    FULL_GATE_ARGS="--accel"; FULL_GATE_DL=1800
-    # No rung gates / no ladder in the real campaign: the 25% quick
-    # datapoint already is the stepping stone, and with the full gate
-    # + stall supervision the ladder's two extra measured runs (plus
-    # two compile-only gates) cost ~1.5 h of a possibly short
-    # healthy-chip window for little added evidence.
-    RUNG_LIST=""
-    HEAD_ENV="TPULSAR_BENCH_LADDER=0"
-    HEAD_BUDGET=2400; HEAD_DL=1500; HEAD_TO=2600
-    CFG_ENV=""
-    CFG_BUDGET=1500;  CFG_DL=1200;  CFG_TO=1700
-    CFG4AB_BUDGET=1200; CFG4AB_DL=900; CFG4AB_TO=1400
-    CFG5_ENV=""
-    CFG5_BUDGET=3000; CFG5_DL=2700; CFG5_TO=3200
-    HEAD_RESERVE=600; CFG5_RESERVE=900
-    QUICK_OUT=quick_quarter.json
 fi
+# All per-step scales/deadlines/budgets live in ONE sourced file so
+# bench invocations and this script cannot drift (round-3 advisor
+# hazard); drill and real mode differ only in the values, never in
+# the code path below.  Guarded with || (not just -f: an unreadable
+# or syntax-broken file must also abort) — with set -u but not -e, a
+# failed source would otherwise let the campaign run until the first
+# unset expansion aborts it mid-chip-window.  Placed AFTER the drill
+# block so the FATAL line lands in the drill log for drills, never in
+# the real-evidence log.
+. "$REPO/tools/campaign_params.sh" || {
+    echo "[campaign] FATAL: cannot source tools/campaign_params.sh" \
+        | tee -a "$LOG"
+    exit 9
+}
 mkdir -p "$OUT"
 
 # one campaign at a time: two concurrent campaigns (watcher + manual)
