@@ -135,3 +135,39 @@ def test_aot_check_fast_mode():
     assert "form_subbands ds=1" in out.stdout
     assert "form_subbands ds=2" not in out.stdout
     assert out.stdout.count("sp_boxcars") == 1
+
+
+def test_campaign_params_define_every_step_var():
+    """tools/campaign_params.sh is the single source of the campaign's
+    per-step budgets (round-3 advisor: bench and campaign drifted by
+    hand); both modes must define every variable tpu_campaign.sh
+    consumes, and drill values must actually differ from real ones."""
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Fail CLOSED: every ALL-CAPS variable the campaign script
+    # expands counts as a param unless it is known script-local
+    # state, so a newly added param that is missing from
+    # campaign_params.sh fails here instead of aborting a real
+    # campaign mid-chip-window.
+    campaign = open(os.path.join(repo, "tools",
+                                 "tpu_campaign.sh")).read()
+    script_local = {"REPO", "LOG", "OUT", "DRILL", "LOCKFILE",
+                    "TPULSAR_CAMPAIGN_DRILL", "TPULSAR_BENCH_SCALE",
+                    "TPULSAR_BENCH_CONFIG", "PATH", "HOME"}
+    used = set(re.findall(r"\$\{?([A-Z][A-Z0-9_]+)\}?", campaign))
+    need = sorted(used - script_local)
+    assert "QUICK_SCALE" in need and "CFG5_BUDGET" in need  # sanity
+    out = {}
+    for mode in ("0", "1"):
+        script = (f'DRILL={mode} . {repo}/tools/campaign_params.sh && '
+                  + ' && '.join(f'echo "{v}=${{{v}?}}"' for v in need))
+        r = subprocess.run(["bash", "-u", "-c", script],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, \
+            f"mode {mode}: param undefined: {r.stderr}"
+        out[mode] = dict(ln.split("=", 1)
+                         for ln in r.stdout.strip().splitlines())
+    # drill must be a genuinely smaller rehearsal, not a copy
+    assert float(out["1"]["QUICK_SCALE"]) < float(out["0"]["QUICK_SCALE"])
+    assert int(out["1"]["HEAD_BUDGET"]) < int(out["0"]["HEAD_BUDGET"])

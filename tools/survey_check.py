@@ -6,9 +6,12 @@ gate and blew its timeout (MULTICHIP_r03.json rc=124); it now lives
 here, out of the gate's budget.
 
 Usage:
-    JAX_PLATFORMS=cpu \
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python tools/survey_check.py [n_devices]
+
+Always runs on virtual CPU devices (any inherited JAX_PLATFORMS is
+overridden — this container's shell profile exports axon globally);
+set TPULSAR_SURVEY_ON_DEVICE=1 to run on the real accelerator
+instead.
 """
 
 import os
@@ -16,7 +19,17 @@ import sys
 
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 
-if not os.environ.get("JAX_PLATFORMS", "").strip():
+# This is by definition a virtual-device CPU validation run (the
+# container's shell profile exports JAX_PLATFORMS=axon globally, so
+# honouring the inherited env would point an 8-device mesh at the one
+# real chip).  TPULSAR_SURVEY_ON_DEVICE=1 is the explicit escape
+# hatch.
+if os.environ.get("TPULSAR_SURVEY_ON_DEVICE", "") != "1":
+    inherited = os.environ.get("JAX_PLATFORMS", "").strip()
+    if inherited and inherited != "cpu":
+        print(f"[survey_check] overriding JAX_PLATFORMS={inherited} "
+              "-> cpu (set TPULSAR_SURVEY_ON_DEVICE=1 for a real "
+              "on-device run)", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
