@@ -144,6 +144,10 @@ def test_bf16_plane_optin_matches_f32(monkeypatch):
 
     def summed_with(dtype_name):
         monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", dtype_name)
+        # pin the TPU z-chunk: at the CPU default (16) the ifft
+        # intermediates dominate plane_dm_chunk for this tiny nz and
+        # mask the bf16 plane saving the assertion checks
+        monkeypatch.setenv("TPULSAR_ACCEL_Z_CHUNK", "4")
         mod = importlib.reload(ak)
         plane = mod._correlate_segments(
             jnp.asarray(spec), jnp.asarray(bank.bank_fft), bank.seg,
@@ -159,6 +163,7 @@ def test_bf16_plane_optin_matches_f32(monkeypatch):
         summed_b16, chunk_b16 = summed_with("bf16")
     finally:
         monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", "f32")
+        monkeypatch.delenv("TPULSAR_ACCEL_Z_CHUNK", raising=False)
         importlib.reload(ak)
 
     assert summed_b16.dtype == np.float32   # f32 accumulation
@@ -257,3 +262,27 @@ def test_native_search_batch_equals_forced_xla(monkeypatch):
         for i in range(3):
             np.testing.assert_array_equal(np.asarray(got[h][i]),
                                           np.asarray(want[h][i]))
+
+
+def test_stage_maxes_bit_identical_to_per_stage_sums():
+    """_harmonic_stage_maxes (incremental cross-stage term reuse +
+    static strided slices) must be BIT-identical to summing each
+    stage from scratch with _harmonic_sum_plane — same left-to-right
+    f32 addition order — for every stage and several nz/nr shapes."""
+    import jax.numpy as jnp
+
+    from tpulsar.kernels import accel as ak
+    from tpulsar.kernels.fourier import harmonic_stages
+
+    rng = np.random.default_rng(5)
+    for nz, nr, mh in ((51, 4096, 16), (9, 1000, 8), (201, 2048, 16),
+                       (51, 777, 4)):
+        plane = jnp.asarray(rng.normal(size=(nz, nr)).astype(np.float32) ** 2)
+        maxes = ak._harmonic_stage_maxes(
+            plane, tuple(harmonic_stages(mh)), nz)
+        for h in harmonic_stages(mh):
+            old = np.asarray(ak._harmonic_sum_plane(plane, h, nz))
+            np.testing.assert_array_equal(np.asarray(maxes[h][0]),
+                                          old.max(axis=0))
+            np.testing.assert_array_equal(np.asarray(maxes[h][1]),
+                                          old.argmax(axis=0))

@@ -308,8 +308,36 @@ def plane_itemsize() -> int:
     return jnp.dtype(plane_dtype()).itemsize
 
 # z-templates correlated per inverse-FFT call in the batched path;
-# bounds the (nd*nsegs*Z_CHUNK, seg) intermediate.
-Z_CHUNK = 4
+# bounds the (nd*nsegs*z_chunk(), seg) intermediate.  Resolved lazily
+# per backend: 16 on CPU (25% faster at survey shapes — fewer, larger
+# FFT batches amortize dispatch and padding overhead; host RAM
+# absorbs the 4x bigger intermediate), 4 on the TPU (the proven
+# on-chip shape — the bigger intermediate would also have to be
+# re-accounted in plane_dm_chunk's HBM budget before raising it).
+# TPULSAR_ACCEL_Z_CHUNK pins either backend for A/B runs.
+_Z_CHUNK_RESOLVED = None
+
+
+def z_chunk() -> int:
+    global _Z_CHUNK_RESOLVED
+    if _Z_CHUNK_RESOLVED is None:
+        forced = os.environ.get("TPULSAR_ACCEL_Z_CHUNK", "").strip()
+        if forced:
+            try:
+                val = int(forced)
+            except ValueError:
+                val = -1
+            if not 1 <= val <= 64:
+                raise ValueError(
+                    f"TPULSAR_ACCEL_Z_CHUNK must be an integer in "
+                    f"[1, 64], got {forced!r} (a bad value would "
+                    "otherwise crash mid-trace inside the correlate "
+                    "program)")
+            _Z_CHUNK_RESOLVED = val
+        else:
+            _Z_CHUNK_RESOLVED = (16 if jax.default_backend() == "cpu"
+                                 else 4)
+    return _Z_CHUNK_RESOLVED
 # Flattened FFT batch counts are padded up to a multiple of this: the
 # axon TPU runtime's complex-FFT lowering rejects (UNIMPLEMENTED) or
 # hangs on some batch shapes with odd factors (observed: (2,9,8192)
@@ -329,12 +357,14 @@ def plane_dm_chunk(nbins: int, nz: int, max_chunk: int = 32) -> int:
     intermediates (ALWAYS float32 — _harmonic_sum_plane accumulates
     in f32 even for a bf16 plane), and the complex64 overlap-save
     intermediates (segs + their FFT at ~16 B/bin plus the
-    (Z_CHUNK, seg) product/ifft at ~65 B/bin with batch padding
-    slop)."""
+    (z_chunk(), seg) product/ifft at ~32 B/bin per z-row in the
+    chunk, with batch padding slop)."""
     # x2 throughout: the numbetween=2 plane is 2*nbins wide and the
-    # interpolated iffts are 2*seg long
+    # interpolated iffts are 2*seg long.  The ifft-intermediate term
+    # scales with z_chunk(): at the TPU's zc=4 it is the original
+    # ~128 B/bin (+64 fixed), a bigger CPU zc raises it in step.
     per_dm = (nz * nbins * 2 * (2 * plane_itemsize() + 4)
-              + nbins * 192)
+              + nbins * (64 + 32 * z_chunk()))
     return max(1, min(max_chunk, PLANE_HBM_BUDGET // max(per_dm, 1)))
 
 
@@ -370,8 +400,9 @@ def _corr_piece_list(specs: jnp.ndarray, bank_fft: jnp.ndarray,
                               FFT_BATCH_PAD), axis=-1)
     f = f[: nd * nsegs].reshape(nd, nsegs, 2 * seg)
     pieces = []
-    for z0 in range(0, nz, Z_CHUNK):
-        zc = min(Z_CHUNK, nz - z0)
+    zch = z_chunk()
+    for z0 in range(0, nz, zch):
+        zc = min(zch, nz - z0)
         prod = f[:, :, None, :] * bank_fft[z0: z0 + zc][None, None]
         corr = jnp.fft.ifft(
             _pad_rows(prod.reshape(nd * nsegs * zc, 2 * seg),
