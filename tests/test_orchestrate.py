@@ -825,6 +825,63 @@ def test_pbs_per_node_cap_and_no_node(tmp_path):
     assert qm2.can_submit() is True
 
 
+def test_pbs_ranking_counts_slots_but_cap_counts_jobs(tmp_path):
+    """Free-CPU ranking subtracts occupied CPU SLOTS (the reference's
+    PBSQuery 'jobs' list is per-slot, pbs.py:100-104) while the
+    per-node cap counts UNIQUE jobs: a node carrying one 4-ppn job
+    has 4 slots busy but only 1 job.  Round-4 advisor (medium):
+    np - unique_jobs overestimated free CPUs on ppn>1 nodes and
+    steered submissions onto nearly saturated ones."""
+    from tpulsar.orchestrate.queue_managers.pbs import PBSManager
+
+    nodes = """nodeA
+     state = free
+     np = 8
+     properties = search
+     jobs = 0/50.srv, 1/50.srv, 2/50.srv, 3/50.srv, 4/50.srv, 5/50.srv
+
+nodeB
+     state = free
+     np = 8
+     properties = search
+     jobs = 0/60.srv, 1/61.srv
+"""
+    fake = _pbs_fake_run(nodes_out=nodes)
+    # cap=2: nodeA has ONE unique job (under cap) but 6 busy slots
+    # (2 free CPUs); nodeB has TWO unique jobs (at cap -> excluded
+    # only if cap<=2... cap=3 keeps both).  With cap=3 both qualify
+    # and nodeB must win on free CPUs (6 vs 2).
+    qm = PBSManager(script="job.sh", node_property="search",
+                    max_jobs_per_node=3,
+                    state_file=str(tmp_path / "st.json"), runner=fake)
+    assert qm._get_submit_node() == "nodeB"
+    # cap=2 excludes nodeB (2 unique jobs >= 2) but keeps nodeA
+    # (1 unique job) despite its 6 busy slots: cap and ranking use
+    # different counts by design
+    qm2 = PBSManager(script="job.sh", node_property="search",
+                     max_jobs_per_node=2,
+                     state_file=str(tmp_path / "st2.json"), runner=fake)
+    assert qm2._get_submit_node() == "nodeA"
+
+
+def test_pbs_submit_invalidates_node_cache(tmp_path):
+    """A successful qsub clears the node cache so the next submit
+    re-polls pbsnodes with fresh job counts — a burst of submits
+    inside the cache TTL must not all pile onto one node (the
+    reference re-queries every submit, pbs.py:86-107; round-4
+    advisor, low)."""
+    from tpulsar.orchestrate.queue_managers.pbs import PBSManager
+
+    fake = _pbs_fake_run()
+    qm = PBSManager(script="job.sh", node_property="search",
+                    max_jobs_per_node=4,
+                    state_file=str(tmp_path / "st.json"), runner=fake)
+    qm.submit(["a.fits"], str(tmp_path / "out"), 1)
+    qm.submit(["b.fits"], str(tmp_path / "out"), 2)
+    # one pbsnodes poll per submit (no stale-cache reuse)
+    assert sum(1 for c in fake.calls if c[0] == "pbsnodes") == 2
+
+
 def test_pbs_without_node_selection_keeps_generic_spec(tmp_path):
     """No property/cap configured: submission stays nodes=1:ppn=N
     (no pbsnodes dependency)."""

@@ -137,11 +137,26 @@ def test_aot_check_fast_mode():
     assert out.stdout.count("sp_boxcars") == 1
 
 
+def _load_rungs(repo: str, mode: str) -> list[dict]:
+    script = (f'DRILL={mode} . {repo}/tools/campaign_params.sh && '
+              'echo "$RUNGS"')
+    r = subprocess.run(["bash", "-u", "-c", script],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, f"mode {mode}: {r.stderr}"
+    rows = []
+    for ln in r.stdout.split():
+        parts = ln.split("|")
+        assert len(parts) == 8, f"malformed rung row {ln!r}"
+        rows.append(dict(zip(("name", "cfg", "scale", "gate_dl", "dl",
+                              "to", "budget", "extra"), parts)))
+    return rows
+
+
 def test_campaign_params_define_every_step_var():
     """tools/campaign_params.sh is the single source of the campaign's
-    per-step budgets (round-3 advisor: bench and campaign drifted by
-    hand); both modes must define every variable tpu_campaign.sh
-    consumes, and drill values must actually differ from real ones."""
+    rung ladder (round-3 advisor: bench and campaign drifted by hand);
+    both modes must define every variable tpu_campaign.sh consumes,
+    and drill values must actually differ from real ones."""
     import re
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -152,22 +167,48 @@ def test_campaign_params_define_every_step_var():
     # campaign mid-chip-window.
     campaign = open(os.path.join(repo, "tools",
                                  "tpu_campaign.sh")).read()
-    script_local = {"REPO", "LOG", "OUT", "DRILL", "LOCKFILE",
+    script_local = {"REPO", "LOG", "OUT", "DRILL", "LOCKFILE", "IFS",
                     "TPULSAR_CAMPAIGN_DRILL", "TPULSAR_BENCH_SCALE",
                     "TPULSAR_BENCH_CONFIG", "PATH", "HOME"}
     used = set(re.findall(r"\$\{?([A-Z][A-Z0-9_]+)\}?", campaign))
     need = sorted(used - script_local)
-    assert "QUICK_SCALE" in need and "CFG5_BUDGET" in need  # sanity
-    out = {}
+    assert "RUNGS" in need  # sanity: the ladder comes from params
     for mode in ("0", "1"):
         script = (f'DRILL={mode} . {repo}/tools/campaign_params.sh && '
-                  + ' && '.join(f'echo "{v}=${{{v}?}}"' for v in need))
+                  + ' && '.join(f': "${{{v}?}}"' for v in need))
         r = subprocess.run(["bash", "-u", "-c", script],
                            capture_output=True, text=True)
         assert r.returncode == 0, \
             f"mode {mode}: param undefined: {r.stderr}"
-        out[mode] = dict(ln.split("=", 1)
-                         for ln in r.stdout.strip().splitlines())
-    # drill must be a genuinely smaller rehearsal, not a copy
-    assert float(out["1"]["QUICK_SCALE"]) < float(out["0"]["QUICK_SCALE"])
-    assert int(out["1"]["HEAD_BUDGET"]) < int(out["0"]["HEAD_BUDGET"])
+
+
+def test_campaign_rung_ladder_shape():
+    """The rung ladder's round-4-verdict contract: rung 1 is the
+    config-1 dedispersion-only run with a short (~300 s) deadline so
+    a brief healthy-chip window still lands a committed number; every
+    rung's child deadline fires before its outer kill; drill is a
+    genuinely smaller rehearsal of the SAME ladder code path."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    real = _load_rungs(repo, "0")
+    drill = _load_rungs(repo, "1")
+    assert real and drill
+    # verdict #1: first rung = config 1, deadline <= 300 s
+    assert real[0]["cfg"] == "1"
+    assert float(real[0]["dl"]) <= 300
+    # the config-3 plane-dtype A/B is in the ladder (verdict #4),
+    # pinned consistently for gate AND bench via extra_env
+    dtypes = {r["extra"] for r in real if r["cfg"] == "3"}
+    assert "TPULSAR_ACCEL_PLANE_DTYPE=f32" in dtypes
+    assert "TPULSAR_ACCEL_PLANE_DTYPE=bf16" in dtypes
+    for rows in (real, drill):
+        for r in rows:
+            assert float(r["dl"]) < float(r["to"]), r
+            assert float(r["scale"]) <= 1.0, r
+            assert r["cfg"] in "012345", r
+    # drill rungs are smaller than their real counterparts
+    real_by_name = {r["name"]: r for r in real}
+    shared = [d for d in drill if d["name"] in real_by_name]
+    assert shared, "drill must rehearse real rung names"
+    for d in shared:
+        assert (float(d["scale"])
+                < float(real_by_name[d["name"]]["scale"])), d

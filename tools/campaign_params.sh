@@ -1,47 +1,65 @@
-# Single source of truth for the measurement campaign's per-step
-# scales, deadlines, and budgets (round-3 advisor: bench.py and
-# tpu_campaign.sh kept these in lockstep by hand).  Sourced by
-# tools/tpu_campaign.sh; values flow into bench.py ONLY via the
-# TPULSAR_BENCH_* environment (bench has no copy of them).
+# Single source of truth for the measurement campaign's rung ladder
+# (round-3 advisor: bench.py and tpu_campaign.sh kept step scales/
+# deadlines in lockstep by hand).  Sourced by tools/tpu_campaign.sh;
+# values flow into bench.py ONLY via the TPULSAR_BENCH_* environment
+# (bench has no copy of them).
 #
 # Calling convention: set DRILL=0|1 before sourcing.
 #
-# Real-campaign sizing rationale lives with the numbers:
-#  - QUICK_*: 25%-scale measured datapoint lands within ~15 min of
-#    chip recovery, before the long full-scale compiles begin.
-#  - *_DL (deadline) < *_TO (outer timeout): the child's own deadline
-#    fires first and exits cleanly; the outer timeout is only a
-#    catastrophic backstop (a SIGKILL mid-remote-compile wedges the
+# RUNGS: one row per campaign rung, smallest-first, format
+#   name|cfg|scale|gate_dl|bench_dl|bench_to|bench_budget|extra_env
+# where cfg is TPULSAR_BENCH_CONFIG (0 = the full-plan headline), and
+# extra_env is a single KEY=VAL applied to BOTH the rung's AOT gate
+# and its measured bench (so e.g. a plane-dtype pin can never gate one
+# program set and execute another), or "-" for none.
+#
+# Real-ladder rationale (round-4 verdict "next round" #1): four rounds
+# produced zero TPU wall-clock because the first measured step was a
+# 25%-scale FULL-plan beam with a 1500 s deadline — too big for the
+# short healthy-chip windows this tunnel actually grants.  The ladder
+# now starts with config 1 (rfifind + subbands + 128-DM dedispersion,
+# BASELINE.json configs[0]): its gate is ~4 programs and its measured
+# run is expected in SECONDS on a healthy chip, so a 10-minute window
+# still lands a committed number (evidence is committed after EVERY
+# rung).  Then config 2 (+FFT+lo, configs[1]), the config-3 hi-accel
+# micro-bench with the f32/bf16 plane A/B (configs[2]; round-4
+# advisor: the bf16 'auto' default has never been candidate-compared
+# on chip), config 4, and only then the full-plan headline and the
+# 8-beam batch.
+#
+#  - bench_dl (deadline) < bench_to (outer timeout): the child's own
+#    deadline fires first and exits cleanly; the outer timeout is only
+#    a catastrophic backstop (a SIGKILL mid-remote-compile wedges the
 #    chip for hours).
-#  - No ladder rungs in the real campaign: the 25% quick datapoint is
-#    the stepping stone (see tpu_campaign.sh step 3b comment).
+#  - gate_dl is aot_gate_loop's between-compiles deadline per attempt;
+#    remote TPU compiles run ~20 s/program, the config-1 set is ~4
+#    programs, the full no-accel set ~26, the accel set adds ~12.
 
 if [ "${DRILL:-0}" = "1" ]; then
-    QUICK_SCALE=0.03; QUICK_GATE_DL=300; QUICK_BUDGET=400
-    QUICK_DL=300;     QUICK_TO=500
-    FULL_GATE_ARGS="--scale 0.06 --accel"; FULL_GATE_DL=500
-    RUNG_LIST=""
-    HEAD_ENV="TPULSAR_BENCH_SCALE=0.06 TPULSAR_BENCH_LADDER=0"
-    HEAD_BUDGET=500;  HEAD_DL=400;  HEAD_TO=600
-    CFG_ENV="TPULSAR_BENCH_SCALE=0.06"
-    CFG_BUDGET=250;   CFG_DL=200;   CFG_TO=350
-    CFG4AB_BUDGET=250; CFG4AB_DL=200; CFG4AB_TO=350
-    CFG5_ENV="TPULSAR_BENCH_SCALE=0.03 TPULSAR_BENCH_NBEAMS=2"
-    CFG5_BUDGET=400;  CFG5_DL=350;  CFG5_TO=500
-    HEAD_RESERVE=60;  CFG5_RESERVE=60
-    QUICK_OUT=quick_drill.json
+    RUNGS="
+cfg1_quarter|1|0.03|240|120|220|160|-
+cfg1_full|1|0.06|240|150|250|200|-
+cfg2_quarter|2|0.03|300|200|320|250|-
+cfg2_full|2|0.06|400|250|380|300|-
+cfg3_quarter_f32|3|0.03|300|200|320|250|TPULSAR_ACCEL_PLANE_DTYPE=f32
+cfg3_quarter_bf16|3|0.03|300|200|320|250|TPULSAR_ACCEL_PLANE_DTYPE=bf16
+cfg4_full|4|0.06|300|200|320|250|-
+headline|0|0.06|500|400|550|450|-
+cfg5_batch|5|0.03|400|350|500|400|TPULSAR_BENCH_NBEAMS=2
+cfg4_clipped|4|0.06|300|200|320|250|TPULSAR_SP_DETREND=clipped_mean
+"
 else
-    QUICK_SCALE=0.25; QUICK_GATE_DL=900; QUICK_BUDGET=2700
-    QUICK_DL=1500;    QUICK_TO=2900
-    FULL_GATE_ARGS="--accel"; FULL_GATE_DL=1800
-    RUNG_LIST=""
-    HEAD_ENV="TPULSAR_BENCH_LADDER=0"
-    HEAD_BUDGET=2400; HEAD_DL=1500; HEAD_TO=2600
-    CFG_ENV=""
-    CFG_BUDGET=1500;  CFG_DL=1200;  CFG_TO=1700
-    CFG4AB_BUDGET=1200; CFG4AB_DL=900; CFG4AB_TO=1400
-    CFG5_ENV=""
-    CFG5_BUDGET=3000; CFG5_DL=2700; CFG5_TO=3200
-    HEAD_RESERVE=600; CFG5_RESERVE=900
-    QUICK_OUT=quick_quarter.json
+    RUNGS="
+cfg1_quarter|1|0.25|420|240|400|300|-
+cfg1_full|1|1.0|600|300|480|360|-
+cfg2_quarter|2|0.25|900|600|780|660|-
+cfg2_full|2|1.0|1200|900|1100|1000|-
+cfg3_quarter_f32|3|0.25|600|450|630|510|TPULSAR_ACCEL_PLANE_DTYPE=f32
+cfg3_quarter_bf16|3|0.25|600|450|630|510|TPULSAR_ACCEL_PLANE_DTYPE=bf16
+cfg3_full_f32|3|1.0|900|1200|1400|1300|TPULSAR_ACCEL_PLANE_DTYPE=f32
+cfg4_full|4|1.0|600|600|780|660|-
+headline|0|1.0|1800|1500|2600|2400|-
+cfg5_batch|5|1.0|600|2700|3200|3000|-
+cfg4_clipped|4|1.0|600|900|1380|1200|TPULSAR_SP_DETREND=clipped_mean
+"
 fi

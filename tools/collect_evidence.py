@@ -54,9 +54,37 @@ def _pallas_verdict(log_path: str) -> dict | None:
             "detail": details[-1][:400] if details else None}
 
 
+def _attempt_records(runs_dir: str) -> list[dict]:
+    """Per-attempt kill-attribution records (bench_runs/attempts/*/
+    attempt.json).  Non-ok attempts are the round-4 lesson: a killed
+    run's stage attribution and archived partials are the most
+    expensive evidence a wedge-prone chip produces, and they must
+    reach the committed record even though the shared working files
+    get truncated by the next attempt."""
+    adir = os.path.join(runs_dir, "attempts")
+    out: list[dict] = []
+    if not os.path.isdir(adir):
+        return out
+    for d in sorted(os.listdir(adir)):
+        path = os.path.join(adir, d, "attempt.json")
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if rec.get("status") == "ok":
+            continue      # successful runs are already in runs{}
+        keep = {k: rec.get(k) for k in
+                ("label", "status", "rc", "deadline_s", "elapsed_s",
+                 "kill_reason", "stalled_stage", "stage_elapsed_s",
+                 "stage_progress", "attempt_dir") if k in rec}
+        out.append(keep)
+    return out[-20:]      # bound the committed record's size
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", default=os.environ.get("TPULSAR_ROUND", "4"))
+    ap.add_argument("--round", default=os.environ.get("TPULSAR_ROUND", "5"))
     ap.add_argument("--out", default=None)
     ap.add_argument("--runs-dir", default=None,
                     help="records directory (default bench_runs/; the "
@@ -86,7 +114,10 @@ def main() -> None:
                              os.path.join(REPO, "tpu_campaign.log"))
     if pallas is not None:
         record["pallas_smoke"] = pallas
-    if not record["runs"] and pallas is None:
+    attempts = _attempt_records(runs_dir)
+    if attempts:
+        record["failed_attempts"] = attempts
+    if not record["runs"] and pallas is None and not attempts:
         print("no evidence to collect")
         return
     with open(out_path, "w") as fh:

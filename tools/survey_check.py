@@ -31,10 +31,21 @@ if os.environ.get("TPULSAR_SURVEY_ON_DEVICE", "") != "1":
               "-> cpu (set TPULSAR_SURVEY_ON_DEVICE=1 for a real "
               "on-device run)", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
+# REWRITE any inherited device-count flag rather than keeping it
+# (round-4 advisor: a substring check that keeps an inherited
+# --xla_force_host_platform_device_count=1 collapses the mesh to one
+# device and the 'sharded==single equality' compares a run against
+# itself)
+import re
+
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={n}").strip()
+flag = f"--xla_force_host_platform_device_count={n}"
+if "xla_force_host_platform_device_count" in flags:
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                   flag, flags)
+else:
+    flags = f"{flags} {flag}".strip()
+os.environ["XLA_FLAGS"] = flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))

@@ -83,16 +83,16 @@ fi
 # serialized against a real campaign that starts mid-step.
 [ "$DRILL" = "1" ] || export TPULSAR_CAMPAIGN_LOCK_HELD=1
 
-# Whatever evidence landed, fold it into a COMMITTED record on every
+# Whatever evidence landed, fold it into a COMMITTED record — after
+# EVERY rung (round-4 verdict #1: evidence must be committed before
+# the next, bigger rung starts — a chip that re-wedges mid-campaign
+# must not take the finished rungs' numbers with it) and on every
 # exit (abort included): bench_runs/ is gitignored working space, and
 # a campaign often finishes hours after the session that armed the
 # watcher is gone — uncommitted evidence would be invisible to the
 # judge.  The commit is data-only; skip silently when nothing landed
 # or nothing changed.
-collected=0
-collect_evidence() {
-    [ "$collected" -eq 1 ] && return 0
-    collected=1
+checkpoint_evidence() {
     if [ "$DRILL" = "1" ]; then
         # drill evidence goes to an uncommitted scratch file — it
         # must never be mistaken for on-chip measurements
@@ -111,6 +111,14 @@ collect_evidence() {
     git add -- "$f" 2>>"$LOG"
     git diff --cached --quiet -- "$f" || git commit -q -m \
         "Record on-chip campaign evidence ($f)" -- "$f" >>"$LOG" 2>&1
+}
+# exit/abort path keeps a latch so the INT trap + EXIT trap pair
+# cannot double-collect on the way down
+collected=0
+collect_evidence() {
+    [ "$collected" -eq 1 ] && return 0
+    collected=1
+    checkpoint_evidence
 }
 # INT/TERM trapped separately and TERMINALLY: bash does not run an
 # EXIT trap on an untrapped fatal signal, but a non-exiting INT/TERM
@@ -159,120 +167,21 @@ say "=== TPU campaign start ==="
 probe_or_abort "probe unhealthy" 1
 say "probe healthy"
 
-# 2. Quick datapoint at 25% scale.  FULL gate first (not the fast
-#    maximal-footprint one): the 2026-07-31 03:49 attempt showed the
-#    fast gate leaves every per-pass program (subband/dedisperse/SP/
-#    FFT) uncompiled, and the measured child then sat >25 min silent
-#    in its first in-line remote compile — indistinguishable from a
-#    hang until the deadline kill wedged the chip.  The full gate is
-#    compile-only, streams per-program [ok] lines to the log (a hung
-#    compile is localized by name), and leaves the measured run fully
-#    cached so its stage trace measures execution, not compilation.
-say "quick datapoint: full AOT gate at scale $QUICK_SCALE (compile-only)"
-bash tools/aot_gate_loop.sh "$LOG" "$QUICK_GATE_DL" \
-    --scale "$QUICK_SCALE" --accel > /dev/null
-qrc=$?
-if [ $qrc -ne 0 ]; then
-    # Do NOT abort the whole campaign: the full-scale gate (step 3)
-    # resumes from the same cache and the ladder/focused steps are
-    # independent evidence.  Only the quick measured run is skipped
-    # (running it against an unconverged gate is the in-line-compile
-    # blindness of the 03:49 attempt).
-    say "quick datapoint SKIPPED: quarter-scale gate rc=$qrc (2=stopped converging, else compile failure/hang)"
-else
-    say "quick datapoint: scale-$QUICK_SCALE measured run (cache warm)"
-    env TPULSAR_BENCH_SCALE="$QUICK_SCALE" TPULSAR_BENCH_LADDER=0 \
-        TPULSAR_BENCH_AOT=0 TPULSAR_BENCH_CPU_FALLBACK=0 \
-        TPULSAR_BENCH_TOTAL_BUDGET="$QUICK_BUDGET" \
-        TPULSAR_BENCH_DEADLINE="$QUICK_DL" \
-        timeout "$QUICK_TO" python bench.py \
-        > "$OUT/$QUICK_OUT" 2>>"$LOG"
-    say "quick: $(tail -c 600 "$OUT/$QUICK_OUT")"
-fi
-
-probe_or_abort "chip unhealthy after quick datapoint" 6
-
-# 3. AOT gate (compile-only; also the cache warmer).  NEVER
-# SIGTERM-kill this mid-compile: killing the PJRT client during an
-# active remote compile wedged the chip on 2026-07-31 (01:25 rc=124
-# kill -> probe hung at 01:29) exactly like a runtime OOM.  Instead
-# the tool takes an internal --deadline checked BETWEEN compiles and
-# exits rc 3 cleanly; we loop, resuming from the persistent cache.
-# The outer timeout is only a catastrophic backstop sized far above
-# any observed single compile (accel: >7 min each on this 1-core
-# host).
-bash tools/aot_gate_loop.sh "$LOG" "$FULL_GATE_DL" $FULL_GATE_ARGS > /dev/null
-aot_rc=$?
-if [ $aot_rc -ne 0 ]; then
-    say "ABORT: aot gate rc=$aot_rc (2=stopped converging, else compile failure/crash) — full-scale programs must not run"
-    exit 2
-fi
-say "aot_check passed (full-scale programs compiled)"
-
-# 3b. Gate the ladder rung scales too (compile-only): rung shapes are
-#     distinct programs, and an in-line remote compile inside a rung's
-#     measured child is silent until its cap kills it mid-compile —
-#     the wedge mode this campaign exists to avoid.  A rung-gate
-#     failure skips nothing downstream (the headline's full-scale
-#     programs are already gated); worst case the rungs compile
-#     in-line under the stall supervisor.
-for rung in $RUNG_LIST; do
-    say "rung gate: compile-only at scale $rung"
-    bash tools/aot_gate_loop.sh "$LOG" 900 --scale "$rung" --accel > /dev/null \
-        || say "rung $rung gate incomplete (rungs may compile in-line)"
-done
-
-# 4. headline ladder bench (generous self-run budgets; the driver's
-#    own run later reuses the warmed cache)
-say "headline bench (ladder + full scale, accel on)"
-env $HEAD_ENV TPULSAR_BENCH_TOTAL_BUDGET="$HEAD_BUDGET" \
-    TPULSAR_BENCH_DEADLINE="$HEAD_DL" \
-    TPULSAR_BENCH_FULL_RESERVE="$HEAD_RESERVE" TPULSAR_BENCH_AOT=0 \
-    timeout "$HEAD_TO" python bench.py > "$OUT/headline.json" 2>>"$LOG"
-say "headline: $(tail -c 600 "$OUT/headline.json")"
-
-# stop early if the chip wedged mid-campaign
-probe_or_abort "chip unhealthy after headline" 3
-
-# 5. focused configs
-for cfg in 1 4 3; do
-    say "focused config $cfg"
-    env $CFG_ENV TPULSAR_BENCH_CONFIG=$cfg \
-        TPULSAR_BENCH_TOTAL_BUDGET="$CFG_BUDGET" \
-        TPULSAR_BENCH_DEADLINE="$CFG_DL" \
-        timeout "$CFG_TO" python bench.py \
-        > "$OUT/config$cfg.json" 2>>"$LOG"
-    say "config $cfg: $(tail -c 400 "$OUT/config$cfg.json")"
-    probe_or_abort "chip unhealthy after config $cfg" 4
-done
-
-say "focused config 5 (8-beam steady state)"
-env $CFG5_ENV TPULSAR_BENCH_CONFIG=5 \
-    TPULSAR_BENCH_TOTAL_BUDGET="$CFG5_BUDGET" \
-    TPULSAR_BENCH_DEADLINE="$CFG5_DL" \
-    TPULSAR_BENCH_FULL_RESERVE="$CFG5_RESERVE" \
-    timeout "$CFG5_TO" python bench.py > "$OUT/config5.json" 2>>"$LOG"
-say "config 5: $(tail -c 400 "$OUT/config5.json")"
-
-# 5b. SP detrend A/B (config 4 again with the sort-free estimator:
-#     on CPU the exact-median sort is ~3.5x the whole boxcar ladder;
-#     this run decides whether the TPU default should change)
-say "focused config 4 A/B: clipped_mean detrend"
-env $CFG_ENV TPULSAR_BENCH_CONFIG=4 TPULSAR_SP_DETREND=clipped_mean \
-    TPULSAR_BENCH_TOTAL_BUDGET="$CFG4AB_BUDGET" \
-    TPULSAR_BENCH_DEADLINE="$CFG4AB_DL" \
-    timeout "$CFG4AB_TO" python bench.py \
-    > "$OUT/config4_clipped.json" 2>>"$LOG"
-say "config 4 clipped: $(tail -c 400 "$OUT/config4_clipped.json")"
-
-# 6. Pallas diagnosis: run the smoke in a subprocess and capture the
-#    REAL error text (fix-or-retire decision input)
-say "pallas smoke diagnosis"
+# 2. Pallas smoke diagnosis FIRST (round-4 verdict #3: run the smoke
+#    alone on the next healthy window, before anything else can wedge
+#    the chip).  Small kernel, subprocess-isolated, clean compile-
+#    stage failure expected if it fails; the captured detail line is
+#    the fix-or-retire decision input that two rounds of bare
+#    'Pallas smoke: False' never provided.  Success also populates
+#    the shared smoke cache so every later bench child reads the
+#    verdict instead of re-probing mid-run.
+say "pallas smoke diagnosis (fresh probe, detail captured)"
 if [ "$DRILL" = "1" ]; then
-    # step 6 deletes and repopulates the SHARED pallas smoke cache;
-    # a CPU interpret-mode 'ok' written there would let a later real
-    # TPU run enable the kernel without ever probing the real
-    # lowering — the exact hang the subprocess smoke exists to catch
+    # this step deletes and repopulates the SHARED pallas smoke
+    # cache; a CPU interpret-mode 'ok' written there would let a
+    # later real TPU run enable the kernel without ever probing the
+    # real lowering — the exact hang the subprocess smoke exists to
+    # catch
     say "pallas step SKIPPED in drill (would poison the shared smoke cache with a CPU verdict)"
 else
 timeout 400 python -c "
@@ -290,5 +199,64 @@ ok = pallas_dd.smoke_test_ok()
 print('pallas smoke:', ok)
 print('detail:', pallas_dd.LAST_SMOKE_DETAIL)
 " >> "$LOG" 2>&1
+    probe_or_abort "chip unhealthy after pallas smoke" 7
+fi
+
+# 3. The rung ladder (tools/campaign_params.sh RUNGS): smallest
+#    evidence first, gate-then-measure per rung, evidence COMMITTED
+#    after every rung.  Per-rung AOT gate (compile-only, never
+#    SIGTERM-killed mid-compile — aot_gate_loop's internal deadline
+#    exits rc 3 cleanly between compiles; killing the PJRT client
+#    mid-compile wedged the chip on 2026-07-31 exactly like a runtime
+#    OOM): the gate compiles the EXACT program set the rung executes
+#    and leaves the cache warm, so the measured child measures
+#    execution, not compilation — the 03:49 attempt died silent in an
+#    in-line remote compile because its gate had skipped the per-pass
+#    programs.
+rung_failures=0
+for row in $RUNGS; do
+    IFS='|' read -r name cfg scale gate_dl dl to budget extra <<< "$row"
+    [ -z "$name" ] && continue
+    rung_env=()
+    [ "$extra" != "-" ] && rung_env+=("$extra")
+    case "$cfg" in
+        0) gate_args=(--scale "$scale" --accel) ;;
+        2) gate_args=(--scale "$scale") ;;
+        5) gate_args=(--scale "$scale" --accel) ;;
+        *) gate_args=(--config "$cfg" --scale "$scale") ;;
+    esac
+    say "rung $name: AOT gate (${gate_args[*]} ${rung_env[*]:-})"
+    env "${rung_env[@]}" bash tools/aot_gate_loop.sh "$LOG" "$gate_dl" \
+        "${gate_args[@]}" > /dev/null
+    grc=$?
+    if [ $grc -ne 0 ]; then
+        # skip ONLY this rung's measured run: executing against an
+        # unconverged gate is the in-line-compile blindness of the
+        # 03:49 attempt.  Later rungs gate independently (and resume
+        # from whatever this gate DID cache).
+        say "rung $name SKIPPED: gate rc=$grc (2=stopped converging, else compile failure/hang)"
+        rung_failures=$((rung_failures + 1))
+        probe_or_abort "chip unhealthy after failed $name gate" 4
+        continue
+    fi
+    cfg_env=()
+    [ "$cfg" != "0" ] && cfg_env+=("TPULSAR_BENCH_CONFIG=$cfg")
+    say "rung $name: measured run (cfg=$cfg scale=$scale dl=$dl)"
+    env "${rung_env[@]}" "${cfg_env[@]}" \
+        TPULSAR_BENCH_SCALE="$scale" TPULSAR_BENCH_LADDER=0 \
+        TPULSAR_BENCH_AOT=0 TPULSAR_BENCH_CPU_FALLBACK=0 \
+        TPULSAR_BENCH_TOTAL_BUDGET="$budget" \
+        TPULSAR_BENCH_DEADLINE="$dl" \
+        timeout "$to" python bench.py \
+        > "$OUT/rung_$name.json" 2>>"$LOG"
+    say "rung $name: $(tail -c 600 "$OUT/rung_$name.json")"
+    # commit whatever has landed BEFORE the next (bigger) rung: a
+    # mid-campaign re-wedge must not cost the finished rungs
+    checkpoint_evidence
+    probe_or_abort "chip unhealthy after rung $name" 4
+done
+
+if [ "$rung_failures" -gt 0 ]; then
+    say "campaign done with $rung_failures skipped rung(s)"
 fi
 say "=== TPU campaign done ==="

@@ -634,6 +634,44 @@ def cmd_doctor(args):
         report("device probe", False,
                f"hung > {args.device_timeout:.0f} s (wedged chip?)")
 
+    # Fallback-path visibility (round-4 verdict #8): which degraded
+    # paths a run on THIS node would take, readable without burning a
+    # chip window.  The smoke caches are success-only (a missing file
+    # means the next run re-probes, not that the path is broken), and
+    # the kernels are NOT imported here — they import jax at module
+    # level, and a wedged chip hangs that before any timeout arms.
+    print("fallback paths (smoke caches + env pins):")
+    import glob
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "tpulsar"))
+    for label, pat in [("pallas dedisperse", "pallas_smoke_*.ok"),
+                       ("batched accel", "accel_batch_*.ok")]:
+        hits = sorted(glob.glob(os.path.join(cache_dir, pat)))
+        if hits:
+            print(f"  [ok] {label}: cached pass "
+                  f"({os.path.basename(hits[-1])})")
+        else:
+            print(f"  [--] {label}: no cached pass — next run "
+                  "re-probes in a subprocess and falls back to the "
+                  "XLA path on failure")
+    for var in ("TPULSAR_PALLAS", "TPULSAR_ACCEL_BATCH",
+                "TPULSAR_ACCEL_NATIVE", "TPULSAR_ACCEL_PLANE_DTYPE",
+                "TPULSAR_SP_DETREND"):
+        val = os.environ.get(var)
+        if val is not None:
+            print(f"  [pin] {var}={val}")
+    from tpulsar.search import degraded
+
+    snap = degraded.snapshot()
+    if snap:
+        for flag, detail in sorted(snap.items()):
+            print(f"  [degraded] {flag}: {detail}")
+    else:
+        print("  [ok] no degraded modes noted in this process "
+              "(per-run flags land in each results dir's .report)")
+
     print(("all checks passed" if not failures
            else f"{len(failures)} check(s) FAILED: "
                 + ", ".join(failures)))

@@ -651,7 +651,7 @@ def _accel_search_batch_native(spectra, bank: TemplateBank,
     nz = len(bank.zs)
     bank_fft = jnp.asarray(bank.bank_fft)
     ndms, nbins = spectra.shape
-    from tpulsar.search.report import _beat
+    from tpulsar.search.report import progress_beat
 
     stages = harmonic_stages(max_numharm)
     nstages = len(stages)
@@ -662,9 +662,12 @@ def _accel_search_batch_native(spectra, bank: TemplateBank,
         # clamp so the (possibly short) last chunk re-covers earlier
         # rows instead of triggering a second compile signature
         s0 = min(c0, ndms - dm_chunk)
-        _beat()    # per-chunk heartbeat: a full-scale hi stage can
-        #            run far longer than the stall supervisor's
-        #            threshold inside ONE executor stage
+        # per-chunk heartbeat WITH position: a full-scale hi stage can
+        # run far longer than the stall supervisor's threshold inside
+        # ONE executor stage, and a kill mid-stage must be able to say
+        # how far the stage got (round-4 verdict: the one on-chip kill
+        # carried no attribution)
+        progress_beat(f"accel native dm {s0}/{ndms}")
         block = jax.lax.dynamic_slice_in_dim(
             spectra, np.int32(s0), dm_chunk, axis=0)
         pieces_dev = _correlate_pieces(
@@ -745,16 +748,19 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
     # sequential), then fetch the whole window in one sync.
     SYNC_WINDOW = 32
 
-    from tpulsar.search.report import _beat
+    from tpulsar.search.report import progress_beat
 
     def _drain(pending):
+        done = 0
         for s0, nrows, tup in jax.device_get(pending):
             vals[s0:s0 + nrows] = tup[0]
             rbins[s0:s0 + nrows] = tup[1]
             zidx[s0:s0 + nrows] = tup[2]
+            done = s0 + nrows
         pending.clear()
-        _beat()    # real progress: a window of chunk programs has
-        #            completed on device (see the native path's note)
+        # real progress with position: a window of chunk programs has
+        # completed on device (see the native path's note)
+        progress_beat(f"accel window dm {done}/{ndms}")
 
     if use_batch:
         pending: list = []

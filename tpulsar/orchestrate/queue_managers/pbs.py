@@ -81,10 +81,12 @@ class PBSManager(CLIQueueBackend):
             if self.node_property and self.node_property not in props:
                 continue
             jobs_val = attrs.get("jobs", "")
-            # unique job ids: pbsnodes lists one slot entry per CPU
-            # ('0/11.srv, 1/11.srv' is ONE 2-ppn job, not two)
+            slot_entries = [j for j in jobs_val.split(",") if j.strip()]
+            # unique job ids for the PER-NODE JOB CAP: pbsnodes lists
+            # one slot entry per CPU ('0/11.srv, 1/11.srv' is ONE
+            # 2-ppn job, not two)
             njobs = len({j.strip().split("/")[-1]
-                         for j in jobs_val.split(",") if j.strip()})
+                         for j in slot_entries})
             cap = self.max_jobs_per_node
             if cap is not None and njobs >= cap:
                 continue
@@ -92,7 +94,12 @@ class PBSManager(CLIQueueBackend):
                 np_cpus = int(attrs.get("np", "0"))
             except ValueError:
                 continue
-            free = np_cpus - njobs
+            # free-CPU RANKING counts occupied SLOTS, not unique jobs
+            # (the reference's PBSQuery 'jobs' list is per-CPU-slot,
+            # pbs.py:100-104): with ppn>1 jobs, np - unique_jobs would
+            # overestimate free CPUs and steer submissions onto nearly
+            # saturated nodes (round-4 advisor, medium)
+            free = np_cpus - len(slot_entries)
             if free > best_free:
                 best, best_free = name, free
         self._node_cache = (_time.monotonic(), best)
@@ -128,6 +135,12 @@ class PBSManager(CLIQueueBackend):
         qid = r.stdout.strip().splitlines()[-1].strip()
         if not qid:
             raise QueueManagerNonFatalError("qsub returned no job id")
+        # a successful submit invalidates the node cache: a burst of
+        # submits inside the TTL would otherwise all target the same
+        # cached node with stale job counts and overshoot
+        # max_jobs_per_node (the reference re-queries every submit,
+        # pbs.py:86-107; round-4 advisor, low)
+        self._node_cache = None
         self._stderr.put(qid, errpath=errpath)
         return qid
 

@@ -10,6 +10,7 @@ written at the end of the search (write_report, :336-372).  The
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import sys
 import time
@@ -27,22 +28,52 @@ STAGES = ("rfifind", "subbanding", "dedispersing", "single-pulse",
 # kill, 25 min later).
 _TRACE = os.environ.get("TPULSAR_STAGE_TRACE", "") == "1"
 
-# TPULSAR_STAGE_HEARTBEAT=<path>: touch <path> at every stage begin/
-# end.  A supervising parent distinguishes a *stalled* child (no
-# heartbeat for many minutes -> hung dispatch, kill it) from a slow
-# but progressing one (heartbeat fresh -> let it run): killing a
-# healthy child mid-dispatch wedges the chip for hours, so the parent
-# must never kill on elapsed time alone.
+# TPULSAR_STAGE_HEARTBEAT=<path>: write a JSON beat to <path> at every
+# stage begin/end and at chunk drains inside long stages.  A
+# supervising parent distinguishes a *stalled* child (no heartbeat for
+# many minutes -> hung dispatch, kill it) from a slow but progressing
+# one (heartbeat fresh -> let it run): killing a healthy child
+# mid-dispatch wedges the chip for hours, so the parent must never
+# kill on elapsed time alone.  The beat carries the CURRENT STAGE NAME
+# and its begin time, so a kill — deadline, stall, or per-stage budget
+# — can always name the stage it interrupted (the 2026-07-31 03:44
+# on-chip run died at +1500 s with no record of which stage ate ~24
+# minutes; this field is that record).
 _HEARTBEAT = os.environ.get("TPULSAR_STAGE_HEARTBEAT", "")
 
+# current innermost timed stage: (name, begin_time) — module-level so
+# progress_beat() callers (executor chunk loops, accel drain) need no
+# handle on the StageTimers instance
+_CUR_STAGE: list[tuple[str, float]] = []
 
-def _beat() -> None:
-    if _HEARTBEAT:
-        try:
-            with open(_HEARTBEAT, "w") as fh:
-                fh.write(str(time.time()))
-        except OSError:
-            pass
+
+def _beat(stage: str = "", event: str = "", info: str = "") -> None:
+    if not _HEARTBEAT:
+        return
+    t_stage = _CUR_STAGE[-1][1] if _CUR_STAGE else 0.0
+    rec = {"t": time.time(), "stage": stage, "event": event,
+           "t_stage": t_stage}
+    if info:
+        rec["info"] = info
+    try:
+        # atomic replace: the supervising parent reads this file
+        # between polls, and a torn half-written JSON read as garbage
+        # would cost the kill its attribution at the worst moment
+        tmp = _HEARTBEAT + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(rec, fh)
+        os.replace(tmp, _HEARTBEAT)
+    except OSError:
+        pass
+
+
+def progress_beat(info: str = "") -> None:
+    """Refresh the heartbeat from inside a long timed stage (a chunk
+    drained, a window synced).  Keeps the stage's begin time, so the
+    parent's per-stage budget still measures total in-stage time while
+    the stall detector sees live progress."""
+    if _HEARTBEAT and _CUR_STAGE:
+        _beat(_CUR_STAGE[-1][0], "progress", info)
 
 
 class StageTimers:
@@ -54,7 +85,8 @@ class StageTimers:
     def timing(self, stage: str):
         self.times.setdefault(stage, 0.0)
         start = time.time()
-        _beat()
+        _CUR_STAGE.append((stage, start))
+        _beat(stage, "begin")
         if _TRACE:
             print(f"[stage-trace +{start - self._t0:8.1f}s] begin "
                   f"{stage}", file=sys.stderr, flush=True)
@@ -63,7 +95,9 @@ class StageTimers:
         finally:
             end = time.time()
             self.times[stage] += end - start
-            _beat()
+            if _CUR_STAGE and _CUR_STAGE[-1][0] == stage:
+                _CUR_STAGE.pop()
+            _beat(stage, "end")
             if _TRACE:
                 print(f"[stage-trace +{end - self._t0:8.1f}s] end   "
                       f"{stage} ({end - start:.1f} s)",
