@@ -46,12 +46,17 @@ def _pallas_verdict(log_path: str) -> dict | None:
             text = fh.read()
     except OSError:
         return None
-    smokes = re.findall(r"pallas smoke: (\S+)", text)
-    details = re.findall(r"detail: (.+)", text)
-    if not smokes:
+    # pair each verdict with the detail line that FOLLOWS it (bench
+    # pre-probes also log detail: lines, so a global last-detail could
+    # belong to a different probe than the last smoke verdict)
+    pairs = re.findall(
+        r"pallas smoke: (\S+)(?:.*?\n[^\n]*?detail: ([^\n]+))?",
+        text)
+    if not pairs:
         return None
-    return {"ok": smokes[-1] == "True",
-            "detail": details[-1][:400] if details else None}
+    ok, detail = pairs[-1]
+    return {"ok": ok == "True",
+            "detail": detail[:400] if detail else None}
 
 
 def _attempt_records(runs_dir: str) -> list[dict]:
