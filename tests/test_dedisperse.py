@@ -227,6 +227,42 @@ def test_pallas_dedisperse_matches_gather():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
 
+def test_pallas_variants_match_gather(monkeypatch):
+    """BOTH kernel formulations — 'roll' (dynamic lane rotate +
+    static slice, the round-5 default built for Mosaic's layout
+    rules) and 'slice' (dynamic lane-dim slice, the rounds-3/4
+    on-chip-failing suspect kept for diagnosis) — agree exactly with
+    the XLA gather in interpret mode, and an unknown variant name
+    fails loudly instead of silently picking one."""
+    import pytest
+    import jax.numpy as jnp
+    from tpulsar.kernels import pallas_dd
+    from tpulsar.kernels.dedisperse import _dedisperse_subbands_xla
+
+    rng = np.random.default_rng(11)
+    nsub, T, ndms = 8, 1200, 5
+    subb = rng.standard_normal((nsub, T)).astype(np.float32)
+    shifts = rng.integers(0, 290, size=(ndms, nsub)).astype(np.int32)
+    want = np.asarray(_dedisperse_subbands_xla(jnp.asarray(subb),
+                                               jnp.asarray(shifts)))
+    for variant in ("roll", "slice"):
+        monkeypatch.setenv("TPULSAR_PALLAS_VARIANT", variant)
+        assert pallas_dd.kernel_variant() == variant
+        got = np.asarray(pallas_dd.dedisperse_subbands_pallas(
+            subb, shifts, block_t=256, dm_chunk=4, interpret=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4,
+                                   err_msg=variant)
+    # the smoke cache is variant-keyed: a roll pass must not
+    # validate slice
+    monkeypatch.setenv("TPULSAR_PALLAS_VARIANT", "roll")
+    p_roll = pallas_dd._smoke_cache_path()
+    monkeypatch.setenv("TPULSAR_PALLAS_VARIANT", "slice")
+    assert pallas_dd._smoke_cache_path() != p_roll
+    monkeypatch.setenv("TPULSAR_PALLAS_VARIANT", "bogus")
+    with pytest.raises(ValueError):
+        pallas_dd.kernel_variant()
+
+
 def test_pallas_dedisperse_edge_clamp():
     """Shifts that run past the end must clamp to the last sample,
     matching the gather semantics."""

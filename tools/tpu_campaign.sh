@@ -187,7 +187,13 @@ if [ "$DRILL" = "1" ]; then
     # catch
     say "pallas step SKIPPED in drill (would poison the shared smoke cache with a CPU verdict)"
 else
-timeout 400 python -c "
+    # probe BOTH kernel variants: 'roll' (the round-5 rewrite that
+    # avoids the suspected unaligned lane-dim dynamic slice) and
+    # 'slice' (the rounds-3/4 formulation) — one window yields the
+    # full fix-or-retire picture, each with its own detail line
+    for variant in roll slice; do
+        say "pallas smoke variant=$variant"
+        env TPULSAR_PALLAS_VARIANT=$variant timeout 400 python -c "
 import os, sys; sys.path.insert(0, '$REPO')
 from tpulsar.kernels import pallas_dd
 # force a REAL probe: the memo/disk-cache fast paths would return a
@@ -202,7 +208,8 @@ ok = pallas_dd.smoke_test_ok()
 print('pallas smoke:', ok)
 print('detail:', pallas_dd.LAST_SMOKE_DETAIL)
 " >> "$LOG" 2>&1
-    probe_or_abort "chip unhealthy after pallas smoke" 7
+        probe_or_abort "chip unhealthy after pallas smoke ($variant)" 7
+    done
 fi
 
 # 3. The rung ladder (tools/campaign_params.sh RUNGS): smallest

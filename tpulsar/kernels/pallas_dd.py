@@ -36,7 +36,14 @@ from jax.experimental.pallas import tpu as pltpu
 def _kernel(shift_ref, sub_hbm, out_ref, tile, sem, *, nsub, ndms,
             block_t, window):
     """One grid step: stage (nsub, window) at t0 = i*block_t, then
-    out[d, :] = sum_s tile[s, shift[d,s] : shift[d,s]+block_t]."""
+    out[d, :] = sum_s tile[s, shift[d,s] : shift[d,s]+block_t].
+
+    'slice' variant: the shifted read is a dynamic slice whose runtime
+    offset lands on the LANE (minor) dimension at arbitrary (non-128-
+    aligned) positions — the prime suspect for the on-chip Mosaic
+    lowering failure ('Pallas smoke: False', rounds 3-4; detail now
+    captured by the campaign).  Kept selectable via
+    TPULSAR_PALLAS_VARIANT=slice for the on-chip diagnosis."""
     i = pl.program_id(0)
     dma = pltpu.make_async_copy(
         sub_hbm.at[:, pl.ds(i * block_t, window)], tile, sem)
@@ -56,11 +63,68 @@ def _kernel(shift_ref, sub_hbm, out_ref, tile, sem, *, nsub, ndms,
     jax.lax.fori_loop(0, ndms, dm_body, 0)
 
 
+def _kernel_roll(shift_ref, sub_hbm, out_ref, tile, sem, *, nsub,
+                 ndms, block_t, window):
+    """Same math as _kernel, expressed with primitives Mosaic lowers
+    on every TPU generation: the shifted read
+    tile[s, sh : sh+block_t] becomes a dynamic-scalar LANE ROTATE
+    (pltpu.roll, tpu.dynamic_rotate) followed by a STATIC slice of
+    the first block_t lanes — no dynamic lane-dimension slicing.
+    Exact because rolled[j] = row[(j + sh) mod window] and
+    j + sh < block_t + S = window for all j < block_t, sh <= S
+    (no wraparound enters the kept region).  The sublane index s
+    stays a supported dynamic sublane slice."""
+    i = pl.program_id(0)
+    dma = pltpu.make_async_copy(
+        sub_hbm.at[:, pl.ds(i * block_t, window)], tile, sem)
+    dma.start()
+    dma.wait()
+
+    def dm_body(d, _):
+        def sb_body(s, acc):
+            sh = shift_ref[d, s]
+            row = tile[pl.ds(s, 1), :]               # (1, window)
+            # window - sh, not -sh: roll's contract forbids negative
+            # amounts (only checkable for static ints — a traced
+            # negative would bypass validation and reach the chip),
+            # and (window - sh) ≡ -sh (mod window) is always positive
+            rolled = pltpu.roll(row, window - sh, 1)
+            return acc + rolled[:, :block_t]
+
+        acc0 = jnp.zeros((1, block_t), jnp.float32)
+        out_ref[pl.ds(d, 1), :] = jax.lax.fori_loop(
+            0, nsub, sb_body, acc0)
+        return 0
+
+    jax.lax.fori_loop(0, ndms, dm_body, 0)
+
+
+_KERNEL_VARIANTS = {"slice": _kernel, "roll": _kernel_roll}
+
+
+def kernel_variant() -> str:
+    """TPULSAR_PALLAS_VARIANT: which kernel formulation the Pallas
+    path (and its smoke probe — the subprocess inherits the env) uses.
+    Default 'roll': the slice variant failed its on-chip smoke in
+    rounds 3-4 and the unaligned lane-dim dynamic slice is the prime
+    suspect; roll expresses the same read with a dynamic lane rotate
+    + static slice, which Mosaic supports.  The campaign probes BOTH
+    and records each variant's detail."""
+    val = os.environ.get("TPULSAR_PALLAS_VARIANT", "roll").strip()
+    if val not in _KERNEL_VARIANTS:
+        raise ValueError(
+            f"TPULSAR_PALLAS_VARIANT must be one of "
+            f"{sorted(_KERNEL_VARIANTS)}, got {val!r}")
+    return val
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("block_t", "window", "interpret"))
+                   static_argnames=("block_t", "window", "interpret",
+                                    "variant"))
 def _dedisperse_chunk(subb_padded: jnp.ndarray, shifts: jnp.ndarray,
                       block_t: int, window: int,
-                      interpret: bool) -> jnp.ndarray:
+                      interpret: bool,
+                      variant: str = "roll") -> jnp.ndarray:
     """subb_padded: (nsub, n_blocks*block_t + S) f32, edge-padded.
     shifts: (ndms_c, nsub) int32, all in [0, S].
     Returns (ndms_c, n_blocks*block_t) f32."""
@@ -80,8 +144,8 @@ def _dedisperse_chunk(subb_padded: jnp.ndarray, shifts: jnp.ndarray,
         ],
     )
     return pl.pallas_call(
-        functools.partial(_kernel, nsub=nsub, ndms=ndms,
-                          block_t=block_t, window=window),
+        functools.partial(_KERNEL_VARIANTS[variant], nsub=nsub,
+                          ndms=ndms, block_t=block_t, window=window),
         out_shape=jax.ShapeDtypeStruct((ndms, n_blocks * block_t),
                                        jnp.float32),
         grid_spec=grid_spec,
@@ -123,13 +187,16 @@ def dedisperse_subbands_pallas(subbands, sub_shifts,
         if nrows < dm_chunk:   # keep one compiled (ndms, ...) shape
             chunk = np.pad(chunk, ((0, dm_chunk - nrows), (0, 0)))
         res = _dedisperse_chunk(subb_padded, jnp.asarray(chunk),
-                                block_t, window, interpret)
+                                block_t, window, interpret,
+                                variant=kernel_variant())
         outs.append(res[:nrows, :T])
     return jnp.concatenate(outs, axis=0)
 
 
 _DISABLED_SIGS: dict[tuple, str] = {}
-_SMOKE_OK: bool | None = None
+#: per-variant in-process smoke memo ({variant: ok}); None accepted
+#: as a legacy full reset
+_SMOKE_OK: dict | None = None
 
 #: the last smoke probe's outcome detail ("ok", or the captured
 #: subprocess stderr tail / timeout note) — the on-chip diagnosis
@@ -159,7 +226,11 @@ def _smoke_cache_path() -> str:
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "tpulsar"))
     os.makedirs(cache_dir, exist_ok=True)
-    return os.path.join(cache_dir, f"pallas_smoke_{jax.__version__}.ok")
+    # variant-keyed: a cached pass for the roll kernel must never
+    # validate the slice kernel (or vice versa)
+    return os.path.join(
+        cache_dir,
+        f"pallas_smoke_{jax.__version__}_{kernel_variant()}.ok")
 
 
 _SMOKE_SRC = r"""
@@ -199,13 +270,20 @@ def smoke_test_ok(timeout: float = 300.0) -> bool:
     try/except fallback (bench.py avoids this by probing from a parent
     that never touches jax)."""
     global _SMOKE_OK, LAST_SMOKE_DETAIL
-    if _SMOKE_OK is not None:
-        return _SMOKE_OK
+    variant = kernel_variant()
+    # The in-process memo is VARIANT-KEYED, like the disk cache: a
+    # roll verdict must never answer for slice (the campaign's
+    # diagnostic loop probes both in sequence).  Tolerate legacy
+    # resets (`pallas_dd._SMOKE_OK = None` clears everything).
+    if not isinstance(_SMOKE_OK, dict):
+        _SMOKE_OK = {}
+    if variant in _SMOKE_OK:
+        return _SMOKE_OK[variant]
     path = _smoke_cache_path()
     try:
         with open(path) as fh:
             if fh.read().strip() == "ok":
-                _SMOKE_OK = True
+                _SMOKE_OK[variant] = True
                 return True
     except OSError:
         pass
@@ -213,7 +291,7 @@ def smoke_test_ok(timeout: float = 300.0) -> bool:
         # Can't probe safely (the subprocess would contend for the
         # chip we hold); optimistically allow, signature-disable
         # catches non-hang failures.
-        _SMOKE_OK = True
+        _SMOKE_OK[variant] = True
         return True
     import subprocess
     import sys
@@ -234,8 +312,8 @@ def smoke_test_ok(timeout: float = 300.0) -> bool:
     except OSError as e:
         ok = False
         detail = str(e)
-    _SMOKE_OK = ok
-    LAST_SMOKE_DETAIL = detail or "ok"
+    _SMOKE_OK[variant] = ok
+    LAST_SMOKE_DETAIL = f"variant={variant}: " + (detail or "ok")
     if ok:
         try:
             with open(path, "w") as fh:
