@@ -41,9 +41,12 @@ assert rec.get("ok") and rec.get("platform") not in (None, "cpu"), rec
 EOF
 
 echo "==== 2. kernel smoke probes (errors are diagnostic, not fatal) ===="
-timeout 400 python -c "
-from tpulsar.kernels.pallas_dd import smoke_test_ok
-print('pallas smoke:', smoke_test_ok())" || true
+for variant in roll slice; do
+    TPULSAR_PALLAS_VARIANT=$variant timeout 400 python -c "
+from tpulsar.kernels import pallas_dd
+print('pallas smoke:', pallas_dd.smoke_test_ok())
+print('detail:', pallas_dd.LAST_SMOKE_DETAIL or 'cached-ok')" || true
+done
 timeout 400 python -c "
 from tpulsar.kernels.accel import _batch_path_usable
 print('accel batch smoke:', _batch_path_usable())" || true
