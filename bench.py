@@ -716,6 +716,11 @@ def run_child(deadline: float, extra_env: dict | None = None,
             pass
         rec = {"label": label, "status": status, "rc": rc,
                "deadline_s": deadline, "t_end": time.time(),
+               # which backend the child targeted: CPU exploration
+               # kills must never read as on-chip attempts in the
+               # collected campaign evidence
+               "platform": (env.get("JAX_PLATFORMS", "").strip()
+                            or "accelerator"),
                "elapsed_s": round(time.time() - t_start, 1), **info}
         try:
             with open(os.path.join(attempt, "attempt.json"), "w") as fh:

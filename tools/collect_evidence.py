@@ -81,8 +81,9 @@ def _attempt_records(runs_dir: str) -> list[dict]:
             continue      # successful runs are already in runs{}
         keep = {k: rec.get(k) for k in
                 ("label", "status", "rc", "deadline_s", "elapsed_s",
-                 "kill_reason", "stalled_stage", "stage_elapsed_s",
-                 "stage_progress", "attempt_dir") if k in rec}
+                 "platform", "kill_reason", "stalled_stage",
+                 "stage_elapsed_s", "stage_progress", "attempt_dir")
+                if k in rec}
         out.append(keep)
     return out[-20:]      # bound the committed record's size
 
