@@ -36,15 +36,17 @@
 #    programs, the full no-accel set ~26, the accel set adds ~12.
 
 if [ "${DRILL:-0}" = "1" ]; then
+    # same ORDER as the real ladder (headline after the quarter
+    # rungs) so the drill rehearses the real sequencing
     RUNGS="
 cfg1_quarter|1|0.03|240|120|220|160|-
 cfg1_full|1|0.06|240|150|250|200|-
 cfg2_quarter|2|0.03|300|200|320|250|-
-cfg2_full|2|0.06|400|250|380|300|-
 cfg3_quarter_f32|3|0.03|300|200|320|250|TPULSAR_ACCEL_PLANE_DTYPE=f32
 cfg3_quarter_bf16|3|0.03|300|200|320|250|TPULSAR_ACCEL_PLANE_DTYPE=bf16
-cfg4_full|4|0.06|300|200|320|250|-
 headline|0|0.06|500|400|550|450|-
+cfg2_full|2|0.06|400|250|380|300|-
+cfg4_full|4|0.06|300|200|320|250|-
 cfg5_batch|5|0.03|400|350|500|400|TPULSAR_BENCH_NBEAMS=2
 cfg4_clipped|4|0.06|300|200|320|250|TPULSAR_SP_DETREND=clipped_mean
 "
