@@ -40,10 +40,12 @@ def _kernel(shift_ref, sub_hbm, out_ref, tile, sem, *, nsub, ndms,
 
     'slice' variant: the shifted read is a dynamic slice whose runtime
     offset lands on the LANE (minor) dimension at arbitrary (non-128-
-    aligned) positions — the prime suspect for the on-chip Mosaic
-    lowering failure ('Pallas smoke: False', rounds 3-4; detail now
-    captured by the campaign).  Kept selectable via
-    TPULSAR_PALLAS_VARIANT=slice for the on-chip diagnosis."""
+    aligned) positions.  CONFIRMED on-chip (v5e, 2026-08-01 campaign):
+    Mosaic rejects it at compile time with "prove that index in
+    dimension 1 is a multiple of 128" on the generated vector.load —
+    exactly the suspected unaligned lane-dim dynamic slice.  Kept
+    selectable via TPULSAR_PALLAS_VARIANT=slice as the negative
+    control for the diagnosis."""
     i = pl.program_id(0)
     dma = pltpu.make_async_copy(
         sub_hbm.at[:, pl.ds(i * block_t, window)], tile, sem)
@@ -106,10 +108,12 @@ def kernel_variant() -> str:
     """TPULSAR_PALLAS_VARIANT: which kernel formulation the Pallas
     path (and its smoke probe — the subprocess inherits the env) uses.
     Default 'roll': the slice variant failed its on-chip smoke in
-    rounds 3-4 and the unaligned lane-dim dynamic slice is the prime
-    suspect; roll expresses the same read with a dynamic lane rotate
-    + static slice, which Mosaic supports.  The campaign probes BOTH
-    and records each variant's detail."""
+    rounds 3-4; the 2026-08-01 v5e campaign captured the error
+    ("prove that index in dimension 1 is a multiple of 128" — the
+    unaligned lane-dim dynamic slice) and the roll formulation
+    PASSES its on-chip smoke ("variant=roll: ok"), so roll is the
+    production TPU tier.  The campaign probes BOTH and records each
+    variant's detail."""
     val = os.environ.get("TPULSAR_PALLAS_VARIANT", "roll").strip()
     if val not in _KERNEL_VARIANTS:
         raise ValueError(
