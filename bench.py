@@ -274,11 +274,31 @@ def run_focused_config(cfg: int) -> None:
         # releases pass buffers the same way.
         del data, subb, series, spec, powers, wpow
         t0 = time.time()               # into the accel-only timing
-        with timers.timing("hi-accelsearch"):
-            bank = ak.build_template_bank(200.0)
-            res = ak.accel_search_batch(wspec, bank, max_numharm=16,
-                                        topk=64)
-            jax.block_until_ready(jnp.asarray(res[1][0]))
+        try:
+            with timers.timing("hi-accelsearch"):
+                bank = ak.build_template_bank(200.0)
+                res = ak.accel_search_batch(wspec, bank,
+                                            max_numharm=16, topk=64)
+                jax.block_until_ready(jnp.asarray(res[1][0]))
+        except jax.errors.JaxRuntimeError as exc:
+            # The tunneled runtime rejected the z200 programs at
+            # execution (observed 2026-08-01, cfg3_quarter_f32: the
+            # batched path AND the per-DM fallback both raised
+            # UNIMPLEMENTED while the z50 survey shapes ran fine).
+            # A crashed child records nothing — emit the rung record
+            # with the failure named instead.
+            print(json.dumps({
+                "metric": "accelsearch_z200_h16_32dm_wallclock",
+                "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+                "error": "accel_z200_runtime_rejected",
+                "detail": str(exc)[:300], "nsamp": nsamp,
+                "device": str(jax.devices()[0]),
+                "accel_plane_dtype": _plane_dtype_name(),
+                "stage_s": {k: round(v, 2)
+                            for k, v in timers.times.items()
+                            if v >= 0.005},
+            }), flush=True)
+            return
         # Plane dtype + a digest of the strongest detections, so two
         # cfg-3 runs with different TPULSAR_ACCEL_PLANE_DTYPE settings
         # are a committed candidate-level A/B, not just a wall-clock
@@ -1170,6 +1190,36 @@ def main() -> None:
                 except (subprocess.TimeoutExpired, OSError):
                     _log("Pallas smoke probe hung (kernel will use "
                          "XLA fallback via signature disable)")
+                # Same pre-probe for the stage-1 subband kernel: its
+                # verdict gates form_subbands' Pallas tier (the XLA
+                # lax.map path measured 160.6/176.5 s of config 1
+                # on-chip, rung_cfg1_full.json 2026-08-01).
+                _log("pre-running Pallas subband smoke probe")
+                try:
+                    sbsmoke = subprocess.run(
+                        [sys.executable, "-c",
+                         "import sys; sys.path.insert(0, %r); "
+                         "from tpulsar.kernels import pallas_dd as p; "
+                         "ok = p.sb_smoke_test_ok(); "
+                         "print('pallas sb smoke:', ok); "
+                         "print('detail:', p.LAST_SB_SMOKE_DETAIL or "
+                         "'cached-ok')" % _REPO],
+                        capture_output=True, text=True,
+                        timeout=smoke_cap())
+                    for ln in sbsmoke.stdout.strip().splitlines()[-2:]:
+                        _log(ln.strip()[:400])
+                    if "pallas sb smoke: True" not in sbsmoke.stdout:
+                        # The verdict must REACH the measured child:
+                        # jax is initialized there before the first
+                        # form_subbands, so sb_smoke_test_ok() would
+                        # take the optimistic backend-already-
+                        # initialized path and engage the kernel the
+                        # probe just saw fail/hang.
+                        os.environ["TPULSAR_PALLAS_SB"] = "0"
+                except (subprocess.TimeoutExpired, OSError):
+                    _log("Pallas subband smoke probe hung — pinning "
+                         "stage 1 to the XLA lax.map fallback")
+                    os.environ["TPULSAR_PALLAS_SB"] = "0"
                 # Same pre-probe for the batched accel-search path:
                 # its failure mode on a sick runtime is a hang only a
                 # subprocess can catch; on success the measured child

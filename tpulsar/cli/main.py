@@ -647,6 +647,7 @@ def cmd_doctor(args):
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "tpulsar"))
     for label, pat in [("pallas dedisperse", "pallas_smoke_*.ok"),
+                       ("pallas subbands", "pallas_sb_smoke_*.ok"),
                        ("batched accel", "accel_batch_*.ok")]:
         hits = sorted(glob.glob(os.path.join(cache_dir, pat)))
         if hits:

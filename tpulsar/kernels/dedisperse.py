@@ -137,7 +137,34 @@ def form_subbands(data: jnp.ndarray, chan_shifts, nsub: int,
     nchan = data.shape[0]
     if nchan % nsub:
         raise ValueError(f"nchan {nchan} not divisible by nsub {nsub}")
+    from tpulsar.kernels import pallas_dd
+
     shifts_np = np.asarray(chan_shifts)
+    # Stage-1 Pallas tier (same gate/fallback discipline as stage 2):
+    # the XLA `lax.map` formulation serializes the subbands and
+    # measured 160.6 s of config 1's 176.5 s on-chip wall-clock
+    # (rung_cfg1_full.json, 2026-08-01) — the VMEM-staged kernel is
+    # the production TPU path, the map the portable fallback.
+    sig = ("sb", tuple(data.shape), int(nsub), int(downsamp))
+    if pallas_dd.use_pallas_sb() and pallas_dd.signature_enabled(sig):
+        try:
+            out = pallas_dd.form_subbands_pallas(data, shifts_np,
+                                                 nsub, downsamp)
+            # force execution so a kernel fault lands in this except
+            # (async dispatch would surface it downstream)
+            jax.block_until_ready(out)
+            return out
+        except Exception as e:
+            if pallas_dd.forced():
+                raise      # TPULSAR_PALLAS=1 = no-fallback (CI mode)
+            pallas_dd.disable_signature(sig, reason=str(e)[:200])
+            from tpulsar.search import degraded
+            degraded.note("pallas_sb_disabled",
+                          f"kernel fault, XLA fallback: {str(e)[:160]}")
+    elif pallas_dd.is_tpu_backend():
+        from tpulsar.search import degraded
+        degraded.note("pallas_sb_disabled",
+                      "smoke gate or env off; XLA lax.map subband path")
     pad = _pad_bucket(int(shifts_np.max(initial=0)))
     return _form_subbands_jit(data, jnp.asarray(shifts_np), nsub,
                               downsamp, pad)

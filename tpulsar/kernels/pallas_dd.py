@@ -101,6 +101,48 @@ def _kernel_roll(shift_ref, sub_hbm, out_ref, tile, sem, *, nsub,
     jax.lax.fori_loop(0, ndms, dm_body, 0)
 
 
+def _kernel_sb(shift_ref, data_hbm, out_ref, tile, sem, *, nsub, cps,
+               block_t, window):
+    """Stage-1 subband formation, one grid step: stage the whole
+    (nchan, window) channel block at t0 = i*block_t once, then
+        out[b, :] = sum_c tile[b*cps + c, sh[b,c] : sh[b,c]+block_t]
+    with the shifted read expressed as the roll variant's dynamic
+    lane rotate + static slice (the on-chip-proven formulation — the
+    slice form is Mosaic-rejected for unaligned lane-dim dynamic
+    slices).  Replaces the XLA `lax.map` formulation that serializes
+    96 subbands and measured 160.6 s of config 1's 176.5 s on-chip
+    (bench_runs/rung_cfg1_full.json, 2026-08-01); the same sweep as a
+    VMEM-staged Pallas program is the stage-2 kernel that does 12x
+    more row-reads in 8 s.  Reference native component: the subband
+    pass of `prepsubband -sub` (PALFA2_presto_search.py:506-511).
+
+    The staged tile keeps the wrapper-provided dtype — bfloat16 for
+    quantized uint8 beams (Mosaic has no 8-bit -> f32 cast; bf16 is
+    exact for 0..255 and half the DMA traffic of a float32 stage) —
+    and rows are cast to float32 in VMEM before accumulation."""
+    i = pl.program_id(0)
+    dma = pltpu.make_async_copy(
+        data_hbm.at[:, pl.ds(i * block_t, window)], tile, sem)
+    dma.start()
+    dma.wait()
+
+    def sb_body(b, _):
+        def ch_body(c, acc):
+            sh = shift_ref[b, c]
+            row = tile[pl.ds(b * cps + c, 1), :].astype(jnp.float32)
+            # window - sh, not -sh: roll's contract forbids negative
+            # amounts (see _kernel_roll)
+            rolled = pltpu.roll(row, window - sh, 1)
+            return acc + rolled[:, :block_t]
+
+        acc0 = jnp.zeros((1, block_t), jnp.float32)
+        out_ref[pl.ds(b, 1), :] = jax.lax.fori_loop(
+            0, cps, ch_body, acc0)
+        return 0
+
+    jax.lax.fori_loop(0, nsub, sb_body, 0)
+
+
 _KERNEL_VARIANTS = {"slice": _kernel, "roll": _kernel_roll}
 
 
@@ -195,6 +237,91 @@ def dedisperse_subbands_pallas(subbands, sub_shifts,
                                 variant=kernel_variant())
         outs.append(res[:nrows, :T])
     return jnp.concatenate(outs, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("pad",))
+def _pad_widen(data: jnp.ndarray, pad: int) -> jnp.ndarray:
+    """Edge-pad, widening 8-bit beams to bfloat16 in the same fused
+    program.  Mosaic has no 8-bit -> f32 element cast ("Unsupported
+    cast: uint8 -> float32", on-chip 2026-08-01, cfg2_quarter child
+    stderr), so quantized beams must be widened before staging; bf16
+    is exact for every uint8/int8 value (8-bit mantissa) at half the
+    DMA traffic of a float32 stage.  One jitted pad+cast so XLA fuses
+    the cast into the pad and peak HBM holds the original plus ONE
+    widened padded copy — eager astype-then-pad held three beam-scale
+    buffers (~19 GB at headline scale, over a v5e's 16 GB)."""
+    out = jnp.pad(data, ((0, 0), (0, pad)), mode="edge")
+    if out.dtype.itemsize == 1:
+        out = out.astype(jnp.bfloat16)
+    return out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nsub", "block_t", "window",
+                                    "interpret"))
+def _form_subbands_block(data_padded: jnp.ndarray,
+                         shifts: jnp.ndarray, nsub: int,
+                         block_t: int, window: int,
+                         interpret: bool) -> jnp.ndarray:
+    """data_padded: (nchan, n_blocks*block_t + S) native dtype,
+    edge-padded.  shifts: (nsub, cps) int32, all in [0, S].
+    Returns (nsub, n_blocks*block_t) f32 (un-downsampled)."""
+    nchan, tp = data_padded.shape
+    cps = nchan // nsub
+    n_blocks = (tp - (window - block_t)) // block_t
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((nsub, block_t), lambda i, s_ref: (0, i),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nchan, window), data_padded.dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel_sb, nsub=nsub, cps=cps,
+                          block_t=block_t, window=window),
+        out_shape=jax.ShapeDtypeStruct((nsub, n_blocks * block_t),
+                                       jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(shifts, data_padded)
+
+
+def form_subbands_pallas(data, chan_shifts, nsub: int, downsamp: int,
+                         block_t: int = 4096,
+                         interpret: bool | None = None):
+    """Stage-1 Pallas path: (nchan, T) + per-channel shifts ->
+    (nsub, T // downsamp) f32.  Same contract as
+    dedisperse._form_subbands_jit (shift clamp to the pad bucket,
+    edge-sample padding, floor-truncating sum-downsample) with the
+    sweep restructured as one VMEM-staged sliding-window program
+    instead of a 96-step serialized `lax.map`."""
+    if interpret is None:
+        interpret = not is_tpu_backend()
+    data = jnp.asarray(data)
+    nchan, T = data.shape
+    cps = nchan // nsub
+    shifts_np = np.asarray(chan_shifts, np.int32).reshape(nsub, cps)
+    smax = int(shifts_np.max(initial=0))
+    S = max(256, 1 << int(np.ceil(np.log2(max(smax, 1)))))
+    # same clamp as the XLA formulation's min(shift, pad) — a no-op
+    # while S >= smax, kept so the two paths cannot drift
+    shifts_np = np.minimum(shifts_np, S)
+    window = block_t + S
+    n_blocks = -(-T // block_t)
+    pad = n_blocks * block_t + S - T
+    data_padded = _pad_widen(data, pad)
+    out = _form_subbands_block(data_padded, jnp.asarray(shifts_np),
+                               nsub, block_t, window, interpret)
+    out = out[:, :T]
+    if downsamp > 1:
+        n_ds = (T // downsamp) * downsamp
+        out = out[:, :n_ds].reshape(nsub, -1, downsamp).sum(axis=-1)
+    return out
 
 
 _DISABLED_SIGS: dict[tuple, str] = {}
@@ -341,6 +468,108 @@ def use_pallas() -> bool:
     if env in ("1", "on", "true"):
         return True
     return is_tpu_backend() and smoke_test_ok()
+
+
+#: stage-1 smoke memo (None = not probed this process)
+_SB_SMOKE_OK: bool | None = None
+
+#: last stage-1 smoke outcome detail, same contract as
+#: LAST_SMOKE_DETAIL (the campaign/evidence tooling greps `detail:`)
+LAST_SB_SMOKE_DETAIL: str | None = None
+
+_SB_SMOKE_SRC = r"""
+import numpy as np
+import jax.numpy as jnp
+from tpulsar.kernels.pallas_dd import form_subbands_pallas
+rng = np.random.default_rng(0)
+data = jnp.asarray(rng.integers(0, 255, (32, 4096), dtype=np.uint8))
+shifts = (np.arange(32, dtype=np.int32).reshape(8, 4) * 5)
+out = np.asarray(form_subbands_pallas(data, shifts, 8, 2,
+                                      block_t=1024))
+assert out.shape == (8, 2048) and np.isfinite(out).all()
+print("PALLAS_SB_SMOKE_OK")
+"""
+
+
+def _sb_smoke_cache_path() -> str:
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "tpulsar"))
+    os.makedirs(cache_dir, exist_ok=True)
+    return os.path.join(cache_dir,
+                        f"pallas_sb_smoke_{jax.__version__}.ok")
+
+
+def sb_smoke_test_ok(timeout: float = 300.0) -> bool:
+    """Stage-1 (subband formation) twin of smoke_test_ok: subprocess
+    probe under a hard timeout, success-only disk cache, optimistic
+    allow when this process already holds a TPU backend (the
+    per-signature try/except fallback catches non-hang failures)."""
+    global _SB_SMOKE_OK, LAST_SB_SMOKE_DETAIL
+    if _SB_SMOKE_OK is not None:
+        return _SB_SMOKE_OK
+    path = _sb_smoke_cache_path()
+    try:
+        with open(path) as fh:
+            if fh.read().strip() == "ok":
+                _SB_SMOKE_OK = True
+                return True
+    except OSError:
+        pass
+    if _backend_already_initialized():
+        _SB_SMOKE_OK = True
+        return True
+    import subprocess
+    import sys
+    detail = ""
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _SB_SMOKE_SRC],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        ok = res.returncode == 0 and "PALLAS_SB_SMOKE_OK" in res.stdout
+        if not ok:
+            detail = (f"rc={res.returncode}: "
+                      + (res.stderr or "").strip()[-500:])
+    except subprocess.TimeoutExpired:
+        ok = False
+        detail = f"hung > {timeout:.0f} s"
+    except OSError as e:
+        ok = False
+        detail = str(e)
+    _SB_SMOKE_OK = ok
+    LAST_SB_SMOKE_DETAIL = "subbands: " + (detail or "ok")
+    if ok:
+        try:
+            with open(path, "w") as fh:
+                fh.write("ok")
+        except OSError:
+            pass
+    else:
+        import warnings
+        warnings.warn("Pallas subband smoke failed/hung in subprocess; "
+                      "using XLA subband fallback this process "
+                      f"({detail})")
+    return ok
+
+
+def use_pallas_sb() -> bool:
+    """Stage-1 Pallas gate.  TPULSAR_PALLAS=0 turns off every Pallas
+    tier; TPULSAR_PALLAS_SB=0/1 then overrides for stage 1 alone
+    (the forced() no-fallback contract applies to both tiers)."""
+    genv = os.environ.get("TPULSAR_PALLAS", "").strip()
+    if genv in ("0", "off", "false"):
+        return False
+    env = os.environ.get("TPULSAR_PALLAS_SB", "").strip()
+    if env in ("0", "off", "false"):
+        return False
+    # TPULSAR_PALLAS=1 forces BOTH tiers on (the no-fallback CI
+    # contract must cover stage 1 too — a smoke-gated bypass here
+    # would keep CI green through a stage-1 Mosaic regression)
+    if env in ("1", "on", "true") or genv in ("1", "on", "true"):
+        return True
+    return is_tpu_backend() and sb_smoke_test_ok()
 
 
 def signature_enabled(sig: tuple) -> bool:

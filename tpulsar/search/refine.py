@@ -172,7 +172,19 @@ def _gather_jit():
         def _gather(spec, lo_arr, width):
             idx = lo_arr[:, None] + jnp.arange(width)[None, :]
             idx = jnp.clip(idx, 0, spec.shape[0] - 1)
-            return jnp.take(spec, idx, axis=0)
+            # Gather on the complex spectrum (device-side complex
+            # takes are proven — the cfg2_quarter refinement compute
+            # finished in 17.9 s), then SHIP float32 real/imag
+            # planes: the tunneled axon runtime raised UNIMPLEMENTED
+            # on the complex64 window fetch — the only complex host
+            # transfer in the whole search path — killing the
+            # 2026-08-01 cfg2_quarter rung at +478 s with every pass
+            # finished (bench_runs/attempts/20260801T085022_1994_cfg2).
+            # Every other fetch in the pipeline is f32 and works; the
+            # host side recombines.
+            win = jnp.take(spec, idx, axis=0)
+            return jnp.stack([win.real, win.imag],
+                             axis=-1)          # (NWIN, width, 2) f32
 
         _GATHER_JIT = jax.jit(_gather, static_argnames=("width",))
     return _GATHER_JIT
@@ -294,7 +306,8 @@ def refine_candidates(cands, series_by_dm, dt: float, nfft: int,
                                      jnp.asarray(lows, np.int32),
                                      width=width))
         fetched = np.concatenate(
-            [np.asarray(c) for c in jax.device_get(chunks_dev)],
+            [np.asarray(c[..., 0] + 1j * c[..., 1])
+             for c in jax.device_get(chunks_dev)],
             axis=0)
         windows = [(lo, fetched[i][: min(width, nbins - lo)])
                    for i, (lo, _hi) in enumerate(ranges)]
