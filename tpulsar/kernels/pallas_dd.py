@@ -101,8 +101,8 @@ def _kernel_roll(shift_ref, sub_hbm, out_ref, tile, sem, *, nsub,
     jax.lax.fori_loop(0, ndms, dm_body, 0)
 
 
-def _kernel_sb(shift_ref, data_hbm, out_ref, tile, sem, *, nsub, cps,
-               block_t, window):
+def _kernel_sb(shift_ref, data_hbm, out_ref, *scratch, nsub, cps,
+               block_t, window, needs_cast):
     """Stage-1 subband formation, one grid step: stage the whole
     (nchan, window) channel block at t0 = i*block_t once, then
         out[b, :] = sum_c tile[b*cps + c, sh[b,c] : sh[b,c]+block_t]
@@ -118,18 +118,31 @@ def _kernel_sb(shift_ref, data_hbm, out_ref, tile, sem, *, nsub, cps,
 
     The staged tile keeps the wrapper-provided dtype — bfloat16 for
     quantized uint8 beams (Mosaic has no 8-bit -> f32 cast; bf16 is
-    exact for 0..255 and half the DMA traffic of a float32 stage) —
-    and rows are cast to float32 in VMEM before accumulation."""
+    exact for 0..255 and half the DMA traffic of a float32 stage).
+    A bf16 tile is then cast ONCE to a float32 VMEM scratch so every
+    dynamic-sublane row load is f32 — the stage-2-proven pattern; a
+    dynamic single-sublane load on the 16-bit-packed bf16 tile
+    crashed the remote compile helper (HTTP 500, cfg3 rungs
+    2026-08-01).  Float32 inputs skip the second scratch and the
+    copy entirely (doubling VMEM there could push large-window
+    shapes over budget for no benefit)."""
+    if needs_cast:
+        tile, tile_f32, sem = scratch
+    else:
+        tile, sem = scratch
+        tile_f32 = tile
     i = pl.program_id(0)
     dma = pltpu.make_async_copy(
         data_hbm.at[:, pl.ds(i * block_t, window)], tile, sem)
     dma.start()
     dma.wait()
+    if needs_cast:
+        tile_f32[...] = tile[...].astype(jnp.float32)
 
     def sb_body(b, _):
         def ch_body(c, acc):
             sh = shift_ref[b, c]
-            row = tile[pl.ds(b * cps + c, 1), :].astype(jnp.float32)
+            row = tile_f32[pl.ds(b * cps + c, 1), :]
             # window - sh, not -sh: roll's contract forbids negative
             # amounts (see _kernel_roll)
             rolled = pltpu.roll(row, window - sh, 1)
@@ -269,6 +282,7 @@ def _form_subbands_block(data_padded: jnp.ndarray,
     nchan, tp = data_padded.shape
     cps = nchan // nsub
     n_blocks = (tp - (window - block_t)) // block_t
+    needs_cast = data_padded.dtype != jnp.float32
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -276,14 +290,17 @@ def _form_subbands_block(data_padded: jnp.ndarray,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((nsub, block_t), lambda i, s_ref: (0, i),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((nchan, window), data_padded.dtype),
-            pltpu.SemaphoreType.DMA(()),
-        ],
+        scratch_shapes=(
+            [pltpu.VMEM((nchan, window), data_padded.dtype)]
+            + ([pltpu.VMEM((nchan, window), jnp.float32)]
+               if needs_cast else [])
+            + [pltpu.SemaphoreType.DMA(())]
+        ),
     )
     return pl.pallas_call(
         functools.partial(_kernel_sb, nsub=nsub, cps=cps,
-                          block_t=block_t, window=window),
+                          block_t=block_t, window=window,
+                          needs_cast=needs_cast),
         out_shape=jax.ShapeDtypeStruct((nsub, n_blocks * block_t),
                                        jnp.float32),
         grid_spec=grid_spec,
