@@ -286,3 +286,89 @@ def test_stage_maxes_bit_identical_to_per_stage_sums():
                                           old.max(axis=0))
             np.testing.assert_array_equal(np.asarray(maxes[h][1]),
                                           old.argmax(axis=0))
+
+
+def test_per_dm_fallback_zero_fills_refused_rows(monkeypatch):
+    """A runtime-refused row dispatch (UNIMPLEMENTED observed on the
+    tunneled TPU runtime, 2026-08-01 headline rung) is retried once,
+    then zero-filled with a degraded-mode note — one flaky trial must
+    degrade one DM row, not kill the whole beam."""
+    import jax
+    from tpulsar.search import degraded
+
+    rng = np.random.default_rng(23)
+    nbins = 5000
+    specs = jnp.asarray((rng.normal(size=(3, nbins))
+                         + 1j * rng.normal(size=(3, nbins))
+                         ).astype(np.complex64))
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+
+    monkeypatch.setattr(accel, "_BATCH_OK", False)
+    monkeypatch.setattr(accel, "_native_cpu_path_usable",
+                        lambda: False)
+    clean = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                     topk=8)
+
+    real_row = accel.accel_row_topk
+
+    def flaky_row(full, bf, i, **kw):
+        if int(i) == 1:
+            raise jax.errors.JaxRuntimeError(
+                "UNIMPLEMENTED: TPU backend error (Unimplemented).")
+        return real_row(full, bf, i, **kw)
+
+    monkeypatch.setattr(accel, "accel_row_topk", flaky_row)
+    degraded.reset()
+    out = accel.accel_search_batch(specs, bank, max_numharm=2, topk=8)
+    for h in clean:
+        # surviving rows identical to the clean run
+        for r in (0, 2):
+            np.testing.assert_allclose(out[h][0][r], clean[h][0][r],
+                                       rtol=2e-4)
+        # the refused row is zero power, never a candidate
+        assert np.all(out[h][0][1] == 0.0)
+    snap = degraded.snapshot()
+    assert "accel_rows_zero_filled" in snap
+    assert snap["accel_rows_zero_filled"].startswith("1/3 across 1")
+
+
+def test_per_dm_fallback_recovers_deferred_drain_error(monkeypatch):
+    """An async error that surfaces at the WINDOW SYNC (jax is
+    async — the most plausible surfacing point) must not zero-fill
+    the whole window: each pending row is re-dispatched
+    synchronously and only individually refused rows are lost."""
+    import jax
+    from tpulsar.search import degraded
+
+    rng = np.random.default_rng(29)
+    nbins = 5000
+    specs = jnp.asarray((rng.normal(size=(3, nbins))
+                         + 1j * rng.normal(size=(3, nbins))
+                         ).astype(np.complex64))
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+
+    monkeypatch.setattr(accel, "_BATCH_OK", False)
+    monkeypatch.setattr(accel, "_native_cpu_path_usable",
+                        lambda: False)
+    clean = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                     topk=8)
+
+    real_get = jax.device_get
+    state = {"raised": False}
+
+    def flaky_get(x):
+        if not state["raised"] and isinstance(x, list) and len(x) > 1:
+            state["raised"] = True
+            raise jax.errors.JaxRuntimeError(
+                "UNIMPLEMENTED: TPU backend error (Unimplemented).")
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", flaky_get)
+    degraded.reset()
+    out = accel.accel_search_batch(specs, bank, max_numharm=2, topk=8)
+    monkeypatch.setattr(jax, "device_get", real_get)
+    assert state["raised"]
+    for h in clean:
+        np.testing.assert_allclose(out[h][0], clean[h][0], rtol=2e-4)
+    # every row recovered on the sync retry: nothing degraded
+    assert "accel_rows_zero_filled" not in degraded.snapshot()

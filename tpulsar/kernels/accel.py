@@ -793,13 +793,70 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
     if not use_batch:
         # Per-DM fallback: exactly the shapes of the proven
         # single-spectrum path ((nz, seg) iffts, no DM batch axis),
-        # same windowed async dispatch.
+        # same windowed async dispatch.  Row dispatches can STILL be
+        # rejected by the tunneled runtime (UNIMPLEMENTED observed
+        # 2026-08-01 on the headline rung: 38 rows of pass 1 ran,
+        # then pass 2's first dispatch was refused) — a refused row
+        # is retried once (sync'd, in case the error belonged to a
+        # prior async dispatch), then zero-filled and recorded so one
+        # flaky trial degrades one DM row instead of killing the
+        # whole beam at +1500 s with nothing to show.
         pending = []
-        for i in range(ndms):
-            pending.append((i, 1, row_fn(spectra, bank_fft, i)))
-            if len(pending) >= SYNC_WINDOW:
+        failed_rows: list[int] = []
+
+        def _zero_fill(rows):
+            for r in rows:
+                # zero power sifts below every threshold
+                vals[r] = 0.0
+                rbins[r] = 0
+                zidx[r] = 0
+                failed_rows.append(r)
+
+        def _safe_drain():
+            try:
                 _drain(pending)
-        _drain(pending)
+            except jax.errors.JaxRuntimeError:
+                # A deferred async error surfaces at the window sync
+                # and poisons the whole window; most of those rows
+                # are fine.  Re-dispatch each one SYNCHRONOUSLY so
+                # only the truly refused rows are zero-filled.
+                stalled = [s0 for s0, _n, _t in pending]
+                pending.clear()
+                for r in stalled:
+                    try:
+                        one = [(r, 1, row_fn(spectra, bank_fft, r))]
+                        _drain(one)
+                    except jax.errors.JaxRuntimeError:
+                        _zero_fill([r])
+
+        for i in range(ndms):
+            try:
+                pending.append((i, 1, row_fn(spectra, bank_fft, i)))
+            except jax.errors.JaxRuntimeError:
+                _safe_drain()   # flush async state, then retry once
+                try:
+                    pending.append((i, 1,
+                                    row_fn(spectra, bank_fft, i)))
+                except jax.errors.JaxRuntimeError:
+                    _zero_fill([i])
+            if len(pending) >= SYNC_WINDOW:
+                _safe_drain()
+        _safe_drain()
+        if failed_rows:
+            from tpulsar.search import degraded
+            # count(), not note(): this fires once per DM chunk and
+            # the totals must ACCUMULATE across the pass.  Row ids
+            # are chunk-local, so only counts are recorded.
+            degraded.count(
+                "accel_rows_zero_filled", len(failed_rows), ndms,
+                extra="runtime refused these accel rows (each "
+                      "retried synchronously); powers zero-filled — "
+                      "hi-accel coverage is PARTIAL")
+            import warnings
+            warnings.warn(
+                f"accel per-DM fallback: {len(failed_rows)}/{ndms} "
+                "rows refused by the runtime and zero-filled "
+                "(degraded-mode note recorded)")
     zs = np.asarray(bank.zs)
     return {h: (vals[:, si_, :], rbins[:, si_, :], zs[zidx[:, si_, :]])
             for si_, h in enumerate(stages)}

@@ -19,6 +19,7 @@ search run snapshots + resets around its own execution.
 from __future__ import annotations
 
 _FLAGS: dict[str, str] = {}
+_COUNTS: dict[str, list[int]] = {}
 
 
 def note(flag: str, detail: str = "") -> None:
@@ -27,9 +28,24 @@ def note(flag: str, detail: str = "") -> None:
     _FLAGS.setdefault(flag, detail)
 
 
+def count(flag: str, n: int, of: int, extra: str = "") -> None:
+    """Accumulate a COUNTED degraded event across calls.  note() is
+    first-wins, which under-reports events that recur per chunk/pass
+    (a run where chunk 0 loses 1 row and chunk 3 loses 32 must not
+    record only the 1): the flag's detail is rewritten with the
+    running totals on every call."""
+    c = _COUNTS.setdefault(flag, [0, 0, 0])
+    c[0] += n
+    c[1] += of
+    c[2] += 1
+    _FLAGS[flag] = (f"{c[0]}/{c[1]} across {c[2]} call(s)"
+                    + (f"; {extra}" if extra else ""))
+
+
 def snapshot() -> dict[str, str]:
     return dict(_FLAGS)
 
 
 def reset() -> None:
     _FLAGS.clear()
+    _COUNTS.clear()
