@@ -372,3 +372,27 @@ def test_per_dm_fallback_recovers_deferred_drain_error(monkeypatch):
         np.testing.assert_allclose(out[h][0], clean[h][0], rtol=2e-4)
     # every row recovered on the sync retry: nothing degraded
     assert "accel_rows_zero_filled" not in degraded.snapshot()
+
+
+def test_per_dm_fallback_total_refusal_raises(monkeypatch):
+    """When the runtime refuses EVERY row (twice each), the search
+    must not return an all-zero result dressed as success."""
+    import jax
+    import pytest
+
+    rng = np.random.default_rng(31)
+    specs = jnp.asarray((rng.normal(size=(2, 4000))
+                         + 1j * rng.normal(size=(2, 4000))
+                         ).astype(np.complex64))
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    monkeypatch.setattr(accel, "_BATCH_OK", False)
+    monkeypatch.setattr(accel, "_native_cpu_path_usable",
+                        lambda: False)
+
+    def refuse(full, bf, i, **kw):
+        raise jax.errors.JaxRuntimeError(
+            "UNIMPLEMENTED: TPU backend error (Unimplemented).")
+
+    monkeypatch.setattr(accel, "accel_row_topk", refuse)
+    with pytest.raises(accel.AccelStageRefused):
+        accel.accel_search_batch(specs, bank, max_numharm=2, topk=8)

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Bisect the hi-accel UNIMPLEMENTED refusal ON TPU.
+
+Round-5 on-chip finding: the batched accel path is runtime-rejected
+(UNIMPLEMENTED at execution; the gate compiles it cleanly) at survey
+shapes — z50 full-scale and z200 quarter — while the small-shape
+accel-batch smoke passes, and per-DM row programs are refused
+intermittently from the second pass onward.  hi-accel is 80%+ of the
+headline wall-clock, so this refusal decides the <60 s target.
+
+This script grows (nbins, nz, nrows) from the known-good smoke shape
+toward the survey shape and reports the first (dimension, size) that
+flips to UNIMPLEMENTED, running each probe in a subprocess under a
+timeout so a hang cannot wedge the sweep.
+
+Usage (chip must be free — take the campaign lock first):
+    flock .campaign.lock python tools/diag_accel_unimpl.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, ".jax_cache"))
+
+_PROBE = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+import numpy as np, jax, jax.numpy as jnp
+from tpulsar.kernels import accel
+rng = np.random.default_rng(0)
+nrows, nbins, zmax = %(nrows)d, %(nbins)d, %(zmax).1f
+specs = jnp.asarray((rng.normal(size=(nrows, nbins))
+                     + 1j * rng.normal(size=(nrows, nbins))
+                     ).astype(np.complex64))
+bank = accel.build_template_bank(zmax)
+bank_fft = jnp.asarray(bank.bank_fft)
+out = accel.accel_chunk_topk(specs, bank_fft, np.int32(0),
+                             nrows=nrows, seg=bank.seg,
+                             step=bank.step, width=bank.width,
+                             nz=len(bank.zs), max_numharm=16, topk=64)
+jax.block_until_ready(out)
+print("PROBE_OK")
+"""
+
+#: (nrows, nbins, zmax) ladder from smoke-ish shapes to the survey
+#: full-scale shape; each step grows ONE dimension
+LADDER = [
+    (1, 65536, 50.0),
+    (1, 491521, 50.0),       # quarter-scale nbins
+    (1, 1966081, 50.0),      # full-scale nbins
+    (4, 1966081, 50.0),
+    (38, 491521, 50.0),
+    (38, 1966081, 50.0),     # survey chunk shape (the refused one)
+    (1, 491521, 200.0),      # cfg3 quarter shape (refused)
+]
+
+
+def main() -> int:
+    results = []
+    for nrows, nbins, zmax in LADDER:
+        src = _PROBE % {"repo": _REPO, "nrows": nrows,
+                        "nbins": nbins, "zmax": zmax}
+        try:
+            res = subprocess.run([sys.executable, "-c", src],
+                                 capture_output=True, text=True,
+                                 timeout=900)
+            if res.returncode == 0 and "PROBE_OK" in res.stdout:
+                verdict = "ok"
+            else:
+                tail = (res.stderr or "").strip().splitlines()
+                verdict = (tail[-1][:200] if tail else
+                           f"rc={res.returncode}")
+        except subprocess.TimeoutExpired:
+            verdict = "hung>900s"
+        rec = {"nrows": nrows, "nbins": nbins, "zmax": zmax,
+               "verdict": verdict}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+        if verdict != "ok" and "UNIMPLEMENTED" not in verdict:
+            # a hang or crash mid-sweep: stop before wedging the chip
+            break
+    out = os.path.join(_REPO, "bench_runs", "accel_unimpl_bisect.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as fh:
+        json.dump(results, fh, indent=1)
+    print("wrote", out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

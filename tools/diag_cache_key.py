@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Diagnose the gate-vs-child persistent-cache key mismatch ON TPU.
+
+Round-5 finding: the cfg1_full measured child spent 160.6 s of its
+176.5 s wall-clock recompiling `_form_subbands_jit` in-line even
+though the AOT gate had compiled the identical HLO minutes earlier
+(cache entries differ in hash AND size; CPU two-process repros HIT).
+This script runs both sides at a small scale on the real chip with
+the compilation-cache loggers at DEBUG so the two keys are printed
+and can be diffed.
+
+Usage (chip must be free — take the campaign lock first):
+    flock .campaign.lock python tools/diag_cache_key.py [--scale 0.02]
+
+Runs two subprocesses sharing JAX_COMPILATION_CACHE_DIR:
+  1. gate-style:  jit.lower(ShapeDtypeStruct...).compile()
+  2. bench-style: plain dispatch on real device arrays
+and prints each side's "Writing ... with key" / "cache hit" lines.
+A mismatch shows two different keys for byte-identical HLO — the
+delta must then be in the compile-options/config salt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, ".jax_cache_diag"))
+
+_COMMON = r"""
+import sys, logging
+sys.path.insert(0, %(repo)r)
+logging.basicConfig(level=logging.WARNING)
+for n in ("jax._src.compilation_cache", "jax._src.compiler"):
+    logging.getLogger(n).setLevel(logging.DEBUG)
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+from tpulsar.kernels import dedisperse as dd
+NCHAN, FCTR, BW, TSAMP = 960, 1375.5, 322.617, 65.476e-6
+T = int(%(scale)f * 3932160) // 2048 * 2048
+freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
+dms = np.arange(128) * 2.0
+ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 96, 140.0, dms, TSAMP, 1)
+pad1 = dd._pad_bucket(int(np.asarray(ch_sh).max(initial=0)))
+print("dev:", jax.devices()[0], "T:", T, "pad1:", pad1)
+"""
+
+_GATE = _COMMON + r"""
+S = jax.ShapeDtypeStruct
+c = dd._form_subbands_jit.lower(
+    S((NCHAN, T), jnp.uint8), S((NCHAN,), jnp.int32),
+    nsub=96, downsamp=1, pad=pad1).compile()
+print("GATE COMPILED")
+"""
+
+_BENCH = _COMMON + r"""
+data = jnp.zeros((NCHAN, T), jnp.uint8)
+import os
+os.environ["TPULSAR_PALLAS_SB"] = "0"   # force the XLA path
+out = dd.form_subbands(data, ch_sh, 96, 1)
+jax.block_until_ready(out)
+print("BENCH CALLED")
+"""
+
+
+def run(tag: str, src: str, timeout: float) -> None:
+    print(f"=== {tag} ===", flush=True)
+    res = subprocess.run([sys.executable, "-c", src],
+                         capture_output=True, text=True,
+                         timeout=timeout)
+    for ln in (res.stdout + res.stderr).splitlines():
+        if any(k in ln for k in ("key", "cache", "GATE", "BENCH",
+                                 "dev:", "Error", "error")):
+            print("  " + ln[:300], flush=True)
+    print(f"=== {tag} rc={res.returncode} ===", flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.02)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+    sub = {"repo": _REPO, "scale": args.scale}
+    run("gate-style", _GATE % sub, args.timeout)
+    run("bench-style", _BENCH % sub, args.timeout)
+    print("compare the two 'with key' lines above: same key = hit "
+          "(mismatch solved); different keys on identical HLO = "
+          "compile-options/config salt — diff the full DEBUG output.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

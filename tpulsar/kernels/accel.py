@@ -33,6 +33,14 @@ import numpy as np
 DZ = 2.0  # z-plane step in bins (PRESTO's accelsearch grid spacing)
 
 
+class AccelStageRefused(RuntimeError):
+    """The runtime refused EVERY per-DM dispatch of an accel chunk
+    (each retried once): not flakiness but an outright program
+    rejection.  Raised instead of returning an all-zero result
+    dressed as success; the executor converts it into a loud
+    degraded skip of that pass's hi stage."""
+
+
 def z_grid(zmax: float) -> np.ndarray:
     """Symmetric z values searched: -zmax..zmax step DZ (0 included)."""
     n = int(round(zmax / DZ))
@@ -818,14 +826,22 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
             except jax.errors.JaxRuntimeError:
                 # A deferred async error surfaces at the window sync
                 # and poisons the whole window; most of those rows
-                # are fine.  Re-dispatch each one SYNCHRONOUSLY so
-                # only the truly refused rows are zero-filled.
-                stalled = [s0 for s0, _n, _t in pending]
+                # finished on device.  First try to FETCH each
+                # pending result individually (KB-scale top-k blocks,
+                # no recompute); re-dispatch synchronously only the
+                # entries whose own fetch raises; zero-fill only rows
+                # refused twice.
+                stalled = pending[:]
                 pending.clear()
-                for r in stalled:
+                for r, nr, tup in stalled:
                     try:
-                        one = [(r, 1, row_fn(spectra, bank_fft, r))]
-                        _drain(one)
+                        _drain([(r, nr, tup)])
+                        continue
+                    except jax.errors.JaxRuntimeError:
+                        pass
+                    try:
+                        _drain([(r, nr, row_fn(spectra, bank_fft,
+                                               r))])
                     except jax.errors.JaxRuntimeError:
                         _zero_fill([r])
 
@@ -842,16 +858,29 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
             if len(pending) >= SYNC_WINDOW:
                 _safe_drain()
         _safe_drain()
+        if failed_rows and len(failed_rows) == ndms:
+            # EVERY row refused twice: the runtime is not flaky, it
+            # is refusing this program outright.  An all-zero result
+            # dressed as success would hide that; raise and let the
+            # caller decide (the executor skips this pass's hi stage
+            # with a loud degraded note and keeps the beam alive).
+            raise AccelStageRefused(
+                f"accel per-DM fallback: runtime refused all "
+                f"{ndms} rows (each retried once after a sync "
+                f"flush)")
+        # count(), not note(): this fires once per DM chunk and the
+        # totals must ACCUMULATE across the pass — including the
+        # clean chunks' rows in the denominator, or the recorded
+        # fraction overstates the loss.  Row ids are chunk-local, so
+        # only counts are recorded.  Zero-failure calls still feed
+        # the denominator; the flag is only written once n > 0.
+        from tpulsar.search import degraded
+        degraded.count(
+            "accel_rows_zero_filled", len(failed_rows), ndms,
+            extra="runtime refused these accel rows (each retried "
+                  "synchronously); powers zero-filled — hi-accel "
+                  "coverage is PARTIAL")
         if failed_rows:
-            from tpulsar.search import degraded
-            # count(), not note(): this fires once per DM chunk and
-            # the totals must ACCUMULATE across the pass.  Row ids
-            # are chunk-local, so only counts are recorded.
-            degraded.count(
-                "accel_rows_zero_filled", len(failed_rows), ndms,
-                extra="runtime refused these accel rows (each "
-                      "retried synchronously); powers zero-filled — "
-                      "hi-accel coverage is PARTIAL")
             import warnings
             warnings.warn(
                 f"accel per-DM fallback: {len(failed_rows)}/{ndms} "

@@ -33,13 +33,19 @@ def count(flag: str, n: int, of: int, extra: str = "") -> None:
     first-wins, which under-reports events that recur per chunk/pass
     (a run where chunk 0 loses 1 row and chunk 3 loses 32 must not
     record only the 1): the flag's detail is rewritten with the
-    running totals on every call."""
+    running totals on every call.
+
+    Call with n=0 for clean chunks too — the denominator must cover
+    every chunk the path processed or the recorded fraction
+    overstates the loss.  The flag itself is only written (the run
+    only counts as degraded) once the cumulative n is positive."""
     c = _COUNTS.setdefault(flag, [0, 0, 0])
     c[0] += n
     c[1] += of
     c[2] += 1
-    _FLAGS[flag] = (f"{c[0]}/{c[1]} across {c[2]} call(s)"
-                    + (f"; {extra}" if extra else ""))
+    if c[0] > 0:
+        _FLAGS[flag] = (f"{c[0]}/{c[1]} across {c[2]} call(s)"
+                        + (f"; {extra}" if extra else ""))
 
 
 def snapshot() -> dict[str, str]:

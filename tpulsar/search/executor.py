@@ -867,9 +867,22 @@ def _hi_accel_pass(wspec, dm_chunk, T_s, params: SearchParams
     spectra (device-batched; the spectrum is shared with the lo
     stage)."""
     bank = _get_bank(params.hi_accel_zmax)
-    res = accel_k.accel_search_batch(
-        wspec, bank, max_numharm=params.hi_accel_numharm,
-        topk=params.topk_per_stage)
+    try:
+        res = accel_k.accel_search_batch(
+            wspec, bank, max_numharm=params.hi_accel_numharm,
+            topk=params.topk_per_stage)
+    except accel_k.AccelStageRefused as exc:
+        # The runtime refused the whole chunk outright (observed
+        # UNIMPLEMENTED on the tunneled axon runtime, 2026-08-01).
+        # Skip THIS chunk's hi stage loudly: the beam keeps its SP,
+        # lo, fold, and other chunks' hi science instead of dying
+        # with nothing recorded.
+        from tpulsar.search import degraded
+        degraded.count("accel_hi_chunk_skipped", len(dm_chunk),
+                       len(dm_chunk), extra=str(exc)[:160])
+        import warnings
+        warnings.warn(f"hi-accel chunk skipped: {exc}")
+        return []
 
     # z~0 rows are the lo search's job (z_min_abs); sub-threshold rows
     # never become Python objects (sigma_min pre-filter).  The
