@@ -157,3 +157,52 @@ def test_whitened_spectrum_fusion_matches_sequence():
         series, jnp.asarray(keep), nfft=nfft))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
     assert np.all(got[:, 100:120] == 0)
+
+
+def test_whiten_level_matches_interp():
+    """The factored-out segment lookup in whiten_powers must equal
+    jnp.interp bin-for-bin (same formula, the search just runs once
+    instead of per row)."""
+    import jax
+    import jax.numpy as jnp
+    from tpulsar.kernels import fourier as fr
+
+    rng = np.random.default_rng(41)
+    nbins = 40000
+    powers = jnp.asarray(
+        rng.exponential(size=(3, nbins)).astype(np.float32))
+    edges = tuple(int(e) for e in fr._block_edges(nbins))
+    got = np.asarray(fr.whiten_powers(powers, edges))
+
+    # oracle: the original per-row jnp.interp formulation
+    centers, med_parts = [], []
+    for lo, hi in zip(edges[:-1], edges[1:]):
+        centers.append(0.5 * (lo + hi))
+        med_parts.append(jnp.median(powers[..., lo:hi],
+                                    axis=-1)[..., None])
+    tail_start = int(edges[-1])
+    ntail = nbins - tail_start
+    m = ntail // fr.MAX_WHITEN_BLOCK
+    if m > 0:
+        tail = powers[..., tail_start: tail_start
+                      + m * fr.MAX_WHITEN_BLOCK]
+        tail = tail.reshape(powers.shape[:-1]
+                            + (m, fr.MAX_WHITEN_BLOCK))
+        med_parts.append(jnp.median(tail, axis=-1))
+        centers.extend(tail_start + (j + 0.5) * fr.MAX_WHITEN_BLOCK
+                       for j in range(m))
+    rem = ntail - m * fr.MAX_WHITEN_BLOCK
+    if rem > 16:
+        lo = nbins - rem
+        centers.append(0.5 * (lo + nbins))
+        med_parts.append(jnp.median(powers[..., lo:],
+                                    axis=-1)[..., None])
+    med = jnp.concatenate(med_parts, axis=-1) / jnp.log(2.0)
+    med = jnp.maximum(med, 1e-30)
+    carr = jnp.asarray(centers, dtype=jnp.float32)
+    bins = jnp.arange(nbins, dtype=jnp.float32)
+    level = jax.vmap(lambda mrow: jnp.interp(bins, carr, mrow))(
+        med.reshape(-1, med.shape[-1])).reshape(
+            powers.shape[:-1] + (nbins,))
+    want = np.asarray(powers / level)
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=1e-7)

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Measure the tunneled runtime's per-dispatch overhead.
+
+Round-5 hypothesis: on the axon tunnel each program execution costs
+~1.4 s of round-trip latency regardless of compute (the health
+probe's 256x256 matmul "matmul_s" is 1.4-1.6 s), so per-pass
+wall-clock is dominated by DISPATCH COUNT, not FLOPs — which decides
+whether the fused-single-device pass program (one dispatch per DM
+chunk instead of ~5) is worth wiring.
+
+Measures, on whatever backend jax resolves:
+  1. blocked RTT: N tiny matmuls, each block_until_ready
+  2. async amortization: N tiny matmuls enqueued, ONE final block
+  3. compute scaling: one big matmul (MXU-bound) for contrast
+
+Usage (chip must be free — take the campaign lock first):
+    flock .campaign.lock timeout 300 python tools/diag_rtt.py
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out = {"device": str(dev)}
+
+    small = jnp.ones((256, 256), jnp.bfloat16)
+    f = jax.jit(lambda x: x @ x)
+    f(small).block_until_ready()          # warm the compile
+
+    N = 8
+    t0 = time.time()
+    for _ in range(N):
+        f(small).block_until_ready()
+    out["blocked_rtt_s"] = round((time.time() - t0) / N, 3)
+
+    t0 = time.time()
+    y = small
+    for _ in range(N):
+        y = f(y)
+    y.block_until_ready()
+    out["async_amortized_s"] = round((time.time() - t0) / N, 3)
+
+    big = jnp.ones((8192, 8192), jnp.bfloat16)
+    f(big).block_until_ready()            # warm
+    t0 = time.time()
+    f(big).block_until_ready()
+    out["big_matmul_s"] = round(time.time() - t0, 3)
+
+    # one fetch of a KB-scale result (the pipeline's drain pattern)
+    t0 = time.time()
+    _ = jax.device_get([f(small) for _ in range(N)])
+    out["enqueue8_one_get_s"] = round(time.time() - t0, 3)
+
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
