@@ -197,8 +197,13 @@ def main() -> int:
             sers = S((ndms, nsamp), jnp.float32)
             pows = S((ndms, nbins), jnp.float32)
             check("complex_spectrum", fr.complex_spectrum, sers)
-            check("whiten_powers", fr.whiten_powers, pows,
-                  edges=tuple(int(e) for e in fr._block_edges(nbins)))
+            # the exact jitted callable with the estimator resolved
+            # as the measured run resolves it (TPULSAR_WHITEN_ESTIMATOR
+            # is inherited by this subprocess) — fr.whiten_powers is
+            # the resolving wrapper, not the program
+            check("whiten_powers", fr._whiten_powers_jit, pows,
+                  edges=tuple(int(e) for e in fr._block_edges(nbins)),
+                  estimator=fr.whiten_estimator())
             bank = ak.build_template_bank(200.0)
             nz = len(bank.zs)
             dmc = min(ndms, ak.plane_dm_chunk(nbins, nz))

@@ -15,6 +15,8 @@ host-side sigma conversion (sigma_from_power) exact.
 
 from __future__ import annotations
 
+import os
+
 from functools import partial
 
 import jax
@@ -81,21 +83,77 @@ def _block_edges(nbins: int, first_block: int = 6,
     return np.asarray(edges, dtype=np.int64)
 
 
-@partial(jax.jit, static_argnames=("edges",))
-def whiten_powers(powers: jnp.ndarray, edges: tuple[int, ...]) -> jnp.ndarray:
+def whiten_estimator() -> str:
+    """TPULSAR_WHITEN_ESTIMATOR: block noise-level estimator for the
+    rednoise whitening.  'median' (default) is PRESTO's robust choice
+    (median/ln2 = mean for exponential noise) but a sort per block —
+    the dominant cost of the on-chip FFT stage (~90 s of
+    cfg2_quarter's 186.6 s, 2026-08-01).  'clipped_mean' replaces the
+    sort with two reductions: mean, clip at 4x the mean (kills bright
+    bins/birdies the way the median's breakdown point does for
+    moderate contamination), re-mean with the exponential-tail
+    correction 1/(1-e^-4).  Opt-in until an on-chip candidate-list
+    A/B validates it (same protocol as TPULSAR_SP_DETREND)."""
+    val = os.environ.get("TPULSAR_WHITEN_ESTIMATOR", "median").strip()
+    if val not in ("median", "clipped_mean"):
+        raise ValueError(
+            f"TPULSAR_WHITEN_ESTIMATOR must be median|clipped_mean, "
+            f"got {val!r}")
+    return val
+
+
+def _block_level(x: jnp.ndarray, estimator: str) -> jnp.ndarray:
+    """Mean-noise-level estimate over the last axis (exponential
+    noise): robust to bright bins, already in MEAN units (the median
+    path applies the median->mean factor 1/ln2 here, not at the
+    caller)."""
+    if estimator == "median":
+        return jnp.median(x, axis=-1) / float(np.log(2.0))
+    m1 = jnp.mean(x, axis=-1, keepdims=True)
+    clipped = jnp.minimum(x, 4.0 * m1)
+    # E[min(X, 4 mu)] = mu (1 - e^-4) for X ~ Exp(mu)
+    return jnp.mean(clipped, axis=-1) / (1.0 - float(np.exp(-4.0)))
+
+
+def whiten_powers(powers: jnp.ndarray, edges: tuple[int, ...],
+                  estimator: str | None = None) -> jnp.ndarray:
     """Divide powers by a piecewise local noise level estimated from
-    block medians (median/ln2 = mean for exponential noise), linearly
-    interpolated between block centers.
+    block statistics (median/ln2 or clipped mean — see
+    whiten_estimator), linearly interpolated between block centers.
 
     powers: (..., nbins).  edges: static log-section boundaries; bins
     past edges[-1] are normalized with equal MAX_WHITEN_BLOCK blocks.
-    """
+
+    The estimator resolves OUTSIDE the jit boundary so an env change
+    retraces instead of silently reusing the first compilation (the
+    sp_detrend pattern)."""
+    if estimator is None:
+        estimator = whiten_estimator()
+    elif estimator not in ("median", "clipped_mean"):
+        raise ValueError(
+            f"estimator must be median|clipped_mean, got {estimator!r}"
+            " (a silently ignored value would change the whitening "
+            "statistics with no warning)")
+    return _whiten_powers_jit(powers, edges, estimator)
+
+
+@partial(jax.jit, static_argnames=("edges", "estimator"))
+def _whiten_powers_jit(powers: jnp.ndarray, edges: tuple[int, ...],
+                       estimator: str) -> jnp.ndarray:
     nbins = powers.shape[-1]
     centers: list[float] = []
     med_parts: list[jnp.ndarray] = []
+    # The log-spaced HEAD blocks always use the median: they are tiny
+    # (6..8192 bins — their sorts are noise next to the ~2M-bin
+    # tail's), and a mean-clip is not robust there (one 4000-power
+    # birdie in a 6-bin block inflates the clip threshold enough to
+    # keep most of its power; the median gives ~the true level).
+    # The estimator choice only governs the equal-width tail blocks,
+    # where a single birdie cannot move the first-pass mean.
     for lo, hi in zip(edges[:-1], edges[1:]):
         centers.append(0.5 * (lo + hi))
-        med_parts.append(jnp.median(powers[..., lo:hi], axis=-1)[..., None])
+        med_parts.append(_block_level(powers[..., lo:hi],
+                                      "median")[..., None])
 
     tail_start = int(edges[-1])
     ntail = nbins - tail_start
@@ -103,16 +161,19 @@ def whiten_powers(powers: jnp.ndarray, edges: tuple[int, ...]) -> jnp.ndarray:
     if m > 0:
         tail = powers[..., tail_start: tail_start + m * MAX_WHITEN_BLOCK]
         tail = tail.reshape(powers.shape[:-1] + (m, MAX_WHITEN_BLOCK))
-        med_parts.append(jnp.median(tail, axis=-1))
+        med_parts.append(_block_level(tail, estimator))
         centers.extend(tail_start + (j + 0.5) * MAX_WHITEN_BLOCK
                        for j in range(m))
     rem = ntail - m * MAX_WHITEN_BLOCK
     if rem > 16:
+        # the remainder block can be as small as 17 bins — median,
+        # for the same robustness reason as the head blocks
         lo = nbins - rem
         centers.append(0.5 * (lo + nbins))
-        med_parts.append(jnp.median(powers[..., lo:], axis=-1)[..., None])
+        med_parts.append(_block_level(powers[..., lo:],
+                                      "median")[..., None])
 
-    med = jnp.concatenate(med_parts, axis=-1) / jnp.log(2.0)
+    med = jnp.concatenate(med_parts, axis=-1)
     med = jnp.maximum(med, 1e-30)
     centers = jnp.asarray(centers, dtype=jnp.float32)
 
@@ -134,9 +195,10 @@ def whiten_powers(powers: jnp.ndarray, edges: tuple[int, ...]) -> jnp.ndarray:
     return powers / level
 
 
-def whiten(powers: jnp.ndarray) -> jnp.ndarray:
+def whiten(powers: jnp.ndarray,
+           estimator: str | None = None) -> jnp.ndarray:
     edges = tuple(int(e) for e in _block_edges(powers.shape[-1]))
-    return whiten_powers(powers, edges)
+    return whiten_powers(powers, edges, estimator=estimator)
 
 
 # ------------------------------------------------------------- zapbirds
@@ -179,7 +241,8 @@ def zap_mask(nbins: int, T: float, zaplist: np.ndarray,
 # ------------------------------------------------- whitening pipeline
 
 def whitened_powers(spec: jnp.ndarray,
-                    keep_mask: jnp.ndarray | None = None) -> tuple:
+                    keep_mask: jnp.ndarray | None = None,
+                    estimator: str | None = None) -> tuple:
     """(powers, wpow) from a complex spectrum: zap -> whiten -> re-zap
     (the re-zap because the local level estimate only partially
     excludes zapped bins).  THE definition of the spectral whitening
@@ -188,7 +251,7 @@ def whitened_powers(spec: jnp.ndarray,
     powers = jnp.abs(spec) ** 2
     if keep_mask is not None:
         powers = powers * keep_mask.astype(powers.dtype)
-    wpow = whiten(powers)
+    wpow = whiten(powers, estimator=estimator)
     if keep_mask is not None:
         wpow = wpow * keep_mask.astype(wpow.dtype)
     return powers, wpow

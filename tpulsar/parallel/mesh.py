@@ -51,6 +51,10 @@ class SearchStepSpec:
     max_numharm: int
     topk: int
     whiten_edges: tuple[int, ...]
+    whiten_est: str = "median"  # block noise estimator (static spec
+    #                             config, NOT an ambient env read — an
+    #                             env change under the outer jit would
+    #                             silently reuse the stale trace)
     dd_pad: int = 0    # static stage-2 shift bound (>= max sub_shift);
     #                    0 = pad by the full series length (always
     #                    correct, 2x subband HBM — fine for demos)
@@ -79,7 +83,8 @@ def _local_search(subbands, sub_shifts, keep_mask, spec: SearchStepSpec):
     powers = jnp.abs(cspec) ** 2
     powers = powers.at[..., 0].set(0.0)
     powers = powers * keep_mask
-    wpow = whiten_powers(powers, spec.whiten_edges)
+    wpow = whiten_powers(powers, spec.whiten_edges,
+                         estimator=spec.whiten_est)
     wpow = wpow * keep_mask
     p2 = interbin_powers(scale_spectrum(cspec, powers, wpow))
 
@@ -144,6 +149,9 @@ class PassSpec:
     hi: bool                    # run the accelerated (zmax>0) search
     sp_detrend: str = "median"  # SP baseline estimator (see
     #                             kernels/singlepulse.normalize_series)
+    whiten_est: str = "median"  # whitening block estimator (static
+    #                             spec config for the same stale-trace
+    #                             reason as SearchStepSpec.whiten_est)
     hi_numharm: int = 8
     hi_seg: int = 0             # TemplateBank geometry (static)
     hi_step: int = 0
@@ -258,7 +266,8 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
         sp_snr, sp_idx = sp_k.boxcar_search(norm, spec.sp_widths,
                                             spec.sp_topk)
         cspec = fr.complex_spectrum(fr.pad_series(series, spec.nfft))
-        powers, wpow = fr.whitened_powers(cspec, keep)
+        powers, wpow = fr.whitened_powers(
+            cspec, keep, estimator=spec.whiten_est)
         # half-bin detection grid (interbinning, PRESTO ACCEL_DR=0.5)
         # — identical to the single-device path; bin indices are in
         # half-bin units and the host applies bin_scale=0.5

@@ -980,6 +980,7 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
         topk=params.topk_per_stage,
         sp_widths=tuple(params.sp_widths), sp_topk=sp_k.DEFAULT_TOPK,
         sp_detrend=sp_k.detrend_estimator(params.sp_detrend),
+        whiten_est=fr.whiten_estimator(),
         hi=hi_sharded, hi_numharm=params.hi_accel_numharm,
         hi_seg=bank.seg if hi_sharded else 0,
         hi_step=bank.step if hi_sharded else 0,
