@@ -1,0 +1,60 @@
+#!/bin/bash
+# One focused long-deadline headline attempt, designed from the
+# 2026-08-01 campaign evidence:
+#
+#  * TPULSAR_ACCEL_BATCH=0 — the batched accel path EXECUTES for
+#    ~800 s at survey shapes and is then refused at the result fetch
+#    (UNIMPLEMENTED), after which the per-DM fallback re-does the
+#    work; pinning per-DM skips the burn (pass-1 hi measured 932.8 s
+#    with the burn; per-DM alone is ~40-60 s/pass warm).
+#  * TPULSAR_STAGE_BUDGET_MULT=2 — the 900 s hi budget killed the
+#    12:16 attempt 23 s before pass 1's hi completed.
+#  * deadline 4500 s — estimated full plan at per-DM hi is
+#    ~3300-3600 s; the outer timeout stays a catastrophic backstop.
+#
+# Usage: nohup bash tools/headline_long.sh >> headline_long.log 2>&1 &
+
+set -u
+cd "$(dirname "$0")/.."
+REPO=$(pwd)
+LOG="$REPO/headline_long.log"
+say() { echo "[headline $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+exec 9> "$REPO/.campaign.lock"
+if ! flock -w 60 9; then
+    say "campaign lock held; refusing to contend for the chip"
+    exit 5
+fi
+export TPULSAR_CAMPAIGN_LOCK_HELD=1
+
+probe() {
+    timeout 150 python -c "
+import tpulsar, json, sys
+r = tpulsar.probe_device_subprocess(timeout=120)
+print(json.dumps(r))
+sys.exit(0 if r.get('ok') and r.get('platform') != 'cpu' else 1)
+" >> "$LOG" 2>&1
+}
+probe || { say "ABORT: chip unhealthy"; exit 1; }
+say "probe healthy — gating the full program set (warm resume loop)"
+
+bash tools/aot_gate_loop.sh "$LOG" 1800 --scale 1.0 --accel > /dev/null
+grc=$?
+[ $grc -ne 0 ] && { say "gate rc=$grc — running anyway from warm cache"; }
+
+say "measured run: full plan, per-DM accel pinned, deadline 4500 s"
+env TPULSAR_ACCEL_BATCH=0 TPULSAR_STAGE_BUDGET_MULT=2 \
+    TPULSAR_BENCH_SCALE=1.0 TPULSAR_BENCH_LADDER=0 \
+    TPULSAR_BENCH_AOT=0 TPULSAR_BENCH_CPU_FALLBACK=0 \
+    TPULSAR_BENCH_DEADLINE=4500 TPULSAR_BENCH_TOTAL_BUDGET=4700 \
+    timeout 5000 python bench.py > bench_runs/headline_long.json \
+    2>> "$LOG"
+say "result: $(tail -c 700 bench_runs/headline_long.json)"
+
+out=$(python tools/collect_evidence.py 2>>"$LOG") || exit 0
+[ -f "$out" ] || exit 0
+f=$(basename "$out")
+git add -- "$f" 2>>"$LOG"
+git diff --cached --quiet -- "$f" || git commit -q -m \
+    "Record long-deadline headline evidence ($f)" -- "$f" >>"$LOG" 2>&1
+say "done"
