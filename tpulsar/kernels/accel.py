@@ -373,7 +373,26 @@ def plane_dm_chunk(nbins: int, nz: int, max_chunk: int = 32) -> int:
     # ~128 B/bin (+64 fixed), a bigger CPU zc raises it in step.
     per_dm = (nz * nbins * 2 * (2 * plane_itemsize() + 4)
               + nbins * (64 + 32 * z_chunk()))
-    return max(1, min(max_chunk, PLANE_HBM_BUDGET // max(per_dm, 1)))
+    chunk = max(1, min(max_chunk, PLANE_HBM_BUDGET // max(per_dm, 1)))
+    # The tunneled axon runtime additionally REFUSES (UNIMPLEMENTED
+    # at the fetch/execution, not a compile error) chunk programs
+    # whose (chunk, nz, 2*nbins) plane grows past ~1.2e9 elements,
+    # even when the HBM budget holds: bisected on-chip 2026-08-01
+    # (bench_runs/accel_unimpl_bisect.json + follow-ups — full-scale
+    # survey shapes pass at 5 rows and fail at 6; quarter passes at
+    # 24 and fails at 38).  Cap the plane at 1.0e9 f32 elements for
+    # margin; TPULSAR_ACCEL_PLANE_ELEMS overrides for re-bisecting
+    # on other runtimes.  Applied on every backend where it binds
+    # tighter than HBM only on the tunnel-scale shapes; CPU chunks
+    # are already smaller.
+    try:
+        max_elems = float(os.environ.get("TPULSAR_ACCEL_PLANE_ELEMS",
+                                         "1e9"))
+    except ValueError:
+        max_elems = 1e9
+    per_dm_elems = nz * nbins * 2
+    elem_cap = max(1, int(max_elems // max(per_dm_elems, 1)))
+    return min(chunk, elem_cap)
 
 
 def _pad_rows(x2d: jnp.ndarray, multiple: int) -> jnp.ndarray:
@@ -754,7 +773,18 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
     # of chunk programs (they run back-to-back on device; outputs are
     # KB-scale top-k blocks, temps don't stack because execution is
     # sequential), then fetch the whole window in one sync.
-    SYNC_WINDOW = 32
+    # TPULSAR_ACCEL_SYNC_WINDOW: how many chunk programs are enqueued
+    # before one blocking drain.  32 amortizes host round-trips on
+    # latency-bound links; 1 serializes — on the tunneled axon
+    # runtime a deep queue of multi-GB-temp chunk programs is what
+    # flips execution to UNIMPLEMENTED (a single identical program
+    # runs fine; bisected on-chip 2026-08-01), so the tunnel profile
+    # pins this to 1.
+    try:
+        SYNC_WINDOW = max(1, int(os.environ.get(
+            "TPULSAR_ACCEL_SYNC_WINDOW", "32")))
+    except ValueError:
+        SYNC_WINDOW = 32
 
     from tpulsar.search.report import progress_beat
 
