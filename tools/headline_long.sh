@@ -44,6 +44,7 @@ grc=$?
 
 say "measured run: full plan, per-DM accel pinned, deadline 4500 s"
 env TPULSAR_ACCEL_BATCH=0 TPULSAR_STAGE_BUDGET_MULT=2 \
+    TPULSAR_ACCEL_SYNC_WINDOW=4 \
     TPULSAR_BENCH_SCALE=1.0 TPULSAR_BENCH_LADDER=0 \
     TPULSAR_BENCH_AOT=0 TPULSAR_BENCH_CPU_FALLBACK=0 \
     TPULSAR_BENCH_DEADLINE=4500 TPULSAR_BENCH_TOTAL_BUDGET=4700 \
