@@ -1282,6 +1282,25 @@ def main() -> None:
                         # still attempt the gated full-scale run.
                         ladder.append({"scale": rung, "error": st,
                                        **rinfo, **_read_partial()})
+                        if rinfo.get("stalled_stage") \
+                                == "hi-accelsearch":
+                            # exact match: 'after:hi-accelsearch'
+                            # means the stage FINISHED and the hang
+                            # is in the next scope — not an accel
+                            # stall
+                            # The hi stage hangs its first window
+                            # drain on this runtime (2026-08-01: every
+                            # configuration at every scale except one;
+                            # BENCH_accel_bisect_r05.json) — a rung
+                            # killed THERE predicts the full-scale
+                            # attempt dying the same way.  Degrade to
+                            # accel-off for the rest of this bench,
+                            # recorded loudly: a completed beam with
+                            # accel_stage=false beats a -1 record.
+                            os.environ["TPULSAR_BENCH_ACCEL"] = "0"
+                            _log("rung stalled IN hi-accelsearch — "
+                                 "disabling the accel stage for the "
+                                 "remaining attempts (recorded)")
                         _log(f"rung {rung} exceeded its cap — "
                              "skipping remaining rungs, proceeding "
                              "to the AOT-gated full-scale run")
@@ -1308,6 +1327,30 @@ def main() -> None:
             status, result, kinfo = run_child(
                 eff_deadline,
                 label=f"cfg{bench_cfg}" if bench_cfg else "headline")
+            hi_stall = None
+            if (result is None and bench_cfg == 0
+                    and status in ("timeout", "stall", "stage_budget")
+                    and kinfo.get("stalled_stage") == "hi-accelsearch"
+                    and os.environ.get("TPULSAR_BENCH_ACCEL") != "0"
+                    and remaining() > 700.0):
+                # Same hi-stage hang at full scale: retry ONCE with
+                # the accel stage disabled so the record is a
+                # completed beam with accel_stage=false and the stall
+                # attribution attached, not a bare -1 (the complete
+                # no-accel full-scale beam measures 641 s warm,
+                # BENCH_fullscale_noaccel_r05.json).  hi_stall rides
+                # to the FINAL record below — median sampling can
+                # replace `result`, and a failed retry must still
+                # carry the original accel attribution.
+                _log("full-scale run stalled IN hi-accelsearch — "
+                     "one retry with the accel stage disabled")
+                hi_stall = {k: kinfo[k] for k in
+                            ("stalled_stage", "stage_elapsed_s",
+                             "kill_reason") if k in kinfo}
+                os.environ["TPULSAR_BENCH_ACCEL"] = "0"
+                eff_deadline = min(deadline, remaining())
+                status, result, kinfo = run_child(
+                    eff_deadline, label="headline_noaccel")
             # TPULSAR_BENCH_SAMPLES=N (default 1): repeat the measured
             # run and make the MEDIAN the headline, samples listed —
             # full-scale CPU wall-clock varies ±40% run-to-run on this
@@ -1362,6 +1405,10 @@ def main() -> None:
                     # on-chip timeout record was missing
                     "probe": probe, **kinfo, **partial,
                 }
+            if hi_stall:
+                # attach on WHATEVER record survived (median pick,
+                # completed retry, or the retry's own error record)
+                result["accel_stage_disabled_after_stall"] = hi_stall
             if aot_rec is not None:
                 result.setdefault("aot_check", aot_rec)
             if ladder:
