@@ -213,13 +213,20 @@ def _dedisperse_chunk(subb_padded: jnp.ndarray, shifts: jnp.ndarray,
 
 
 def dedisperse_subbands_pallas(subbands, sub_shifts,
-                               block_t: int = 2048,
+                               block_t: int | None = None,
                                dm_chunk: int = 32,
                                interpret: bool | None = None):
     """(nsub, T) + (ndms, nsub) int32 -> (ndms, T) f32.
 
     DM trials are processed `dm_chunk` at a time to bound the SMEM
     shift table and the VMEM output block.
+
+    block_t None = adaptive: prefer 4096 (measured 28 vs 47 ms/trial
+    against 2048 at survey full scale, 2026-08-01 on-chip probe —
+    fewer grid steps amortize the DMA better), downshifting when the
+    scoped-VMEM estimate for (tile + out block) would approach the
+    16 MB stack limit Mosaic enforces (observed: 17.5 MB request
+    rejected with 'exceeded scoped vmem limit').
     """
     if interpret is None:
         # interpret mode on a real chip would be a catastrophic
@@ -234,6 +241,12 @@ def dedisperse_subbands_pallas(subbands, sub_shifts,
     # round the staging overhang up so (block, window) signatures are
     # shared across passes with similar max shifts
     S = max(256, 1 << int(np.ceil(np.log2(max(smax, 1)))))
+    if block_t is None:
+        block_t = 4096
+        while block_t > 1024 and (
+                4 * (nsub * (block_t + S)
+                     + min(dm_chunk, ndms) * block_t)) > 13_000_000:
+            block_t //= 2
     window = block_t + S
     n_blocks = -(-T // block_t)
     pad = n_blocks * block_t + S - T
@@ -309,7 +322,7 @@ def _form_subbands_block(data_padded: jnp.ndarray,
 
 
 def form_subbands_pallas(data, chan_shifts, nsub: int, downsamp: int,
-                         block_t: int = 4096,
+                         block_t: int | None = None,
                          interpret: bool | None = None):
     """Stage-1 Pallas path: (nchan, T) + per-channel shifts ->
     (nsub, T // downsamp) f32.  Same contract as
@@ -328,6 +341,19 @@ def form_subbands_pallas(data, chan_shifts, nsub: int, downsamp: int,
     # same clamp as the XLA formulation's min(shift, pad) — a no-op
     # while S >= smax, kept so the two paths cannot drift
     shifts_np = np.minimum(shifts_np, S)
+    if block_t is None:
+        # Fit the native tile + f32 scratch + out block inside
+        # Mosaic's 16 MB scoped-VMEM stack (the full-survey crash of
+        # the earlier block_t=4096 default: 960-channel tiles at
+        # window 4352 need ~25 MB across the two scratches — the
+        # compile helper died with HTTP 500 before the limit was
+        # known; the stage-2 probe surfaced the real error).
+        block_t = 4096
+        itm = data.dtype.itemsize if data.dtype.itemsize > 1 else 2
+        while block_t > 512 and (
+                (itm + 4) * nchan * (block_t + S)
+                + 4 * nsub * block_t) > 13_000_000:
+            block_t //= 2
     window = block_t + S
     n_blocks = -(-T // block_t)
     pad = n_blocks * block_t + S - T
