@@ -360,3 +360,31 @@ def test_form_subbands_dispatch_fallback(monkeypatch):
     monkeypatch.delenv("TPULSAR_PALLAS_SB", raising=False)
     monkeypatch.setenv("TPULSAR_PALLAS", "1")
     assert pallas_dd.use_pallas_sb()
+
+
+def test_pallas_form_subbands_slabbed_matches_single():
+    """The time-slabbed sweep (bounding the widened copy's HBM) must
+    agree exactly with the single-slab result, including slab
+    boundaries where a slab reads its successor's samples and the
+    final slab edge-pads."""
+    import jax.numpy as jnp
+    from tpulsar.kernels import pallas_dd
+
+    rng = np.random.default_rng(47)
+    nchan, T, nsub = 16, 3000, 4
+    data = rng.integers(0, 255, size=(nchan, T), dtype=np.uint8)
+    shifts = rng.integers(0, 290, size=nchan).astype(np.int32)
+    one = np.asarray(pallas_dd.form_subbands_pallas(
+        data, shifts, nsub, 1, block_t=256, interpret=True))
+    # tiny budget -> many slabs (block_t=256, nchan=16: slab_t=256)
+    many = np.asarray(pallas_dd.form_subbands_pallas(
+        data, shifts, nsub, 1, block_t=256, interpret=True,
+        slab_bytes=16 * 2 * 256))
+    np.testing.assert_array_equal(one, many)
+    # downsampling composes with slabs
+    one_ds = np.asarray(pallas_dd.form_subbands_pallas(
+        data, shifts, nsub, 3, block_t=256, interpret=True))
+    many_ds = np.asarray(pallas_dd.form_subbands_pallas(
+        data, shifts, nsub, 3, block_t=256, interpret=True,
+        slab_bytes=16 * 2 * 256))
+    np.testing.assert_array_equal(one_ds, many_ds)

@@ -323,7 +323,8 @@ def _form_subbands_block(data_padded: jnp.ndarray,
 
 def form_subbands_pallas(data, chan_shifts, nsub: int, downsamp: int,
                          block_t: int | None = None,
-                         interpret: bool | None = None):
+                         interpret: bool | None = None,
+                         slab_bytes: int = 2_000_000_000):
     """Stage-1 Pallas path: (nchan, T) + per-channel shifts ->
     (nsub, T // downsamp) f32.  Same contract as
     dedisperse._form_subbands_jit (shift clamp to the pad bucket,
@@ -355,12 +356,39 @@ def form_subbands_pallas(data, chan_shifts, nsub: int, downsamp: int,
                 + 4 * nsub * block_t) > 13_000_000:
             block_t //= 2
     window = block_t + S
-    n_blocks = -(-T // block_t)
-    pad = n_blocks * block_t + S - T
-    data_padded = _pad_widen(data, pad)
-    out = _form_subbands_block(data_padded, jnp.asarray(shifts_np),
-                               nsub, block_t, window, interpret)
-    out = out[:, :T]
+    # Time-SLAB the sweep so the widened (bf16) padded copy of a
+    # quantized beam never holds a whole-beam allocation: the eager
+    # per-call copy (~7.5 GB at full survey scale) tipped a full-plan
+    # run into RESOURCE_EXHAUSTED at the pass-29 plan boundary
+    # (attempt 20260801T173113).  Each slab needs [t0, t1 + S) of
+    # input; only the final slab edge-pads.  ~2 GB widened per slab.
+    # budget in the WIDENED dtype: 1-byte inputs stage as bf16 (2 B),
+    # wider dtypes stay as-is
+    widened_itm = max(data.dtype.itemsize, 2)
+    slab_elems = slab_bytes // (widened_itm * nchan)
+    slab_t = max(block_t, (slab_elems // block_t) * block_t)
+    shifts_dev = jnp.asarray(shifts_np)
+    outs = []
+    for t0 in range(0, T, slab_t):
+        t1 = min(t0 + slab_t, T)
+        Ts = t1 - t0
+        n_blocks = -(-Ts // block_t)
+        need = n_blocks * block_t + S
+        avail = T - t0
+        if avail >= need:
+            slab = jax.lax.slice_in_dim(data, t0, t0 + need, axis=1)
+            slab = _pad_widen(slab, 0)
+        else:
+            slab = jax.lax.slice_in_dim(data, t0, T, axis=1)
+            slab = _pad_widen(slab, need - avail)
+        res = _form_subbands_block(slab, shifts_dev, nsub, block_t,
+                                   window, interpret)
+        # block PER SLAB: async dispatch would otherwise race the
+        # loop and allocate every widened slab copy concurrently —
+        # the exact whole-beam-widened peak the slabbing bounds
+        jax.block_until_ready(res)
+        outs.append(res[:, :Ts])
+    out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
     if downsamp > 1:
         n_ds = (T // downsamp) * downsamp
         out = out[:, :n_ds].reshape(nsub, -1, downsamp).sum(axis=-1)
