@@ -381,12 +381,17 @@ def form_subbands_pallas(data, chan_shifts, nsub: int, downsamp: int,
         else:
             slab = jax.lax.slice_in_dim(data, t0, T, axis=1)
             slab = _pad_widen(slab, need - avail)
+        if len(outs) >= 2:
+            # 2-deep backpressure (the executor's pending[-2]
+            # pattern): a hard per-slab block serialized the sweep
+            # (74 s/beam vs the XLA map's 22 s warm), while NO block
+            # lets async dispatch allocate every widened slab copy
+            # concurrently — the RESOURCE_EXHAUSTED peak the slabbing
+            # bounds.  Two slabs in flight ≈ 4 GB widened, and the
+            # DMA of slab k overlaps the compute of slab k-1.
+            jax.block_until_ready(outs[-2])
         res = _form_subbands_block(slab, shifts_dev, nsub, block_t,
                                    window, interpret)
-        # block PER SLAB: async dispatch would otherwise race the
-        # loop and allocate every widened slab copy concurrently —
-        # the exact whole-beam-widened peak the slabbing bounds
-        jax.block_until_ready(res)
         outs.append(res[:, :Ts])
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
     if downsamp > 1:
