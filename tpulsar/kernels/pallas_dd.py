@@ -214,12 +214,15 @@ def _dedisperse_chunk(subb_padded: jnp.ndarray, shifts: jnp.ndarray,
 
 def dedisperse_subbands_pallas(subbands, sub_shifts,
                                block_t: int | None = None,
-                               dm_chunk: int = 32,
+                               dm_chunk: int = 76,
                                interpret: bool | None = None):
     """(nsub, T) + (ndms, nsub) int32 -> (ndms, T) f32.
 
     DM trials are processed `dm_chunk` at a time to bound the SMEM
-    shift table and the VMEM output block.
+    shift table and the VMEM output block; 76 (one survey pass per
+    call) measured 22 vs the old 32-chunk's 35 ms/trial on-chip —
+    short calls still clamp to ndms, so the fold path's single-DM
+    programs are unchanged.
 
     block_t None = adaptive: prefer 4096 (measured 28 vs 47 ms/trial
     against 2048 at survey full scale, 2026-08-01 on-chip probe —
