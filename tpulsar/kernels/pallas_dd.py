@@ -214,15 +214,17 @@ def _dedisperse_chunk(subb_padded: jnp.ndarray, shifts: jnp.ndarray,
 
 def dedisperse_subbands_pallas(subbands, sub_shifts,
                                block_t: int | None = None,
-                               dm_chunk: int = 76,
+                               dm_chunk: int = 32,
                                interpret: bool | None = None):
     """(nsub, T) + (ndms, nsub) int32 -> (ndms, T) f32.
 
     DM trials are processed `dm_chunk` at a time to bound the SMEM
-    shift table and the VMEM output block; 76 (one survey pass per
-    call) measured 22 vs the old 32-chunk's 35 ms/trial on-chip —
-    short calls still clamp to ndms, so the fold path's single-DM
-    programs are unchanged.
+    shift table and the VMEM output block.  A standalone 76-row call
+    measures 22 vs 35 ms/trial against 32-row chunks, but the
+    executor's pass chunking feeds at most ~38 rows per call, so a
+    larger default only forces a new compile family without ever
+    making the large calls (a 76-default run regressed to 448 s
+    end-to-end); 32 stays the default.
 
     block_t None = adaptive: prefer 4096 (measured 28 vs 47 ms/trial
     against 2048 at survey full scale, 2026-08-01 on-chip probe —
