@@ -1,6 +1,16 @@
 #!/bin/bash
-# One focused long-deadline headline attempt, designed from the
-# 2026-08-01 campaign evidence:
+# One focused long-deadline headline attempt.
+#
+# OUTDATED PREMISE (kept for the record): later on 2026-08-01 the
+# per-DM hi path ALSO hung its first window drain at every scale
+# tested (full z50, quarter z50, 8.33% z50 — see
+# BENCH_accel_bisect_r05.json and docs/search.md).  The working
+# production shape is the bench's automatic accel-off degrade
+# (validated: BENCH_driver_rehearsal_r05.json, complete 340.8 s
+# full-scale beam under default budgets).  Use this script only to
+# re-test the hi family after a runtime change.
+#
+# Original design notes from the campaign evidence:
 #
 #  * TPULSAR_ACCEL_BATCH=0 — the batched accel path EXECUTES for
 #    ~800 s at survey shapes and is then refused at the result fetch
