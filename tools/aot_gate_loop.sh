@@ -25,6 +25,7 @@ LOG="$1"; DEADLINE="$2"; shift 2
 
 aot_rc=3
 prev_deferred=-1
+lowest_deferred=-1
 while [ "$aot_rc" -eq 3 ]; do
     tmp=$(mktemp /tmp/aot_gate.XXXXXX)
     timeout 7200 python tools/aot_check.py --deadline "$DEADLINE" "$@" \
@@ -41,12 +42,19 @@ while [ "$aot_rc" -eq 3 ]; do
                 | tee -a "$LOG"
             exit 2
         fi
-        # Progress = a new LOWEST deferred count.  One non-improving
-        # attempt is granted as grace with the grown count adopted as
-        # the new baseline (a mid-campaign code change invalidates
-        # cache entries and grows the set once — this aborted
-        # cfg2_full on 2026-08-01 when a whitening change landed
-        # mid-gate); a second consecutive non-improvement exits 2.
+        # Progress = a new LOWEST-SEEN deferred count; only that
+        # re-earns the grace.  One non-shrinking attempt is granted as
+        # grace (a mid-campaign code change invalidates cache entries
+        # and grows the set once — this aborted cfg2_full on
+        # 2026-08-01 when a whitening change landed mid-gate) and the
+        # grown count becomes the working shrink baseline, but a
+        # shrink/grow oscillation that never beats the lowest-seen
+        # count exits 2 at its second grow instead of being re-graced
+        # forever (the attempt cap was the only bound before).
+        if [ "$lowest_deferred" -lt 0 ] || [ "$deferred" -lt "$lowest_deferred" ]; then
+            lowest_deferred=$deferred
+            graced=0
+        fi
         if [ "$prev_deferred" -ge 0 ] && [ "$deferred" -ge "$prev_deferred" ]; then
             if [ "${graced:-0}" -eq 1 ]; then
                 echo "aot gate stopped converging ($deferred still deferred)" \
@@ -56,8 +64,6 @@ while [ "$aot_rc" -eq 3 ]; do
             graced=1
             echo "aot gate not shrinking ($deferred deferred) — one grace attempt" \
                 | tee -a "$LOG"
-        else
-            graced=0
         fi
         prev_deferred=$deferred
         echo "aot gate deferred $deferred programs; resuming from cache" \

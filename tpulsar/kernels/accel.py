@@ -35,10 +35,12 @@ DZ = 2.0  # z-plane step in bins (PRESTO's accelsearch grid spacing)
 
 class AccelStageRefused(RuntimeError):
     """The runtime refused EVERY per-DM dispatch of an accel chunk
-    (each retried once): not flakiness but an outright program
-    rejection.  Raised instead of returning an all-zero result
-    dressed as success; the executor converts it into a loud
-    degraded skip of that pass's hi stage."""
+    (each retried once) AND the host-CPU rescue recovered none of
+    them: not flakiness but an outright rejection with no healthy
+    device to fall back on.  Raised instead of returning an all-zero
+    result dressed as success; the executor attempts a whole-chunk
+    host rescue and only then converts it into a loud degraded skip
+    of that pass's hi stage."""
 
 
 def z_grid(zmax: float) -> np.ndarray:
@@ -315,6 +317,32 @@ def plane_dtype():
 def plane_itemsize() -> int:
     return jnp.dtype(plane_dtype()).itemsize
 
+
+def _dispatch_deadline_s() -> float:
+    """TPULSAR_ACCEL_DISPATCH_DEADLINE_S: per-dispatch watchdog for
+    the hi-accel row/chunk programs.  0 (default) = no watchdog (no
+    thread per dispatch on healthy runtimes); > 0 converts a hung
+    dispatch into a classified refusal that the retry/rescue path
+    handles like an UNIMPLEMENTED — the session-poisoning hang
+    observed on the tunneled runtime, bounded."""
+    try:
+        return float(os.environ.get(
+            "TPULSAR_ACCEL_DISPATCH_DEADLINE_S", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def _breaker_threshold() -> int:
+    """TPULSAR_ACCEL_BREAKER_THRESHOLD: consecutive refused row
+    dispatches before the per-DM loop stops dispatching to the
+    session and routes the remaining rows straight to host rescue."""
+    try:
+        v = int(os.environ.get("TPULSAR_ACCEL_BREAKER_THRESHOLD",
+                               "8"))
+    except ValueError:
+        v = 8
+    return max(1, v)
+
 # z-templates correlated per inverse-FFT call in the batched path;
 # bounds the (nd*nsegs*z_chunk(), seg) intermediate.  Resolved lazily
 # per backend: 16 on CPU (25% faster at survey shapes — fewer, larger
@@ -381,18 +409,33 @@ def plane_dm_chunk(nbins: int, nz: int, max_chunk: int = 32) -> int:
     # (bench_runs/accel_unimpl_bisect.json + follow-ups — full-scale
     # survey shapes pass at 5 rows and fail at 6; quarter passes at
     # 24 and fails at 38).  Cap the plane at 1.0e9 f32 elements for
-    # margin; TPULSAR_ACCEL_PLANE_ELEMS overrides for re-bisecting
-    # on other runtimes.  Applied on every backend where it binds
-    # tighter than HBM only on the tunnel-scale shapes; CPU chunks
-    # are already smaller.
+    # margin.  The cap is a workaround for ONE runtime's quirk, so it
+    # only applies on the tunnel profile (the axon backend) — a
+    # healthy runtime keeps the HBM-only sizing and its fewer, larger
+    # dispatches; TPULSAR_ACCEL_PLANE_ELEMS forces the cap on any
+    # backend for re-bisecting.
+    forced_elems = os.environ.get("TPULSAR_ACCEL_PLANE_ELEMS",
+                                  "").strip()
+    if not forced_elems and not _tunnel_runtime():
+        return chunk
     try:
-        max_elems = float(os.environ.get("TPULSAR_ACCEL_PLANE_ELEMS",
-                                         "1e9"))
+        max_elems = float(forced_elems or "1e9")
     except ValueError:
         max_elems = 1e9
     per_dm_elems = nz * nbins * 2
     elem_cap = max(1, int(max_elems // max(per_dm_elems, 1)))
     return min(chunk, elem_cap)
+
+
+def _tunnel_runtime() -> bool:
+    """True on the tunneled axon runtime — the only backend the
+    plane-element refusal cap exists for.  Called from plane_dm_chunk,
+    whose callers already hold device arrays, so consulting the
+    backend is safe here (never at import)."""
+    try:
+        return jax.default_backend() == "axon"
+    except Exception:
+        return False
 
 
 def _pad_rows(x2d: jnp.ndarray, multiple: int) -> jnp.ndarray:
@@ -647,6 +690,13 @@ def _native_cpu_path_usable() -> bool:
     disabled via TPULSAR_ACCEL_NATIVE=0."""
     if os.environ.get("TPULSAR_ACCEL_NATIVE", "").strip() == "0":
         return False
+    from tpulsar.resilience import faults
+    if faults.targets_prefix("accel."):
+        # a fault-injection run targeting the accel dispatch points
+        # exists to exercise the XLA dispatch paths; the native host
+        # consumer has no device dispatch to refuse and would bypass
+        # the path under test
+        return False
     if os.environ.get("TPULSAR_ACCEL_BATCH", "").strip() in ("0", "1"):
         # an explicit batch-path pin is a diagnostic control over the
         # XLA path choice — honour it (and its degraded-mode note)
@@ -746,18 +796,50 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                                          topk, dm_chunk)
         if out is not None:
             return out
+    from tpulsar.resilience import faults
+    from tpulsar.resilience import policy as rpolicy
+    from tpulsar.resilience.policy import (CircuitBreaker,
+                                           CircuitOpenError,
+                                           DeadlineExceeded,
+                                           run_with_deadline)
+
     use_batch = _batch_path_usable()
+    if use_batch and faults.targets("accel.row_dispatch") \
+            and not faults.targets("accel.chunk"):
+        # a fault spec naming the per-DM dispatch point pins the
+        # per-DM path: the injection run exists to exercise exactly
+        # that degrade path, which the batched path never enters
+        use_batch = False
+
+    # Everything the retry/rescue machinery classifies as a refusal:
+    # the runtime's own rejection, the injected equivalents (incl. a
+    # poisoned fault session), and a dispatch that outlived the
+    # watchdog deadline (a hang converted into a failure instead of
+    # an unbounded stall).
+    REFUSED = (jax.errors.JaxRuntimeError, DeadlineExceeded,
+               faults.SessionPoisoned)
+    deadline_s = _dispatch_deadline_s()
 
     def chunk_fn(full, bf, c0, nrows):
-        return accel_chunk_topk(full, bf, np.int32(c0), nrows=nrows,
-                                seg=bank.seg, step=bank.step,
-                                width=bank.width, nz=nz,
-                                max_numharm=max_numharm, topk=topk)
+        def attempt():
+            faults.fire("accel.chunk", detail=f"dm chunk @{c0}")
+            return accel_chunk_topk(full, bf, np.int32(c0),
+                                    nrows=nrows, seg=bank.seg,
+                                    step=bank.step, width=bank.width,
+                                    nz=nz, max_numharm=max_numharm,
+                                    topk=topk)
+        return run_with_deadline(attempt, deadline_s,
+                                 label=f"accel chunk @{c0}")
 
     def row_fn(full, bf, i):
-        return accel_row_topk(full, bf, np.int32(i), seg=bank.seg,
-                              step=bank.step, width=bank.width, nz=nz,
-                              max_numharm=max_numharm, topk=topk)
+        def attempt():
+            faults.fire("accel.row_dispatch", detail=f"row {i}")
+            return accel_row_topk(full, bf, np.int32(i), seg=bank.seg,
+                                  step=bank.step, width=bank.width,
+                                  nz=nz, max_numharm=max_numharm,
+                                  topk=topk)
+        return run_with_deadline(attempt, deadline_s,
+                                 label=f"accel row {i}")
 
     stages = harmonic_stages(max_numharm)
     nstages = len(stages)
@@ -790,7 +872,15 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
 
     def _drain(pending):
         done = 0
-        for s0, nrows, tup in jax.device_get(pending):
+        # the watchdog must cover the SYNC too: JAX dispatch is
+        # async, so a poisoned-session hang surfaces here at
+        # device_get, not at the enqueue the row/chunk closures
+        # already bound.  Only the fetch runs on the watched thread —
+        # an abandoned overdue fetch can never write into vals/rbins.
+        fetched = run_with_deadline(
+            lambda: jax.device_get(pending), deadline_s,
+            label="accel window sync")
+        for s0, nrows, tup in fetched:
             vals[s0:s0 + nrows] = tup[0]
             rbins[s0:s0 + nrows] = tup[1]
             zidx[s0:s0 + nrows] = tup[2]
@@ -813,11 +903,13 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                 if len(pending) >= SYNC_WINDOW:
                     _drain(pending)
             _drain(pending)
-        except jax.errors.JaxRuntimeError as exc:
+        except REFUSED as exc:
             # The runtime rejected the batched shapes (the catchable
             # failure mode, surfacing at dispatch or at the window
-            # sync; a hang is only caught by the subprocess gate).
-            # Downgrade for the rest of the process.
+            # sync; a hang is caught by the subprocess gate or, when
+            # TPULSAR_ACCEL_DISPATCH_DEADLINE_S is set, converted to
+            # DeadlineExceeded by the watchdog).  Downgrade for the
+            # rest of the process.
             global _BATCH_OK
             _BATCH_OK = False
             use_batch = False
@@ -836,11 +928,18 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
         # 2026-08-01 on the headline rung: 38 rows of pass 1 ran,
         # then pass 2's first dispatch was refused) — a refused row
         # is retried once (sync'd, in case the error belonged to a
-        # prior async dispatch), then zero-filled and recorded so one
-        # flaky trial degrades one DM row instead of killing the
-        # whole beam at +1500 s with nothing to show.
+        # prior async dispatch), then RESCUED on the host CPU backend
+        # (same row program, slower device) and only zero-filled when
+        # the rescue itself fails: one flaky trial costs latency, not
+        # science.  A circuit breaker stops hammering a session that
+        # refuses many consecutive dispatches (poisoned-session
+        # pattern) and routes the remaining rows straight to rescue.
         pending = []
-        failed_rows: list[int] = []
+        failed_rows: list[int] = []       # lost even after rescue
+        refused_rows: list[int] = []      # refused twice -> rescue
+        undispatched = 0                  # breaker-skipped, never sent
+        breaker = CircuitBreaker(
+            failure_threshold=_breaker_threshold(), cooloff_s=60.0)
 
         def _zero_fill(rows):
             for r in rows:
@@ -853,69 +952,147 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
         def _safe_drain():
             try:
                 _drain(pending)
-            except jax.errors.JaxRuntimeError:
+            except REFUSED:
                 # A deferred async error surfaces at the window sync
                 # and poisons the whole window; most of those rows
                 # finished on device.  First try to FETCH each
                 # pending result individually (KB-scale top-k blocks,
                 # no recompute); re-dispatch synchronously only the
-                # entries whose own fetch raises; zero-fill only rows
-                # refused twice.
+                # entries whose own fetch raises; rows refused twice
+                # go to the rescue set.
                 stalled = pending[:]
                 pending.clear()
                 for r, nr, tup in stalled:
+                    # the breaker bounds this path too: once it opens
+                    # (threshold consecutive refusals), the remaining
+                    # stalled entries go straight to rescue instead
+                    # of burning a watched fetch + watched
+                    # re-dispatch each on a session already judged
+                    # poisoned
+                    if shortcut and not breaker.allow():
+                        refused_rows.append(r)
+                        continue
                     try:
                         _drain([(r, nr, tup)])
                         continue
-                    except jax.errors.JaxRuntimeError:
+                    except REFUSED:
                         pass
                     try:
                         _drain([(r, nr, row_fn(spectra, bank_fft,
                                                r))])
-                    except jax.errors.JaxRuntimeError:
-                        _zero_fill([r])
+                        breaker.record_success()
+                    except REFUSED:
+                        breaker.record_failure()
+                        refused_rows.append(r)
+
+        # dispatch-retry bounds stated through the shared primitive:
+        # one synchronous retry per refused row, the window flush
+        # (_safe_drain) between the attempts in case the error
+        # belonged to a prior async dispatch, breaker consulted and
+        # updated per attempt.  The breaker's skip-without-dispatch
+        # shortcut hands undispatched rows to the host rescue, so it
+        # only engages when there IS a rescue to hand them to: with
+        # TPULSAR_HOST_RESCUE=0 every row must still be dispatched —
+        # only ACTUAL refusals may zero-fill.
+        from tpulsar.resilience import rescue as rescue_mod
+        shortcut = rescue_mod.enabled()
+        row_retry = rpolicy.RetryPolicy(max_attempts=2,
+                                        retry_on=REFUSED)
 
         for i in range(ndms):
+            if shortcut and not breaker.allow():
+                # the session refused `threshold` consecutive
+                # dispatches: classify the rest as refused without
+                # dispatching (at full scale that is hundreds of
+                # doomed round-trips saved) — rescue recomputes them
+                refused_rows.append(i)
+                undispatched += 1
+                continue
             try:
-                pending.append((i, 1, row_fn(spectra, bank_fft, i)))
-            except jax.errors.JaxRuntimeError:
-                _safe_drain()   # flush async state, then retry once
-                try:
-                    pending.append((i, 1,
-                                    row_fn(spectra, bank_fft, i)))
-                except jax.errors.JaxRuntimeError:
-                    _zero_fill([i])
+                pending.append((i, 1, rpolicy.call(
+                    lambda: row_fn(spectra, bank_fft, i), row_retry,
+                    breaker=breaker if shortcut else None,
+                    on_retry=lambda k, e: _safe_drain())))
+            except (CircuitOpenError,) + REFUSED:
+                refused_rows.append(i)
             if len(pending) >= SYNC_WINDOW:
                 _safe_drain()
         _safe_drain()
+
+        rescued: dict[int, tuple] = {}
+        recompute_ran = False
+        if refused_rows:
+            todo = sorted(set(refused_rows))
+            rescued, recompute_ran = rescue_mod.rescue_accel_rows(
+                spectra, bank, todo, max_numharm=max_numharm,
+                topk=topk)
+            for r, tup in rescued.items():
+                vals[r], rbins[r], zidx[r] = tup
+            _zero_fill([r for r in todo if r not in rescued])
+
         if failed_rows and len(failed_rows) == ndms:
-            # EVERY row refused twice: the runtime is not flaky, it
-            # is refusing this program outright.  An all-zero result
-            # dressed as success would hide that; raise and let the
-            # caller decide (the executor skips this pass's hi stage
-            # with a loud degraded note and keeps the beam alive).
-            raise AccelStageRefused(
+            # EVERY row refused AND the host rescue recovered none:
+            # the runtime is refusing this program outright and there
+            # is no healthy device left.  An all-zero result dressed
+            # as success would hide that; raise and let the caller
+            # decide (the executor skips this pass's hi stage with a
+            # loud degraded note and keeps the beam alive).
+            # rescue_exhausted tells the executor the per-row host
+            # RECOMPUTE already ran on these exact spectra and
+            # recovered nothing, so it must not repeat the doomed
+            # recompute chunk-wide.  A rescue that never reached the
+            # recompute (fetch from the poisoned device refused) is
+            # NOT exhausted: the executor's chunk rescue re-fetches,
+            # a genuine second chance on a flaky link.
+            if not shortcut:
+                why = "is disabled"
+            elif recompute_ran:
+                why = "recovered none"
+            else:
+                why = "could not fetch the spectra from the device"
+            exc = AccelStageRefused(
                 f"accel per-DM fallback: runtime refused all "
-                f"{ndms} rows (each retried once after a sync "
-                f"flush)")
+                f"{ndms} rows (dispatched rows each retried once "
+                f"after a sync flush) and the host rescue " + why)
+            exc.rescue_exhausted = recompute_ran
+            raise exc
         # count(), not note(): this fires once per DM chunk and the
         # totals must ACCUMULATE across the pass — including the
         # clean chunks' rows in the denominator, or the recorded
         # fraction overstates the loss.  Row ids are chunk-local, so
         # only counts are recorded.  Zero-failure calls still feed
         # the denominator; the flag is only written once n > 0.
+        # Rescued rows are PROVENANCE (complete science, slower
+        # device), never a loss flag.
         from tpulsar.search import degraded
         degraded.count(
             "accel_rows_zero_filled", len(failed_rows), ndms,
             extra="runtime refused these accel rows (each retried "
-                  "synchronously); powers zero-filled — hi-accel "
-                  "coverage is PARTIAL")
+                  "synchronously) and host rescue failed; powers "
+                  "zero-filled — hi-accel coverage is PARTIAL")
+        rescue_extra = ("runtime refused these accel rows; recomputed "
+                        "on the host CPU backend with the same row "
+                        "program — hi-accel coverage is COMPLETE, "
+                        "rescued rows were slower")
+        if undispatched:
+            rescue_extra += (f" ({undispatched} of them never "
+                             "dispatched: the open breaker routed "
+                             "them straight to rescue)")
+        degraded.provenance_count(
+            "accel_rows_rescued", len(rescued), ndms,
+            extra=rescue_extra)
         if failed_rows:
             import warnings
             warnings.warn(
                 f"accel per-DM fallback: {len(failed_rows)}/{ndms} "
-                "rows refused by the runtime and zero-filled "
-                "(degraded-mode note recorded)")
+                "rows refused by the runtime, not rescuable, and "
+                "zero-filled (degraded-mode note recorded)")
+        elif rescued:
+            import warnings
+            warnings.warn(
+                f"accel per-DM fallback: {len(rescued)}/{ndms} rows "
+                "refused by the runtime and recomputed on the host "
+                "CPU backend (provenance recorded; no science lost)")
     zs = np.asarray(bank.zs)
     return {h: (vals[:, si_, :], rbins[:, si_, :], zs[zidx[:, si_, :]])
             for si_, h in enumerate(stages)}

@@ -247,18 +247,26 @@ def dedisperse_subbands(subbands: jnp.ndarray,
             "(pattern explosion or partial-tensor budget); using the "
             "standard stage-2 path", stacklevel=2)
 
+    from tpulsar.resilience import faults
+
     sig = (tuple(subbands.shape), tuple(np.asarray(sub_shifts).shape))
     use_p = pallas_dd.use_pallas()
     sig_on = pallas_dd.signature_enabled(sig)
-    if use_p and sig_on:
+    noted = False
+    if (use_p and sig_on) or faults.targets("dedisperse.pallas"):
+        # an armed dedisperse.pallas fault enters this branch even on
+        # backends that never take the Pallas path (CPU CI), so the
+        # kernel-fault fallback below is exercisable off the hardware
         try:
-            out = pallas_dd.dedisperse_subbands_pallas(subbands,
-                                                       sub_shifts)
-            # jax dispatch is async: force execution here so a kernel
-            # fault is caught by this except (and triggers the
-            # fallback) rather than surfacing downstream
-            jax.block_until_ready(out)
-            return out
+            faults.fire("dedisperse.pallas", detail=f"stage-2 {sig}")
+            if use_p and sig_on:
+                out = pallas_dd.dedisperse_subbands_pallas(subbands,
+                                                           sub_shifts)
+                # jax dispatch is async: force execution here so a
+                # kernel fault is caught by this except (and triggers
+                # the fallback) rather than surfacing downstream
+                jax.block_until_ready(out)
+                return out
         except Exception as e:   # Mosaic unsupported on this runtime
             if pallas_dd.forced():
                 raise      # TPULSAR_PALLAS=1 = no-fallback (CI mode)
@@ -266,7 +274,11 @@ def dedisperse_subbands(subbands: jnp.ndarray,
             from tpulsar.search import degraded
             degraded.note("pallas_dd_disabled",
                           f"kernel fault, XLA fallback: {str(e)[:160]}")
-    elif pallas_dd.is_tpu_backend():
+            noted = True
+    # NOT an elif of the fault-armed branch: an armed spec whose
+    # fault happens not to fire on this call (count exhausted,
+    # rate<1) must not swallow the TPU-backend provenance note below
+    if pallas_dd.is_tpu_backend() and not noted:
         # flagship kernel off on the TPU backend (smoke gate, env, or
         # a signature disabled by an earlier fault): the result must
         # say which stage-2 path produced it — on EVERY later run too

@@ -29,6 +29,8 @@ import uuid
 
 from tpulsar.obs.log import get_logger
 from tpulsar.orchestrate.jobtracker import JobTracker, nowstr
+from tpulsar.resilience import faults
+from tpulsar.resilience.policy import RetryPolicy
 
 ALLOWABLE_REQUEST_SIZES = [5, 10, 20, 50, 100, 200]   # Downloader.py:365
 
@@ -231,6 +233,11 @@ class Downloader:
         self.numdownloads = numdownloads
         self.numrestores = numrestores
         self.numretries = numretries
+        # the per-file attempt counter lives in the download_attempts
+        # table, not a Python loop, so only the policy's BOUND is
+        # consulted (should_retry) — stated through the shared
+        # primitive so it is one knob with the other retry loops
+        self.retry_policy = RetryPolicy(max_attempts=numretries)
         self.request_timeout_hours = request_timeout_hours
         self.request_numbits = request_numbits
         self.request_datatype = request_datatype
@@ -339,6 +346,11 @@ class Downloader:
                   local: str) -> None:
         t0 = time.time()
         try:
+            # the injected failure takes the identical route as a real
+            # transport error: failed -> retrying (< numretries) ->
+            # terminal_failure, all recorded in download_attempts
+            faults.fire("download.transfer", make_exc=IOError,
+                        detail=remote)
             self.transport.fetch(remote, local)
         except Exception as e:
             self.t.execute(
@@ -410,7 +422,7 @@ class Downloader:
             attempts = self.t.query(
                 "SELECT COUNT(*) c FROM download_attempts WHERE file_id=?",
                 [row["id"]], fetchone=True)["c"]
-            if attempts < self.numretries:
+            if self.retry_policy.should_retry(attempts):
                 self.t.update("files", row["id"], status="retrying",
                               details=f"{attempts} failed attempts")
             else:

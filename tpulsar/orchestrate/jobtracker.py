@@ -16,12 +16,12 @@ Improvements over the reference while keeping its guarantees:
 from __future__ import annotations
 
 import os
-import random
 import sqlite3
 import time
 from typing import Any, Iterable
 
 from tpulsar.obs import debugflags
+from tpulsar.resilience import policy as rpolicy
 
 SCHEMA = """
 CREATE TABLE IF NOT EXISTS requests (
@@ -96,6 +96,16 @@ class JobTracker:
 
     MAX_RETRIES = 20
 
+    #: residual lock contention past the 40 s busy_timeout: bounded,
+    #: jittered exponential backoff (0.05 s doubling, capped at 1 s)
+    #: through the shared resilience primitive — same curve the
+    #: hand-rolled loop implemented, now stated declaratively
+    RETRY_POLICY = rpolicy.RetryPolicy(
+        max_attempts=MAX_RETRIES, backoff_base_s=0.05,
+        backoff_mult=2.0, backoff_max_s=1.0, jitter=True,
+        retry_on=(sqlite3.OperationalError,),
+        retryable=lambda e: "locked" in str(e) or "busy" in str(e))
+
     def __init__(self, db_path: str | None = None):
         if db_path is None:
             from tpulsar.config import settings
@@ -114,17 +124,7 @@ class JobTracker:
         return conn
 
     def _with_retries(self, fn):
-        last: Exception | None = None
-        for attempt in range(self.MAX_RETRIES):
-            try:
-                return fn()
-            except sqlite3.OperationalError as e:
-                if "locked" not in str(e) and "busy" not in str(e):
-                    raise
-                last = e
-                time.sleep(min(1.0, 0.05 * 2 ** attempt)
-                           * (0.5 + random.random()))
-        raise last  # type: ignore[misc]
+        return rpolicy.call(fn, self.RETRY_POLICY)
 
     # ------------------------------------------------------------- queries
 

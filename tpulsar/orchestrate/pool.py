@@ -25,6 +25,7 @@ from tpulsar.orchestrate.queue_managers import (
     QueueManagerJobFatalError,
     QueueManagerNonFatalError,
 )
+from tpulsar.resilience import faults
 
 
 class JobPool:
@@ -180,6 +181,12 @@ class JobPool:
             "SELECT f.filename FROM files f JOIN job_files jf "
             "ON jf.file_id = f.id WHERE jf.job_id=?", [job_id])]
         try:
+            # backend-agnostic injection point: shaped non-fatal so it
+            # exercises the defer-and-retry tier of the taxonomy below
+            # (the job stays queued; the next rotate resubmits)
+            faults.fire("queue.submit",
+                        make_exc=QueueManagerNonFatalError,
+                        detail=f"job {job_id}")
             outdir = self.get_output_dir(fns)
             queue_id = self.qm.submit(fns, outdir, job_id)
         except QueueManagerJobFatalError as e:

@@ -34,6 +34,12 @@ from tpulsar.orchestrate.queue_managers import (
     QueueManagerNonFatalError,
     SubmitRegistry,
 )
+from tpulsar.resilience import policy as rpolicy
+
+
+class _SchedulerUnanswered(QueueManagerNonFatalError):
+    """showq replied with a communication error (or nothing usable)
+    during lost-msub recovery: not a definitive answer, retry."""
 
 #: scheduler states that mean "no longer occupying the queue"
 _GONE_STATES = ("Completed", "Canceling", "DNE")
@@ -159,19 +165,39 @@ class MoabManager(CLIQueueBackend):
         if comm_err:
             # the submission may have landed even though the reply was
             # lost — recover the id by job name rather than resubmit
-            # (a resubmit would double-run the beam)
-            qid = ""
-            for _attempt in range(self.comm_retry_limit):
-                self._sleep(self.retry_wait_s)
+            # (a resubmit would double-run the beam).  The constant-
+            # wait recovery loop is the shared retry primitive with a
+            # flat backoff curve and delay_first (wait BEFORE the
+            # first showq too, like the hand-rolled loop did).
+            def _lookup():
                 try:
                     queue, lookup_comm_err = self._showq(force=True)
-                except QueueManagerNonFatalError:
-                    continue
+                except QueueManagerNonFatalError as e:
+                    raise _SchedulerUnanswered(str(e))
                 if lookup_comm_err:
-                    continue
-                qid = self._find_live(queue, job_name)
-                break       # a definitive showq answer ends recovery
-            else:
+                    raise _SchedulerUnanswered(
+                        "showq communication error")
+                # a definitive showq answer ends recovery ('' = the
+                # name is absent: the lost msub never landed)
+                return self._find_live(queue, job_name)
+
+            try:
+                qid = rpolicy.call(
+                    _lookup,
+                    rpolicy.RetryPolicy(
+                        # call() rejects a zero bound; a configured
+                        # limit of 0 still gets one lookup before the
+                        # fatal verdict (the old loop's 0 meant 'give
+                        # up immediately', which only ever punished a
+                        # submit that might have landed)
+                        max_attempts=max(1, self.comm_retry_limit),
+                        backoff_base_s=self.retry_wait_s,
+                        backoff_mult=1.0,
+                        backoff_max_s=self.retry_wait_s,
+                        delay_first=True,
+                        retry_on=(_SchedulerUnanswered,)),
+                    sleeper=self._sleep)
+            except _SchedulerUnanswered:
                 raise QueueManagerFatalError(
                     f"{self.comm_retry_limit} consecutive Moab "
                     f"communication errors while submitting job {job_id}")

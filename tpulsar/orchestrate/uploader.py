@@ -38,6 +38,18 @@ from tpulsar.orchestrate.uploadables import (
     SinglePulseUpload,
     UploadError,
 )
+from tpulsar.resilience import faults
+from tpulsar.resilience import policy as rpolicy
+
+#: in-process deadlock retries before deferring the submit to the next
+#: daemon iteration: writer contention usually clears in seconds, so a
+#: couple of immediate replays beat a full-cycle wait — connection
+#: errors are NOT retried here (the server may be down for a while;
+#: the retry-later DB state handles those)
+DEADLOCK_RETRY = rpolicy.RetryPolicy(
+    max_attempts=3, backoff_base_s=1.0, backoff_mult=2.0,
+    backoff_max_s=10.0, jitter=True,
+    retry_on=(DatabaseDeadlockError,))
 
 
 def pipeline_version() -> str:
@@ -197,13 +209,29 @@ class JobUploader:
         db = None
         try:
             db = ResultsDB(self.db_url)
-            with _timed("Header (incl. candidates + SP)"):
-                header.upload(db)
-            with _timed("Diagnostics"):
-                for d in diags:
-                    d.header_id = header.header_id
-                    d.upload(db)
-            db.commit()
+
+            def _transaction():
+                # the injected failure is connection-shaped so it
+                # exercises the retry-later taxonomy (leave the submit
+                # 'processed'; a later daemon iteration re-uploads)
+                faults.fire("upload.write",
+                            make_exc=DatabaseConnectionError,
+                            detail=f"submit {submit_id}")
+                with _timed("Header (incl. candidates + SP)"):
+                    header.upload(db)
+                with _timed("Diagnostics"):
+                    for d in diags:
+                        d.header_id = header.header_id
+                        d.upload(db)
+                db.commit()
+
+            rpolicy.call(
+                _transaction, DEADLOCK_RETRY,
+                on_retry=lambda k, e: (
+                    db.rollback(),
+                    self.log.warning(
+                        "submit %d deadlocked (attempt %d): %s; "
+                        "replaying transaction", submit_id, k + 1, e)))
             upload_timing_summary["End-to-end"] = (
                 upload_timing_summary.get("End-to-end", 0.0)
                 + time.time() - t_start)

@@ -54,7 +54,11 @@ class SearchStepSpec:
     whiten_est: str = "median"  # block noise estimator (static spec
     #                             config, NOT an ambient env read — an
     #                             env change under the outer jit would
-    #                             silently reuse the stale trace)
+    #                             silently reuse the stale trace).
+    #                             Builders that honour
+    #                             TPULSAR_WHITEN_ESTIMATOR must thread
+    #                             fr.whiten_estimator() in HERE, like
+    #                             the executor does for PassSpec
     dd_pad: int = 0    # static stage-2 shift bound (>= max sub_shift);
     #                    0 = pad by the full series length (always
     #                    correct, 2x subband HBM — fine for demos)

@@ -1,0 +1,24 @@
+"""Resilience primitives: deterministic fault injection, the shared
+retry/backoff/deadline/circuit-breaker policy engine, and host rescue
+of device-refused work.
+
+The tunneled TPU runtime refuses valid programs flakily
+(UNIMPLEMENTED at execution), hangs on poisoned sessions, and none of
+the resulting degrade paths used to be exercisable off the hardware.
+This package makes them first-class:
+
+  faults.py  — named fault points that deterministically raise
+               refusal-shaped errors, simulate hangs, or poison the
+               session, driven by TPULSAR_FAULTS, so every degrade
+               path reproduces on CPU CI;
+  policy.py  — ONE bounded-retry/backoff/deadline/circuit-breaker
+               primitive replacing the ad-hoc retry loops that had
+               grown in kernels/accel.py, orchestrate/downloader.py,
+               orchestrate/uploader.py, orchestrate/jobtracker.py and
+               queue_managers/;
+  rescue.py  — recompute refused device work on the JAX CPU backend
+               (same program, host device): a refused DM row becomes
+               a slower row, not lost science.
+"""
+
+from tpulsar.resilience import faults, policy, rescue  # noqa: F401

@@ -1,0 +1,257 @@
+"""Deterministic fault injection at named points.
+
+The degrade paths this codebase grew for the tunneled TPU runtime —
+refused accel dispatches, poisoned sessions, hung transfers — only
+fired when real hardware misbehaved, so none of them were exercisable
+in CPU CI.  This layer makes every one reproducible: instrumented
+sites call ``fire(point)`` and a spec (env ``TPULSAR_FAULTS`` or
+``configure()``) decides deterministically whether that call raises a
+refusal-shaped error, sleeps past a watchdog deadline, or poisons the
+whole session.
+
+Spec grammar (``;``-separated specs, ``,``-separated options)::
+
+    TPULSAR_FAULTS="accel.row_dispatch:unimplemented:rate=0.25,seed=7"
+    TPULSAR_FAULTS="download.transfer:hang:seconds=5;queue.submit:unimplemented:count=2"
+
+    spec  := <point> ":" <mode> [":" key=val ("," key=val)*]
+    mode  := unimplemented   raise a refusal-shaped runtime error
+           | hang            sleep `seconds`, then proceed (a hung
+                             dispatch — policy.run_with_deadline
+                             converts it into a classified failure)
+           | poison          raise AND poison the session: every
+                             later fire() at any point raises too
+    keys  := rate=<0..1>     trigger probability per call (default 1)
+             seed=<int>      RNG seed for the rate draw (default 0)
+             after=<int>     first N calls never trigger (default 0)
+             count=<int>     trigger at most N times (default 0 = inf)
+             seconds=<float> hang duration (default 30)
+
+Determinism: each fault point keeps its own call counter and its own
+``random.Random(seed)`` stream, so the same spec over the same call
+sequence triggers the same calls — a degrade-path reproduction is a
+command line, not a lucky hardware flake.
+
+Unknown points or modes raise at configure time: a typo'd spec that
+silently never fired would make a reproduction run meaningless.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+
+#: the fault-point catalog — every instrumented site, enforced at
+#: parse time (docs/operations.md documents what each one exercises)
+FAULT_POINTS = (
+    "accel.row_dispatch",   # per-DM hi-accel row program dispatch
+    "accel.chunk",          # batched hi-accel DM-chunk dispatch
+    "dedisperse.pallas",    # Pallas stage-2 dedispersion kernel
+    "download.transfer",    # transport fetch inside a download thread
+    "upload.write",         # results-DB upload transaction
+    "queue.submit",         # queue-manager job submission
+)
+
+MODES = ("unimplemented", "hang", "poison")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    point: str
+    mode: str
+    rate: float = 1.0
+    seed: int = 0
+    after: int = 0
+    count: int = 0          # 0 = unlimited
+    seconds: float = 30.0
+
+    # runtime state (not part of the parsed spec)
+    calls: int = 0
+    fired: int = 0
+    _rng: random.Random | None = None
+
+    def rng(self) -> random.Random:
+        if self._rng is None:
+            self._rng = random.Random(self.seed)
+        return self._rng
+
+
+_LOCK = threading.Lock()
+_SPECS: dict[str, FaultSpec] | None = None   # None = env not read yet
+_POISONED: str = ""                          # point that poisoned us
+
+
+class SessionPoisoned(RuntimeError):
+    """A `poison` fault fired earlier: the simulated session refuses
+    everything from here on (the wedged-chip failure mode)."""
+
+
+def parse_spec(text: str) -> dict[str, FaultSpec]:
+    """Parse a TPULSAR_FAULTS value.  Raises ValueError loudly on any
+    unknown point/mode/option — see module docstring."""
+    specs: dict[str, FaultSpec] = {}
+    for part in text.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) not in (2, 3):
+            raise ValueError(
+                f"fault spec {part!r} is not point:mode[:opts]")
+        point, mode = fields[0].strip(), fields[1].strip()
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r} (catalog: "
+                f"{', '.join(FAULT_POINTS)})")
+        if mode not in MODES:
+            raise ValueError(
+                f"unknown fault mode {mode!r} (modes: "
+                f"{', '.join(MODES)})")
+        spec = FaultSpec(point=point, mode=mode)
+        if len(fields) == 3 and fields[2].strip():
+            for opt in fields[2].split(","):
+                if "=" not in opt:
+                    raise ValueError(
+                        f"fault option {opt!r} is not key=val")
+                key, val = (s.strip() for s in opt.split("=", 1))
+                if key == "rate":
+                    spec.rate = float(val)
+                    if not 0.0 <= spec.rate <= 1.0:
+                        raise ValueError(f"rate={val} outside [0, 1]")
+                elif key == "seed":
+                    spec.seed = int(val)
+                elif key == "after":
+                    spec.after = int(val)
+                elif key == "count":
+                    spec.count = int(val)
+                elif key == "seconds":
+                    spec.seconds = float(val)
+                else:
+                    raise ValueError(f"unknown fault option {key!r}")
+        if point in specs:
+            raise ValueError(f"duplicate fault point {point!r}")
+        specs[point] = spec
+    return specs
+
+
+def configure(text: str | None = None) -> None:
+    """Arm the layer from a spec string (tests) or from the
+    TPULSAR_FAULTS env (text=None).  Clears poisoned state."""
+    global _SPECS, _POISONED
+    with _LOCK:
+        if text is None:
+            text = os.environ.get("TPULSAR_FAULTS", "")
+        _SPECS = parse_spec(text)
+        _POISONED = ""
+
+
+def reset() -> None:
+    """Disarm everything (including the env spec — tests call this in
+    teardown so one test's faults never leak into the next)."""
+    global _SPECS, _POISONED
+    with _LOCK:
+        _SPECS = {}
+        _POISONED = ""
+
+
+def _specs() -> dict[str, FaultSpec]:
+    global _SPECS
+    if _SPECS is None:
+        configure()
+    return _SPECS  # type: ignore[return-value]
+
+
+def active() -> bool:
+    return bool(_specs())
+
+
+def targets(point: str) -> bool:
+    """Is this exact point armed?  Used by path gates: a spec naming
+    accel.row_dispatch pins the per-DM path so the fault actually
+    fires (the batched/native paths never dispatch rows)."""
+    return point in _specs()
+
+
+def targets_prefix(prefix: str) -> bool:
+    return any(p.startswith(prefix) for p in _specs())
+
+
+def fired(point: str) -> int:
+    """How many times this point's fault has triggered (tests)."""
+    spec = _specs().get(point)
+    return spec.fired if spec else 0
+
+
+def _default_exc(msg: str) -> Exception:
+    """UNIMPLEMENTED-shaped runtime error: the same class the real
+    refusals surface as, so except clauses written for the hardware
+    catch the injection identically."""
+    try:
+        import jax
+        return jax.errors.JaxRuntimeError(msg)
+    except Exception:
+        return RuntimeError(msg)
+
+
+def fire(point: str, make_exc=None, detail: str = "") -> None:
+    """Trip the fault at `point` if its spec says so.
+
+    make_exc: optional callable(message) -> Exception letting the
+    instrumented site shape the error to ITS failure taxonomy (the
+    downloader raises IOError, the uploader a connection error, ...);
+    default is the UNIMPLEMENTED-shaped runtime error.
+
+    No-spec calls are two dict lookups — cheap enough for per-row
+    dispatch loops.
+    """
+    global _POISONED
+    specs = _specs()
+    if not specs and not _POISONED:
+        return
+    with _LOCK:
+        if _POISONED:
+            # shaped through the SITE's taxonomy like any other
+            # injected error (the downloader must see its IOError,
+            # the uploader its connection error — a raw
+            # SessionPoisoned would crash paths the injection exists
+            # to exercise); sites without a make_exc get the marker
+            # class, which the accel REFUSED set catches by name
+            pmsg = (f"session poisoned by fault at {_POISONED!r}; "
+                    f"refusing {point}"
+                    + (f" ({detail})" if detail else ""))
+            raise make_exc(pmsg) if make_exc is not None \
+                else SessionPoisoned(pmsg)
+        spec = specs.get(point)
+        if spec is None:
+            return
+        spec.calls += 1
+        if spec.calls <= spec.after:
+            return
+        if spec.count and spec.fired >= spec.count:
+            return
+        if spec.rate < 1.0 and spec.rng().random() >= spec.rate:
+            return
+        spec.fired += 1
+        n = spec.fired
+        if spec.mode == "poison":
+            _POISONED = point
+    msg = (f"UNIMPLEMENTED: injected fault at {point} "
+           f"(trigger #{n}, mode={spec.mode}"
+           + (f", {detail}" if detail else "") + ")")
+    if spec.mode == "hang":
+        # a hung dispatch: sleep past the watchdog deadline, then
+        # proceed — policy.run_with_deadline converts the stall into
+        # a classified DeadlineExceeded instead of an unbounded hang
+        time.sleep(spec.seconds)
+        return
+    raise make_exc(msg) if make_exc is not None else _default_exc(msg)
+
+
+def snapshot() -> dict[str, dict]:
+    """Armed specs + trigger counts (doctor/debug output)."""
+    return {p: {"mode": s.mode, "rate": s.rate, "calls": s.calls,
+                "fired": s.fired}
+            for p, s in _specs().items()}

@@ -313,10 +313,12 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
 
     _write_header_json(resultsdir, obj)
     deg = degraded.snapshot()
+    resc = degraded.provenance_snapshot()
     _write_search_params(resultsdir, params, basenm, si, num_trials,
-                         baryv=baryv, degraded_modes=deg)
+                         baryv=baryv, degraded_modes=deg,
+                         rescued_modes=resc)
     timers.write_report(os.path.join(resultsdir, f"{basenm}.report"),
-                        basenm, degraded=deg)
+                        basenm, degraded=deg, rescued=resc)
     _tar_result_classes(resultsdir, basenm)
 
     return SearchOutcome(basenm=basenm, resultsdir=resultsdir,
@@ -874,15 +876,54 @@ def _hi_accel_pass(wspec, dm_chunk, T_s, params: SearchParams
     except accel_k.AccelStageRefused as exc:
         # The runtime refused the whole chunk outright (observed
         # UNIMPLEMENTED on the tunneled axon runtime, 2026-08-01).
-        # Skip THIS chunk's hi stage loudly: the beam keeps its SP,
-        # lo, fold, and other chunks' hi science instead of dying
-        # with nothing recorded.
-        from tpulsar.search import degraded
-        degraded.count("accel_hi_chunk_skipped", len(dm_chunk),
-                       len(dm_chunk), extra=str(exc)[:160])
+        # Last resort before losing science: recompute the WHOLE
+        # chunk on the host CPU backend — slower, but a complete
+        # beam.  Skipped when the kernel's own per-row rescue already
+        # ran on these exact spectra and recovered nothing
+        # (rescue_exhausted): repeating the doomed recompute would
+        # double the cost of the skip that is coming anyway.  Only
+        # when no rescue is possible does the chunk's hi stage skip
+        # loudly: the beam keeps its SP, lo, fold, and other chunks'
+        # hi science instead of dying with nothing recorded.
+        from tpulsar.resilience import rescue
+        chunk_res = None
+        if not getattr(exc, "rescue_exhausted", False):
+            chunk_res = rescue.rescue_accel_chunk(
+                wspec, bank, max_numharm=params.hi_accel_numharm,
+                topk=params.topk_per_stage)
+        if chunk_res is None:
+            degraded.count("accel_hi_chunk_skipped", len(dm_chunk),
+                           len(dm_chunk), extra=str(exc)[:160])
+            import warnings
+            warnings.warn(f"hi-accel chunk skipped: {exc}")
+            return []
+        res, lost_rows = chunk_res
+        n_ok = len(dm_chunk) - len(lost_rows)
+        degraded.provenance_count(
+            "accel_rows_rescued", n_ok, len(dm_chunk),
+            extra="whole chunk refused by the runtime; recomputed on "
+                  "the host CPU backend — rescued rows were slower "
+                  "but complete")
+        # lost_rows feed the LOSS ledger (and clean rescues feed its
+        # denominator, n=0): a partial chunk rescue is partial
+        # coverage, never dressed as complete
+        degraded.count(
+            "accel_rows_zero_filled", len(lost_rows), len(dm_chunk),
+            extra="chunk-rescue recompute failed for these rows; "
+                  "powers zero-filled — hi-accel coverage is PARTIAL")
+        degraded.count("accel_hi_chunk_skipped", 0, len(dm_chunk))
         import warnings
-        warnings.warn(f"hi-accel chunk skipped: {exc}")
-        return []
+        warnings.warn(
+            f"hi-accel chunk refused by the runtime and recomputed "
+            f"on the host CPU backend ({n_ok}/{len(dm_chunk)} rows"
+            + (f"; {len(lost_rows)} rows lost and zero-filled"
+               if lost_rows else "")
+            + f"; provenance recorded): {exc}")
+    else:
+        # clean chunks must feed the denominator too (n=0), or the
+        # recorded loss fraction always reads 100% of the counted
+        # chunks — count()'s own documented contract
+        degraded.count("accel_hi_chunk_skipped", 0, len(dm_chunk))
 
     # z~0 rows are the lo search's job (z_min_abs); sub-threshold rows
     # never become Python objects (sigma_min pre-filter).  The
@@ -936,6 +977,15 @@ def _search_pass_sharded(mesh, subb, sub_shifts, dms, dt_ds,
     T_s = nfft * dt_ds
     hi = params.run_hi_accel and params.hi_accel_zmax > 0
     hi_sharded = hi and accel_k._batch_path_usable()
+    if hi_sharded:
+        from tpulsar.resilience import faults
+        if faults.targets_prefix("accel."):
+            # a fault spec naming an accel dispatch point pins the
+            # single-device hi route: the fused sharded program never
+            # dispatches per-row/per-chunk accel work, so the fault —
+            # and the retry/rescue path it exists to exercise — would
+            # never fire under it
+            hi_sharded = False
     bank = _get_bank(params.hi_accel_zmax) if hi else None
     nz = len(bank.zs) if hi else 0
     use_pallas = pallas_dd.use_pallas()
@@ -1142,11 +1192,15 @@ def _write_header_json(resultsdir, obj) -> None:
 
 def _write_search_params(resultsdir, params, basenm, si, num_trials,
                          baryv: float = 0.0,
-                         degraded_modes: dict | None = None) -> None:
+                         degraded_modes: dict | None = None,
+                         rescued_modes: dict | None = None) -> None:
     """Provenance dump, python-literal assignments like the reference's
     search_params.txt (PALFA2_presto_search.py:695-700).
-    degraded_modes: fallback-path flags, so the provenance states
-    which code paths produced these results."""
+    degraded_modes: fallback-path flags (science lost / slower path).
+    rescued_modes: host-rescue provenance (e.g. accel_rows_rescued) —
+    work the primary device refused that was recomputed on another
+    device: the science is complete, only its origin differs, so it is
+    recorded separately from the loss ledger."""
     with open(os.path.join(resultsdir, "search_params.txt"), "w") as fh:
         fh.write(f"basenm = {basenm!r}\n")
         fh.write(f"source = {si.source!r}\n")
@@ -1154,6 +1208,7 @@ def _write_search_params(resultsdir, params, basenm, si, num_trials,
         fh.write(f"num_dm_trials = {num_trials}\n")
         fh.write(f"baryv = {baryv!r}\n")
         fh.write(f"degraded_modes = {dict(degraded_modes or {})!r}\n")
+        fh.write(f"rescued_modes = {dict(rescued_modes or {})!r}\n")
         for k, v in params.provenance().items():
             fh.write(f"{k} = {v!r}\n")
 

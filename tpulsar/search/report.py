@@ -122,13 +122,23 @@ class StageTimers:
         return "\n".join(lines) + "\n"
 
     def write_report(self, path: str, basenm: str,
-                     degraded: dict[str, str] | None = None) -> None:
+                     degraded: dict[str, str] | None = None,
+                     rescued: dict[str, str] | None = None) -> None:
         """degraded: fallback-path flags (search.degraded.snapshot())
         appended so a results directory is self-explaining about
-        which code paths produced it."""
+        which code paths produced it.  rescued: host-rescue
+        provenance (degraded.provenance_snapshot()) — refused device
+        work recomputed elsewhere; listed under its own heading so an
+        operator can tell 'complete beam, some rows slower' from a
+        genuinely degraded beam."""
         with open(path, "w") as fh:
             fh.write(self.report_text(basenm))
             if degraded:
                 fh.write("\nDegraded modes (fallback paths taken):\n")
                 for flag, detail in sorted(degraded.items()):
+                    fh.write(f"  {flag}: {detail}\n")
+            if rescued:
+                fh.write("\nRescued work (recomputed on a fallback "
+                         "device; science complete):\n")
+                for flag, detail in sorted(rescued.items()):
                     fh.write(f"  {flag}: {detail}\n")
