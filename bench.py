@@ -88,6 +88,21 @@ sys.path.insert(0, _REPO)
 
 TARGET_SECONDS = 60.0   # BASELINE.json north-star target (v5e-4)
 
+#: every record bench.py emits (headline, focused configs, and error
+#: records) carries this schema tag plus, for measured runs, a
+#: "stage_rollup" {span: {seconds, count}} from the telemetry span
+#: tracer — BENCH_*.json artifacts from different rounds become
+#: comparable instead of bespoke one-offs.  The schema is documented
+#: in docs/operations.md ("bench/v2 schema"); new keys only, so
+#: consumers of the single stdout JSON line keep working.
+BENCH_SCHEMA = "bench/v2"
+
+
+def _emit(result: dict) -> None:
+    """The one stdout JSON line, schema-tagged."""
+    result.setdefault("schema", BENCH_SCHEMA)
+    print(json.dumps(result), flush=True)
+
 NCHAN = 960
 TSAMP = 65.476e-6
 # divisible by every plan downsamp (1,2,3,5,6,10) and a rich 2^k factor
@@ -216,7 +231,13 @@ def run_focused_config(cfg: int) -> None:
     from tpulsar.kernels import fourier as fr
     from tpulsar.kernels import rfi as rfi_k
     from tpulsar.kernels import singlepulse as sp_k
+    from tpulsar.obs import telemetry
+    from tpulsar.obs import trace as trace_lib
     from tpulsar.search.report import StageTimers
+
+    # span recording on for the measured child: the bench/v2 record
+    # embeds the per-stage span rollup
+    trace_lib.start(clear=True)
 
     scale = float(os.environ.get("TPULSAR_BENCH_SCALE", "1.0"))
     nsamp = int(T_FULL * scale)
@@ -224,9 +245,11 @@ def run_focused_config(cfg: int) -> None:
     freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
     # reset the partial-evidence file so a timed-out focused run's
     # error record cannot absorb a previous headline run's passes
+    # (record shape from the shared telemetry event helper — same
+    # constructor as the executor's stage heartbeat)
     with open(PARTIAL_PATH, "w") as fh:
-        fh.write(json.dumps({"event": "start", "config": cfg,
-                             "nsamp": nsamp, "t": time.time()}) + "\n")
+        fh.write(json.dumps(telemetry.event_record(
+            "start", config=cfg, nsamp=nsamp)) + "\n")
     # Every phase runs in a StageTimers scope: the scopes feed the
     # stage heartbeat, so a focused-config child killed mid-phase
     # still tells the supervising parent WHICH phase it died in
@@ -287,7 +310,7 @@ def run_focused_config(cfg: int) -> None:
             # UNIMPLEMENTED while the z50 survey shapes ran fine).
             # A crashed child records nothing — emit the rung record
             # with the failure named instead.
-            print(json.dumps({
+            _emit({
                 "metric": "accelsearch_z200_h16_32dm_wallclock",
                 "value": -1.0, "unit": "s", "vs_baseline": 0.0,
                 "error": "accel_z200_runtime_rejected",
@@ -297,7 +320,7 @@ def run_focused_config(cfg: int) -> None:
                 "stage_s": {k: round(v, 2)
                             for k, v in timers.times.items()
                             if v >= 0.005},
-            }), flush=True)
+            })
             return
         # Plane dtype + a digest of the strongest detections, so two
         # cfg-3 runs with different TPULSAR_ACCEL_PLANE_DTYPE settings
@@ -330,13 +353,14 @@ def run_focused_config(cfg: int) -> None:
     else:
         raise SystemExit(f"unknown TPULSAR_BENCH_CONFIG {cfg}")
     elapsed = time.time() - t0
-    print(json.dumps({
+    _emit({
         "metric": metric, "value": round(elapsed, 2), "unit": "s",
         "vs_baseline": round(TARGET_SECONDS / max(elapsed, 1e-9), 3),
         "nsamp": nsamp, "device": str(jax.devices()[0]),
         "stage_s": {k: round(v, 2) for k, v in timers.times.items()
-                    if v >= 0.005}, **extra,
-    }), flush=True)
+                    if v >= 0.005},
+        "stage_rollup": trace_lib.rollup(), **extra,
+    })
 
 
 def _plane_dtype_name() -> str:
@@ -396,9 +420,15 @@ def run_measured() -> None:
         pass
 
     from tpulsar.kernels import rfi as rfi_k
+    from tpulsar.obs import telemetry
+    from tpulsar.obs import trace as trace_lib
     from tpulsar.plan import ddplan
     from tpulsar.search import executor
     from tpulsar.search.report import StageTimers
+
+    # span recording on: beam 0's per-stage rollup is embedded in the
+    # bench/v2 record, so every BENCH artifact decomposes the same way
+    trace_lib.start(clear=True)
 
     scale = float(os.environ.get("TPULSAR_BENCH_SCALE", "1.0"))
     run_accel = os.environ.get("TPULSAR_BENCH_ACCEL", "1") != "0"
@@ -420,10 +450,9 @@ def run_measured() -> None:
     npasses = sum(s.numpasses for s in plan)
 
     with open(PARTIAL_PATH, "w") as fh:
-        fh.write(json.dumps({"event": "start", "nsamp": nsamp,
-                             "npasses": npasses, "nbeams": nbeams,
-                             "backend": jax.default_backend(),
-                             "t": time.time()}) + "\n")
+        fh.write(json.dumps(telemetry.event_record(
+            "start", nsamp=nsamp, npasses=npasses, nbeams=nbeams,
+            backend=jax.default_backend())) + "\n")
 
     per_beam_s = []
     found = False
@@ -450,8 +479,12 @@ def run_measured() -> None:
         _log(f"beam {b}: rfifind done at +{time.time()-t0:.1f} s")
 
         def progress(rec, _b=b, _t0=t0):
-            rec = dict(rec, beam=_b, elapsed_s=round(time.time() - _t0, 2),
-                       t=time.time())
+            # shared event constructor: these lines and the stage
+            # heartbeat are the two inputs to the parent's stall
+            # detector, and one shape builder keeps them in step
+            rec = telemetry.event_record(
+                "pass", beam=_b,
+                elapsed_s=round(time.time() - _t0, 2), **rec)
             with open(PARTIAL_PATH, "a") as fh:
                 fh.write(json.dumps(rec) + "\n")
             _log(f"beam {_b}: pass {rec.get('pass_idx', '?')}/"
@@ -473,6 +506,8 @@ def run_measured() -> None:
                     for r in (1.0, 0.5, 2.0)) < 0.01
                 and abs(c.dm - DM_TRUE) < 10.0
                 for c in cands[:10])
+            # beam-0 span rollup, captured before beam 1's spans land
+            rollup0 = trace_lib.rollup()
         del data
 
     elapsed = per_beam_s[0]   # headline: one beam incl. compiles
@@ -497,15 +532,22 @@ def run_measured() -> None:
         # number is decomposable from the one JSON line
         "stage_s": {k: round(v, 2) for k, v in timers0.times.items()
                     if v >= 0.005},
+        # beam-0 telemetry span rollup ({span: {seconds, count}}):
+        # the same numbers as stage_s where names overlap, plus the
+        # structural spans (search_block, dm_chunk) and per-scope
+        # counts — the cross-round comparison surface of bench/v2
+        "stage_rollup": rollup0,
     }
     if nbeams > 1:
         steady = sum(per_beam_s[1:]) / (nbeams - 1)
         result["nbeams"] = nbeams
         result["steady_state_beam_s"] = round(steady, 2)
         result["beams_per_hour"] = round(3600.0 / steady, 1)
+    result.setdefault("schema", BENCH_SCHEMA)
     with open(PARTIAL_PATH, "a") as fh:
-        fh.write(json.dumps({"event": "done", **result}) + "\n")
-    print(json.dumps(result), flush=True)
+        fh.write(json.dumps(telemetry.event_record(
+            "done", **result)) + "\n")
+    _emit(result)
 
 
 # ----------------------------------------------------------------- parent
@@ -928,7 +970,7 @@ def _acquire_campaign_lock() -> "object | None":
         except OSError:
             if time.time() - t0 > wait_s:
                 _log(f"campaign lock still held after {wait_s:.0f} s")
-                print(json.dumps({
+                _emit({
                     "metric": "mock_beam_full_plan_search_wallclock",
                     "value": -1.0, "unit": "s", "vs_baseline": 0.0,
                     "error": "campaign_lock_timeout",
@@ -936,8 +978,7 @@ def _acquire_campaign_lock() -> "object | None":
                               ".campaign.lock for the whole wait; "
                               "refusing to contend for the single "
                               "chip (see bench_runs/ for the "
-                              "campaign's own records)"}),
-                      flush=True)
+                              "campaign's own records)"})
                 raise SystemExit(0)
             if not logged:
                 _log("a measurement campaign holds .campaign.lock — "
@@ -960,10 +1001,10 @@ def main() -> None:
     try:
         _bench_dtype_name()   # fail fast, before any TPU spend
     except SystemExit as e:
-        print(json.dumps({
+        _emit({
             "metric": "mock_beam_full_plan_search_wallclock",
             "value": -1.0, "unit": "s", "vs_baseline": 0.0,
-            "error": str(e)}), flush=True)
+            "error": str(e)})
         return
 
     cfg_raw = os.environ.get("TPULSAR_BENCH_CONFIG", "").strip()
@@ -980,11 +1021,11 @@ def main() -> None:
             if bench_cfg not in (1, 2, 3, 4, 5):
                 raise ValueError
         except ValueError:
-            print(json.dumps({
+            _emit({
                 "metric": "mock_beam_full_plan_search_wallclock",
                 "value": -1.0, "unit": "s", "vs_baseline": 0.0,
                 "error": f"invalid TPULSAR_BENCH_CONFIG {cfg_raw!r} "
-                         "(must be 1-5)"}), flush=True)
+                         "(must be 1-5)"})
             return
 
     probe_timeout = float(os.environ.get("TPULSAR_BENCH_PROBE_TIMEOUT",
@@ -1151,7 +1192,7 @@ def main() -> None:
                         "aot_check": aot_rec, "probe": probe,
                     }
                     add_cpu_fallback(result)
-                    print(json.dumps(result), flush=True)
+                    _emit(result)
                     return
             if on_tpu:
                 # Pre-run the Pallas smoke probe from here, while no
@@ -1321,7 +1362,7 @@ def main() -> None:
                 }
                 if aot_rec is not None:
                     result["aot_check"] = aot_rec
-                print(json.dumps(result), flush=True)
+                _emit(result)
                 return
             eff_deadline = min(deadline, remaining())
             status, result, kinfo = run_child(
@@ -1434,7 +1475,7 @@ def main() -> None:
             "value": -1.0, "unit": "s", "vs_baseline": 0.0,
             "error": f"bench_harness_error: {type(e).__name__}: {e}",
         }
-    print(json.dumps(result), flush=True)
+    _emit(result)
 
 
 if __name__ == "__main__":

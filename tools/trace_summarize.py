@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Per-stage rollup table from a tpulsar Chrome-trace file.
+
+Usage:
+    python tools/trace_summarize.py <trace.json | results_dir>
+        [--json] [--compare-report <path.report>]
+
+Given a `<basenm>_trace.json` written by a `TPULSAR_TRACE=1` run (or
+a results directory containing one — the newest is used), prints the
+per-span-name totals: seconds, share of the root span, and scope
+count.  The find/summarize/render implementation is shared with the
+`tpulsar trace` CLI subcommand (tpulsar/obs/trace.py) — this tool
+adds the `.report` comparison: with ``--compare-report`` the rollup
+is checked against the report's stage totals (the StageTimers view
+over the same spans) and exits nonzero if any stage disagrees by
+more than 5% — the CI smoke job runs exactly this check, so the two
+instruments cannot drift.
+
+JAX-free and numpy-free on purpose: runs anywhere, including the CPU
+CI runner and an operator laptop holding only the artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpulsar.obs import trace  # noqa: E402  (stdlib-only module)
+
+# kept as module-level aliases: tests and other tools call these as
+# trace_summarize.find_trace_file / .summarize
+find_trace_file = trace.find_trace_file
+summarize = trace.summarize_file
+render = trace.render_summary
+
+#: rows of the .report that are not timing-scope stages: the total
+#: line, and the synthetic unaccounted-time remainder.  Everything
+#: else in the '<stage>: <secs> s  (pct%)' format is compared — no
+#: hand-maintained stage list, so a stage added in a future PR is
+#: gated automatically instead of silently skipped.
+_NON_STAGE_ROWS = ("Total time", "other")
+
+_STAGE_ROW = re.compile(
+    r"^\s*([\w./ -]+?):\s+(\d+(?:\.\d+)?) s\s+\(\s*\d+(?:\.\d+)?%\)")
+
+
+def parse_report_stages(report_path: str) -> dict[str, float]:
+    """Stage seconds out of a .report: every row in the
+    '<stage>: <secs> s  (pct%)' shape except the non-stage rows."""
+    stages: dict[str, float] = {}
+    with open(report_path) as fh:
+        for line in fh:
+            m = _STAGE_ROW.match(line)
+            if m is None:
+                continue
+            name = m.group(1).strip()
+            if name in _NON_STAGE_ROWS:
+                continue
+            stages[name] = float(m.group(2))
+    return stages
+
+
+def compare(summary: dict, report_path: str,
+            tolerance: float = 0.05) -> list[str]:
+    """Mismatches between trace rollup and .report stage totals.
+    Absolute slack of 50 ms absorbs sub-tick stages where a relative
+    bound is meaningless."""
+    roll = summary["rollup"]
+    problems = []
+    for stage, rep_s in parse_report_stages(report_path).items():
+        got_s = roll.get(stage, {}).get("seconds", 0.0)
+        if abs(got_s - rep_s) > max(tolerance * rep_s, 0.05):
+            problems.append(
+                f"{stage}: trace {got_s:.2f} s vs report "
+                f"{rep_s:.2f} s (> {100 * tolerance:.0f}%)")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="trace JSON file or results dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    ap.add_argument("--compare-report", default=None, metavar="REPORT",
+                    help="check the rollup against this .report's "
+                         "stage totals (5%% tolerance); nonzero exit "
+                         "on mismatch")
+    args = ap.parse_args(argv)
+    summary = summarize(find_trace_file(args.path))
+    if args.json:
+        print(json.dumps(summary, indent=1, sort_keys=True))
+    else:
+        print(render(summary))
+    if args.compare_report:
+        problems = compare(summary, args.compare_report)
+        if problems:
+            for p in problems:
+                print(f"MISMATCH {p}", file=sys.stderr)
+            return 1
+        print(f"rollup matches {args.compare_report} within 5%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

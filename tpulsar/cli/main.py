@@ -40,6 +40,31 @@ def _notify(cfg):
     return send
 
 
+def _metrics_dir() -> str:
+    """Where daemons export their registries and the monitor commands
+    read them back: <basic.log_dir>/metrics."""
+    from tpulsar.config import settings
+    return os.path.join(settings().basic.log_dir, "metrics")
+
+
+def _export_metrics(name: str) -> None:
+    """Write this process's registry as <name>.prom (atomic replace)
+    and append a snapshot line to <name>.jsonl — the daemon-level
+    metrics the ROADMAP's production north star needs: `tpulsar
+    stats` and any Prometheus scraper read these without touching the
+    daemon process."""
+    from tpulsar.obs import metrics
+    d = _metrics_dir()
+    try:
+        metrics.REGISTRY.write_prom(os.path.join(d, f"{name}.prom"))
+        # bounded history: ~8 MB then rotate once — a daemon looping
+        # for months must not grow this file without limit
+        metrics.REGISTRY.write_jsonl(os.path.join(d, f"{name}.jsonl"),
+                                     max_bytes=8 << 20, daemon=name)
+    except OSError:
+        pass          # metrics export must never take the daemon down
+
+
 def _daemon_loop(name: str, iteration, status, sleep_s: float, notify):
     """Run a daemon with crash notification and exponential backoff on
     repeated errors (reference bin/StartDownloader.py:14-36)."""
@@ -57,6 +82,7 @@ def _daemon_loop(name: str, iteration, status, sleep_s: float, notify):
             print(tb, file=sys.stderr)
             notify(f"{name} crashed", tb)
             delay_mult = min(delay_mult * 2, 32)
+        _export_metrics(name)
         time.sleep(sleep_s * delay_mult)
 
 
@@ -156,6 +182,7 @@ def cmd_jobpool(args):
     if args.once:
         show()
         pool.rotate()
+        _export_metrics("jobpool")
         return 0
     return _daemon_loop("jobpool", pool.rotate, show,
                         cfg.background.sleep, _notify(cfg))
@@ -189,6 +216,7 @@ def cmd_downloader(args):
     if args.once:
         d.run()
         print(d.status())
+        _export_metrics("downloader")
         return 0
     return _daemon_loop("downloader", d.run,
                         lambda: print(d.status()),
@@ -204,6 +232,7 @@ def cmd_uploader(args):
                      delete_raw_on_upload=cfg.basic.delete_rawdata)
     if args.once:
         up.run()
+        _export_metrics("uploader")
         return 0
     return _daemon_loop("uploader", up.run, lambda: None,
                         cfg.background.sleep, _notify(cfg))
@@ -318,6 +347,7 @@ def cmd_stats(args):
                      if r["status"] in ("downloading", "unverified",
                                         "downloaded", "added"))
     print(f"raw data on disk: {disk_bytes / 2**30:.2f} GiB")
+    _print_daemon_metrics()
 
     if args.png:
         import matplotlib
@@ -364,6 +394,34 @@ def cmd_stats(args):
     return 0
 
 
+def _print_daemon_metrics(names: tuple[str, ...] = ()) -> None:
+    """Render the daemons' exported metrics (the .prom files written
+    each loop iteration) — `stats`/`monitor` show live telemetry from
+    processes they are not part of."""
+    import glob
+
+    d = _metrics_dir()
+    paths = sorted(glob.glob(os.path.join(d, "*.prom")))
+    if names:
+        paths = [p for p in paths
+                 if os.path.basename(p).split(".")[0] in names]
+    if not paths:
+        return
+    print(f"--- daemon metrics ({d}) ---")
+    for p in paths:
+        age = time.time() - os.path.getmtime(p)
+        print(f"[{os.path.basename(p).split('.')[0]}] "
+              f"(exported {age:.0f} s ago)")
+        try:
+            with open(p) as fh:
+                for ln in fh:
+                    if ln.startswith("#") or not ln.strip():
+                        continue
+                    print(f"  {ln.rstrip()}")
+        except OSError:
+            continue
+
+
 def cmd_monitor(args):
     """Live download monitor (reference bin/monitor_downloads.py):
     refreshes per-file progress until interrupted."""
@@ -387,6 +445,7 @@ def cmd_monitor(args):
                 bar = "#" * int(pct / 5)
                 print(f"[{r['id']:>4}] {os.path.basename(r['remote_filename'] or '?'):<40.40} "
                       f"{r['status']:<12} |{bar:<20}| {pct:5.1f}%")
+            _print_daemon_metrics(("downloader",))
             if args.once:
                 return 0
             time.sleep(args.interval)
@@ -480,6 +539,24 @@ def cmd_db_shell(args):
         pass
     finally:
         db.close()
+    return 0
+
+
+def cmd_trace(args):
+    """Summarize the last beam's telemetry trace in a results dir
+    (the `<basenm>_trace.json` a TPULSAR_TRACE=1 search writes):
+    per-span seconds/share/scope-count table, newest file wins.
+    Same find/summarize/render implementation as
+    tools/trace_summarize.py — this is the operator-facing spelling."""
+    from tpulsar.obs import trace as trace_lib
+
+    try:
+        trace_file = trace_lib.find_trace_file(args.path)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(trace_lib.render_summary(trace_lib.summarize_file(
+        trace_file)))
     return 0
 
 
@@ -761,6 +838,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--outdir", required=True)
     sp.add_argument("--no-accel", action="store_true")
     sp.set_defaults(fn=cmd_search)
+
+    sp = sub.add_parser(
+        "trace",
+        help="per-stage rollup of the last beam's telemetry trace "
+             "(TPULSAR_TRACE=1 searches write <basenm>_trace.json)")
+    sp.add_argument("path", help="results dir (newest *_trace.json "
+                                 "wins) or a trace file")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser(
         "doctor",

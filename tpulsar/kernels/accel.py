@@ -938,8 +938,13 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
         failed_rows: list[int] = []       # lost even after rescue
         refused_rows: list[int] = []      # refused twice -> rescue
         undispatched = 0                  # breaker-skipped, never sent
+        # named breaker: its open/closed transitions land in the
+        # metrics registry and as trace instants, so a poisoned
+        # session is visible in the beam's trace file, not only in
+        # warning logs
         breaker = CircuitBreaker(
-            failure_threshold=_breaker_threshold(), cooloff_s=60.0)
+            failure_threshold=_breaker_threshold(), cooloff_s=60.0,
+            name="accel.row_dispatch")
 
         def _zero_fill(rows):
             for r in rows:
@@ -1012,7 +1017,8 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                 pending.append((i, 1, rpolicy.call(
                     lambda: row_fn(spectra, bank_fft, i), row_retry,
                     breaker=breaker if shortcut else None,
-                    on_retry=lambda k, e: _safe_drain())))
+                    on_retry=lambda k, e: _safe_drain(),
+                    label="accel.row_dispatch")))
             except (CircuitOpenError,) + REFUSED:
                 refused_rows.append(i)
             if len(pending) >= SYNC_WINDOW:
@@ -1029,7 +1035,6 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
             for r, tup in rescued.items():
                 vals[r], rbins[r], zidx[r] = tup
             _zero_fill([r for r in todo if r not in rescued])
-
         if failed_rows and len(failed_rows) == ndms:
             # EVERY row refused AND the host rescue recovered none:
             # the runtime is refusing this program outright and there
@@ -1055,7 +1060,39 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                 f"{ndms} rows (dispatched rows each retried once "
                 f"after a sync flush) and the host rescue " + why)
             exc.rescue_exhausted = recompute_ran
+            # NO rescue-row OUTCOME metrics on this path: the
+            # exception escalates to the executor's chunk rescue,
+            # which owns the final rescued/lost accounting — counting
+            # here too would record every escalated row twice.  The
+            # undispatched diagnostic has no chunk-level counterpart,
+            # so it IS tallied before the raise: the poisoned-session
+            # scenario (breaker open, most rows skipped) is exactly
+            # where it matters.
+            if undispatched:
+                from tpulsar.obs import telemetry as _tm
+                _tm.accel_undispatched_rows_total().inc(undispatched)
             raise exc
+        # rescue outcome counters (metrics snapshot): disjoint row
+        # accounting — every refused row lands in exactly one of
+        # rescued/lost, so the outcome series sum to the refused row
+        # count; breaker-skipped rows are a separate diagnostic
+        # (accel_undispatched_rows_total), since they also end in
+        # rescued/lost.  The trace instant places the burst on the
+        # timeline.
+        from tpulsar.obs import telemetry as _tm
+        if rescued:
+            _tm.rescue_rows_total().inc(len(rescued),
+                                        outcome="rescued")
+        if failed_rows:
+            _tm.rescue_rows_total().inc(len(failed_rows),
+                                        outcome="lost")
+        if undispatched:
+            _tm.accel_undispatched_rows_total().inc(undispatched)
+        if refused_rows:
+            _tm.trace.instant(
+                "accel_rows_refused", n=len(set(refused_rows)),
+                rescued=len(rescued), lost=len(failed_rows),
+                undispatched=undispatched)
         # count(), not note(): this fires once per DM chunk and the
         # totals must ACCUMULATE across the pass — including the
         # clean chunks' rows in the denominator, or the recorded

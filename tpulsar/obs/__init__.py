@@ -1,1 +1,5 @@
-"""Observability: structured logging, email notification, debug flags."""
+"""Observability: structured logging, email notification, debug
+flags, and the unified telemetry layer — span tracing with
+Chrome-trace export (trace), the process-wide metrics registry
+(metrics), and the instrument catalog + shared heartbeat event shape
+(telemetry)."""

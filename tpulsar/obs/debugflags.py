@@ -8,7 +8,10 @@ from __future__ import annotations
 
 MODES = {
     "jobtracker": "log every job-tracker DB query",
-    "upload": "collect per-category upload timing",
+    "upload": "print the per-category upload timing summary after "
+              "each uploader iteration (the timings themselves are "
+              "always aggregated into the tpulsar_upload_seconds "
+              "metrics histogram; this flag only controls the print)",
     "download": "verbose downloader tracing",
     "syscalls": "echo every external command before execution",
     "qmanager": "verbose queue-manager tracing",

@@ -14,12 +14,23 @@ import sys
 
 
 def get_logger(module: str, logfile: str | None = None,
-               screen: bool = True, level: int = logging.INFO
+               screen: bool = True, level: int | None = None
                ) -> logging.Logger:
     """Create/fetch a logger writing to `logfile` (if given) and
-    optionally the console."""
+    optionally the console.
+
+    The level is set only on FIRST configuration (default INFO) or
+    when a caller passes one explicitly: re-fetching a logger with
+    the default must not reset it — a daemon configured at DEBUG was
+    silently flipped back to INFO by any later library call that
+    fetched the same logger (the old unconditional setLevel)."""
     logger = logging.getLogger(f"tpulsar.{module}")
-    logger.setLevel(level)
+    first_config = not getattr(logger, "_tpulsar_configured", False)
+    if level is not None:
+        logger.setLevel(level)
+    elif first_config:
+        logger.setLevel(logging.INFO)
+    logger._tpulsar_configured = True
     logger.propagate = False
 
     fmt = logging.Formatter(

@@ -1,0 +1,168 @@
+"""Unified telemetry: the instrument catalog + shared event shapes.
+
+One import point for every layer that records telemetry:
+
+  * ``trace`` / ``metrics`` — the span tracer (obs/trace.py) and the
+    process-wide metrics registry (obs/metrics.py), re-exported;
+  * the INSTRUMENT CATALOG — every metric the pipeline exports is
+    declared here once, so names/types/labels live in one table (and
+    docs/operations.md documents this table, not N call sites);
+  * ``event_record`` — the ONE constructor for heartbeat/progress
+    JSON records.  The executor's stage heartbeat (report._beat) and
+    bench.py's bench_partial.jsonl lines previously used different
+    hand-built shapes; the bench supervisor's stall detector reads
+    BOTH, so the shapes drifting apart silently breaks kill
+    attribution.  Both now build their records here.
+
+stdlib only: imported by the resilience policy engine and the
+jobtracker, which must work in processes that never import jax.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpulsar.obs import metrics, trace  # re-exported  # noqa: F401
+
+# --------------------------------------------------------------------
+# instrument catalog — the full set of exported metrics.  Getters, not
+# module-level instances: the registry get-or-create makes each call
+# cheap, and a test that resets metrics.REGISTRY never holds stale
+# instrument handles through this module.
+# --------------------------------------------------------------------
+
+#: histogram buckets for per-stage beam timings (seconds): chunk-level
+#: scopes land in the sub-second decades, full stages in the minutes
+STAGE_BUCKETS = (0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 180.0, 600.0,
+                 1800.0)
+
+
+def stage_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_stage_seconds",
+        "wall seconds per executor timing scope (one observation per "
+        "scope entry, so chunked stages observe once per chunk)",
+        labelnames=("stage",), buckets=STAGE_BUCKETS)
+
+
+def passes_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_passes_total",
+        "completed dedispersion passes")
+
+
+def dm_trials_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_dm_trials_total",
+        "DM trials searched")
+
+
+def retry_attempts_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_retry_attempts_total",
+        "retries issued by the shared resilience policy engine",
+        labelnames=("point",))
+
+
+def backoff_seconds_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_backoff_seconds_total",
+        "seconds slept in policy backoff",
+        labelnames=("point",))
+
+
+def circuit_transitions_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_circuit_transitions_total",
+        "circuit-breaker state transitions",
+        labelnames=("point", "state"))
+
+
+def rescue_rows_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_rescue_rows_total",
+        "refused accel rows by FINAL outcome — rescued (host "
+        "recompute) or lost (zero-filled); disjoint, so the outcome "
+        "series sum to the refused row count",
+        labelnames=("outcome",))
+
+
+def accel_undispatched_rows_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_accel_undispatched_rows_total",
+        "accel rows never dispatched because the open breaker routed "
+        "them straight to rescue (diagnostic overlay: these rows ALSO "
+        "appear in tpulsar_rescue_rows_total under their final "
+        "outcome)")
+
+
+def pool_rotate_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_pool_rotate_seconds",
+        "job-pool scheduler iteration latency")
+
+
+def download_bytes_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_download_bytes_total",
+        "bytes fetched by completed downloads")
+
+
+def download_failures_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_download_failures_total",
+        "download failures by kind",
+        labelnames=("kind",))        # transfer | verify
+
+
+def upload_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_upload_seconds",
+        "per-category upload timing (the debugflags 'upload' "
+        "summary, aggregated as a histogram)",
+        labelnames=("category",))
+
+
+def uploads_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_uploads_total",
+        "upload attempts by outcome",
+        # uploaded | deferred | failed | error (unexpected exception)
+        labelnames=("outcome",))
+
+
+def heartbeats_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_heartbeats_total",
+        "telemetry heartbeat events emitted",
+        labelnames=("event",))
+
+
+# --------------------------------------------------------------------
+# the shared heartbeat/progress event shape
+# --------------------------------------------------------------------
+
+def event_record(event: str, stage: str = "", info: str = "",
+                 t_stage: float = 0.0, **extra) -> dict:
+    """The canonical telemetry event: ``{"t": now, "event": ...}``
+    plus stage attribution when present.
+
+    Consumed by two supervisors that must agree on the shape:
+      * bench.py's stall detector reads ``t`` (freshness) and, for
+        kill attribution, ``stage``/``t_stage``/``event``/``info``
+        from the heartbeat file;
+      * bench.py's ``_read_partial`` folds bench_partial.jsonl lines
+        (``event`` plus free-form keys like ``pass_idx``) into the
+        evidence record.
+    ``extra`` keys are additive — existing consumers key on the names
+    above and ignore the rest."""
+    rec: dict = {"t": time.time(), "event": event}
+    if stage:
+        rec["stage"] = stage
+    if t_stage:
+        rec["t_stage"] = t_stage
+    if info:
+        rec["info"] = info
+    rec.update(extra)
+    heartbeats_total().inc(event=event or "?")
+    return rec

@@ -27,6 +27,7 @@ import threading
 import time
 import uuid
 
+from tpulsar.obs import telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.orchestrate.jobtracker import JobTracker, nowstr
 from tpulsar.resilience import faults
@@ -353,6 +354,7 @@ class Downloader:
                         detail=remote)
             self.transport.fetch(remote, local)
         except Exception as e:
+            telemetry.download_failures_total().inc(kind="transfer")
             self.t.execute(
                 ["UPDATE download_attempts SET status=?, details=?, "
                  "updated_at=? WHERE id=?",
@@ -363,7 +365,9 @@ class Downloader:
             return
         elapsed = max(time.time() - t0, 1e-3)
         if os.path.exists(local):
-            self._rates.append(os.path.getsize(local) / elapsed)
+            nbytes = os.path.getsize(local)
+            self._rates.append(nbytes / elapsed)
+            telemetry.download_bytes_total().inc(nbytes)
         self.t.execute(
             ["UPDATE download_attempts SET status=?, details=?, "
              "updated_at=? WHERE id=?",
@@ -405,6 +409,7 @@ class Downloader:
             else:
                 if os.path.exists(local):
                     os.remove(local)
+                telemetry.download_failures_total().inc(kind="verify")
                 self.t.update("files", row["id"], status="failed",
                               details=f"size mismatch: {actual} != {expected}")
                 att = self.t.query(

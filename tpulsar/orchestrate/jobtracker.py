@@ -124,7 +124,12 @@ class JobTracker:
         return conn
 
     def _with_retries(self, fn):
-        return rpolicy.call(fn, self.RETRY_POLICY)
+        # label: lock-contention retries become
+        # tpulsar_retry_attempts_total{point="jobtracker.lock"} (and
+        # the backoff sleeps the matching backoff-seconds counter) —
+        # previously only visible as elapsed time
+        return rpolicy.call(fn, self.RETRY_POLICY,
+                            label="jobtracker.lock")
 
     # ------------------------------------------------------------- queries
 

@@ -17,6 +17,7 @@ import time
 import traceback
 
 from tpulsar.io import datafile
+from tpulsar.obs import telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.orchestrate.jobtracker import JobTracker, nowstr
 from tpulsar.orchestrate.queue_managers import (
@@ -54,11 +55,19 @@ class JobPool:
     # ------------------------------------------------------------- rotate
 
     def rotate(self) -> None:
-        """One scheduler iteration (reference job.py:107-123)."""
-        self.create_jobs_for_new_files()
-        self.update_jobs_status_from_queue()
-        self.recover_failed_jobs()
-        self.submit_jobs()
+        """One scheduler iteration (reference job.py:107-123).
+        Iteration latency feeds the tpulsar_pool_rotate_seconds
+        histogram — a rotate that grows from ms to minutes (stuck
+        queue backend, contended tracker DB) is visible in the daemon
+        metrics export before it stalls job flow entirely."""
+        t0 = time.time()
+        try:
+            self.create_jobs_for_new_files()
+            self.update_jobs_status_from_queue()
+            self.recover_failed_jobs()
+            self.submit_jobs()
+        finally:
+            telemetry.pool_rotate_seconds().observe(time.time() - t0)
 
     # ------------------------------------------------- job creation
 

@@ -24,6 +24,7 @@ import traceback
 import numpy as np
 
 from tpulsar.io import accelcands
+from tpulsar.obs import telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.orchestrate import diagnostics as diag_mod
 from tpulsar.orchestrate.jobtracker import JobTracker
@@ -83,7 +84,10 @@ def get_version_number(resultsdir: str) -> str:
 
 #: per-category accumulated upload times, printed after each upload
 #: under the 'upload' debug flag (reference upload_timing_summary,
-#: JobUploader.py:88-90,105-129,208-215)
+#: JobUploader.py:88-90,105-129,208-215).  The same timings ALWAYS
+#: feed the tpulsar_upload_seconds metrics histogram — the debug flag
+#: only gates the print, so the per-category distribution is
+#: observable (stats/exports) without rerunning under the flag.
 upload_timing_summary: dict[str, float] = {}
 
 
@@ -93,8 +97,10 @@ def _timed(category: str):
     try:
         yield
     finally:
+        elapsed = time.time() - t0
         upload_timing_summary[category] = (
-            upload_timing_summary.get(category, 0.0) + time.time() - t0)
+            upload_timing_summary.get(category, 0.0) + elapsed)
+        telemetry.upload_seconds().observe(elapsed, category=category)
 
 
 class JobUploader:
@@ -203,6 +209,7 @@ class JobUploader:
                           details=str(e)[:4000])
             self.t.update("jobs", job_id, status="failed",
                           details="result parsing failed")
+            telemetry.uploads_total().inc(outcome="failed")
             self.log.warning("submit %d parse failed: %s", submit_id, e)
             return
 
@@ -238,6 +245,7 @@ class JobUploader:
         except (DatabaseConnectionError, DatabaseDeadlockError) as e:
             if db:
                 db.rollback()
+            telemetry.uploads_total().inc(outcome="deferred")
             self.log.warning("submit %d upload deferred: %s", submit_id, e)
             return                      # leave processed: retry later
         except UploadError as e:
@@ -247,11 +255,16 @@ class JobUploader:
                           details=str(e)[:4000])
             self.t.update("jobs", job_id, status="failed",
                           details="upload verification failed")
+            telemetry.uploads_total().inc(outcome="failed")
             self.log.warning("submit %d upload failed: %s", submit_id, e)
             return
         except Exception:
             if db:
                 db.rollback()
+            # the counter must see EVERY attempt outcome: a daemon
+            # hot-looping on an unexpected error would otherwise show
+            # no upload activity at all in the metrics export
+            telemetry.uploads_total().inc(outcome="error")
             self.log.error("submit %d unexpected upload error:\n%s",
                            submit_id, traceback.format_exc())
             raise
@@ -263,6 +276,7 @@ class JobUploader:
                       details="uploaded and verified")
         self.t.update("jobs", job_id, status="uploaded",
                       details="complete")
+        telemetry.uploads_total().inc(outcome="uploaded")
         self.log.info("submit %d uploaded (header %s)", submit_id,
                       header.header_id)
         if self.delete_raw_on_upload:

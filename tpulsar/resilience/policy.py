@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Callable
 
+from tpulsar.obs import telemetry
+
 
 class DeadlineExceeded(RuntimeError):
     """The watched call outlived its deadline: a hang, classified."""
@@ -90,13 +92,24 @@ class CircuitBreaker:
     the circuit, failure re-opens it for another cooloff."""
 
     def __init__(self, failure_threshold: int = 5,
-                 cooloff_s: float = 60.0, clock=time.monotonic):
+                 cooloff_s: float = 60.0, clock=time.monotonic,
+                 name: str = ""):
         self.failure_threshold = max(1, int(failure_threshold))
         self.cooloff_s = cooloff_s
+        self.name = name
         self._clock = clock
         self._fails = 0
         self._opened_at: float | None = None
         self._lock = threading.Lock()
+
+    def _transition(self, state: str) -> None:
+        """Telemetry on every state change: a counter (snapshot-
+        visible) and a trace instant (timeline-visible) — circuit
+        flips were previously invisible outside warning logs."""
+        point = self.name or "unnamed"
+        telemetry.circuit_transitions_total().inc(point=point,
+                                                  state=state)
+        telemetry.trace.instant("circuit_" + state, point=point)
 
     def allow(self) -> bool:
         with self._lock:
@@ -106,14 +119,26 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            was_open = self._opened_at is not None
             self._fails = 0
             self._opened_at = None
+        if was_open:
+            self._transition("closed")
 
     def record_failure(self) -> None:
         with self._lock:
             self._fails += 1
-            if self._fails >= self.failure_threshold:
+            opened = self._fails >= self.failure_threshold
+            was_open = self._opened_at is not None
+            if opened:
                 self._opened_at = self._clock()
+        if opened and not was_open:
+            self._transition("open")
+        elif opened and was_open:
+            # the half-open trial call failed: a re-open, distinct
+            # from the first trip (a session that keeps refusing its
+            # trial calls reads differently from one bad burst)
+            self._transition("reopen")
 
     @property
     def state(self) -> str:
@@ -161,7 +186,8 @@ def call(fn: Callable, policy: RetryPolicy, *,
          sleeper: Callable[[float], None] = time.sleep,
          rng: Callable[[], float] = random.random,
          breaker: CircuitBreaker | None = None,
-         on_retry: Callable[[int, BaseException], None] | None = None):
+         on_retry: Callable[[int, BaseException], None] | None = None,
+         label: str = ""):
     """Run fn under the policy: up to max_attempts tries, backoff
     between them, per-attempt deadline when configured, breaker
     consulted/updated when provided.  Raises the last failure (or
@@ -169,6 +195,11 @@ def call(fn: Callable, policy: RetryPolicy, *,
     fires only when another attempt WILL follow — never after the
     terminal failure (a callback that resets state for 'the next
     attempt' must not run when there is none).
+
+    label: telemetry point name — retries and backoff sleeps are
+    accumulated per label into tpulsar_retry_attempts_total /
+    tpulsar_backoff_seconds_total (unlabelled calls aggregate under
+    the breaker's name, else 'unnamed').
 
     The breaker records ONE failure per failed CALL, not per attempt:
     its threshold counts consecutive refused operations, so a
@@ -178,6 +209,8 @@ def call(fn: Callable, policy: RetryPolicy, *,
         raise ValueError(
             f"RetryPolicy.max_attempts must be >= 1, got "
             f"{policy.max_attempts}")
+    point = label or (breaker.name if breaker is not None
+                      and breaker.name else "") or "unnamed"
     last: BaseException | None = None
     for attempt in range(policy.max_attempts):
         if breaker is not None and not breaker.allow():
@@ -186,7 +219,13 @@ def call(fn: Callable, policy: RetryPolicy, *,
                 f"consecutive failures (cooloff "
                 f"{breaker.cooloff_s:g} s)")
         if attempt > 0 or policy.delay_first:
-            sleeper(policy.backoff_s(max(0, attempt - 1), rng=rng))
+            delay = policy.backoff_s(max(0, attempt - 1), rng=rng)
+            if delay > 0:
+                telemetry.backoff_seconds_total().inc(delay,
+                                                      point=point)
+            sleeper(delay)
+        if attempt > 0:
+            telemetry.retry_attempts_total().inc(point=point)
         try:
             result = run_with_deadline(fn, policy.deadline_s)
         except BaseException as e:

@@ -39,6 +39,8 @@ import numpy as np
 
 from tpulsar.io import accelcands, datafile
 from tpulsar.kernels import accel as accel_k
+from tpulsar.obs import telemetry
+from tpulsar.obs import trace as trace_mod
 from tpulsar.kernels import dedisperse as dd
 from tpulsar.kernels import fold as fold_k
 from tpulsar.kernels import fourier as fr
@@ -206,6 +208,16 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     # can still take effect.
     tpulsar.apply_platform_env()
     params = params or SearchParams()
+    if trace_mod.enabled():
+        # one trace file per beam: clear events at beam start so the
+        # saved <basenm>_trace.json rollup matches THIS beam's
+        # .report (tools/trace_summarize.py's 5% contract), not an
+        # accumulation over every beam this process searched
+        trace_mod.start(clear=True)
+    # registry baseline: the metrics.json artifact below is the DELTA
+    # over this beam, so a long-lived worker never attributes beam
+    # A's refusals/retries to beam B's results directory
+    metrics_base = telemetry.metrics.REGISTRY.snapshot()
     os.makedirs(workdir, exist_ok=True)
     os.makedirs(resultsdir, exist_ok=True)
 
@@ -319,6 +331,20 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                          rescued_modes=resc)
     timers.write_report(os.path.join(resultsdir, f"{basenm}.report"),
                         basenm, degraded=deg, rescued=resc)
+    # telemetry artifacts ride with the beam: the Chrome-trace file
+    # (TPULSAR_TRACE=1 — load into ui.perfetto.dev, or summarize with
+    # tools/trace_summarize.py / `tpulsar trace <dir>`) and the
+    # per-beam metrics delta, so retry/rescue/circuit counters for
+    # THIS beam are inspectable per results directory, not only in
+    # daemon exports
+    if trace_mod.enabled():
+        trace_mod.save(os.path.join(resultsdir,
+                                    f"{basenm}_trace.json"))
+    import json as _json
+    with open(os.path.join(resultsdir, "metrics.json"), "w") as fh:
+        _json.dump(telemetry.metrics.diff_snapshots(
+            telemetry.metrics.REGISTRY.snapshot(), metrics_base), fh,
+            indent=1)
     _tar_result_classes(resultsdir, basenm)
 
     return SearchOutcome(basenm=basenm, resultsdir=resultsdir,
@@ -400,9 +426,13 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     else:
         _trace = contextlib.nullcontext()
     with _trace:
-        return _search_block_inner(
-            data, freqs, dt, plan, params, zaplist, baryv, nsub,
-            timers, checkpoint_dir, data_id, progress_cb, mesh)
+        # root telemetry span: every stage/chunk span of this search
+        # nests under it in the exported Chrome trace
+        with trace_mod.span("search_block",
+                            npasses=sum(s.numpasses for s in plan)):
+            return _search_block_inner(
+                data, freqs, dt, plan, params, zaplist, baryv, nsub,
+                timers, checkpoint_dir, data_id, progress_cb, mesh)
 
 
 def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
@@ -483,62 +513,80 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                         with timers.timing("pipeline-wait"):
                             jax.block_until_ready(pending[-2][4])
                     dm_chunk = dms[lo: lo + chunk_sz]
-                    with timers.timing("dedispersing"):
-                        series = dd.dedisperse_subbands(
-                            subb,
-                            jnp.asarray(sub_shifts[lo: lo + len(dm_chunk)]))
-                    num_trials += len(dm_chunk)
-                    # FFT-friendly padded length (reference: PRESTO
-                    # choose_N via prepsubband -numout,
-                    # PALFA2_presto_search.py:518); one length per
-                    # plan step keeps compile signatures bounded.
-                    nfft = ddplan.choose_n(series.shape[1])
-                    T_s = nfft * dt_ds
+                    # per-chunk child span: the stage scopes below
+                    # nest under it, so the trace file shows the
+                    # pass/chunk structure, not just stage totals
+                    with trace_mod.span("dm_chunk",
+                                        pass_idx=pass_idx, lo=int(lo),
+                                        n=int(len(dm_chunk))):
+                        with timers.timing("dedispersing"):
+                            series = dd.dedisperse_subbands(
+                                subb,
+                                jnp.asarray(
+                                    sub_shifts[lo: lo + len(dm_chunk)]))
+                            # opt-in device attribution
+                            # (TPULSAR_TRACE_SYNC=1): fence so the
+                            # scope's exit clock includes the device
+                            # compute this enqueue started
+                            trace_mod.fence(series)
+                        num_trials += len(dm_chunk)
+                        # FFT-friendly padded length (reference: PRESTO
+                        # choose_N via prepsubband -numout,
+                        # PALFA2_presto_search.py:518); one length per
+                        # plan step keeps compile signatures bounded.
+                        nfft = ddplan.choose_n(series.shape[1])
+                        T_s = nfft * dt_ds
 
-                    with timers.timing("single-pulse"):
-                        # the device half of single_pulse_search
-                        # (same two jitted programs); the host half
-                        # (events_from_topk) runs at pass end
-                        sp_pair = sp_k.device_search(
-                            series, tuple(params.sp_widths),
-                            estimator=params.sp_detrend)
+                        with timers.timing("single-pulse"):
+                            # the device half of single_pulse_search
+                            # (same two jitted programs); the host half
+                            # (events_from_topk) runs at pass end
+                            sp_pair = sp_k.device_search(
+                                series, tuple(params.sp_widths),
+                                estimator=params.sp_detrend)
+                            trace_mod.fence(sp_pair)
 
-                    with timers.timing("FFT"):
-                        nbins = nfft // 2 + 1
-                        keep = fr.zap_mask(nbins, T_s, zaplist, baryv) \
-                            if zaplist is not None else None
-                        # One fused pad->rfft->whiten->scale program
-                        # per chunk; the whitened COMPLEX spectrum is
-                        # shared by the lo stage (interbinned powers)
-                        # and the hi stage (correlation input).
-                        # Zapped bins have wpow==0 so they vanish
-                        # from both.
-                        wspec = (fr.whitened_spectrum_masked(
-                                     series, jnp.asarray(keep),
-                                     nfft=nfft)
-                                 if keep is not None else
-                                 fr.whitened_spectrum(series,
-                                                      nfft=nfft))
-                    with timers.timing("lo-accelsearch"):
-                        # half-bin detection grid (PRESTO ACCEL_DR=0.5
-                        # via interbinning) — bin indices are in
-                        # half-bin units, hence bin_scale=0.5; one
-                        # fused program so the (rows, 2*nbins)
-                        # interbinned grid never round-trips HBM
-                        res = fr.lo_stage_candidates(
-                            wspec,
-                            tuple(fr.harmonic_stages(
-                                params.lo_accel_numharm)),
-                            params.topk_per_stage)
+                        with timers.timing("FFT"):
+                            nbins = nfft // 2 + 1
+                            keep = fr.zap_mask(nbins, T_s, zaplist,
+                                               baryv) \
+                                if zaplist is not None else None
+                            # One fused pad->rfft->whiten->scale program
+                            # per chunk; the whitened COMPLEX spectrum is
+                            # shared by the lo stage (interbinned powers)
+                            # and the hi stage (correlation input).
+                            # Zapped bins have wpow==0 so they vanish
+                            # from both.
+                            wspec = (fr.whitened_spectrum_masked(
+                                         series, jnp.asarray(keep),
+                                         nfft=nfft)
+                                     if keep is not None else
+                                     fr.whitened_spectrum(series,
+                                                          nfft=nfft))
+                            trace_mod.fence(wspec)
+                        with timers.timing("lo-accelsearch"):
+                            # half-bin detection grid (PRESTO
+                            # ACCEL_DR=0.5 via interbinning) — bin
+                            # indices are in half-bin units, hence
+                            # bin_scale=0.5; one fused program so the
+                            # (rows, 2*nbins) interbinned grid never
+                            # round-trips HBM
+                            res = fr.lo_stage_candidates(
+                                wspec,
+                                tuple(fr.harmonic_stages(
+                                    params.lo_accel_numharm)),
+                                params.topk_per_stage)
+                            trace_mod.fence(res)
 
-                    hi_cands: list = []
-                    if params.run_hi_accel and params.hi_accel_zmax > 0:
-                        with timers.timing("hi-accelsearch"):
-                            hi_cands = _hi_accel_pass(
-                                wspec, dm_chunk, T_s, params)
-                    del wspec
-                    pending.append((dm_chunk, T_s, nbins, sp_pair,
-                                    res, hi_cands))
+                        hi_cands: list = []
+                        if params.run_hi_accel \
+                                and params.hi_accel_zmax > 0:
+                            with timers.timing("hi-accelsearch"):
+                                hi_cands = _hi_accel_pass(
+                                    wspec, dm_chunk, T_s, params)
+                        del wspec
+                        pending.append((dm_chunk, T_s, nbins, sp_pair,
+                                        res, hi_cands))
 
                 # ---- pass end: one transfer per stage family
                 # (charged to its own timer: the first get blocks on
@@ -576,6 +624,8 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                      if len(sp_chunks) > pass_sp_start
                      else _EMPTY_SP),
                     num_trials - pass_trials_start)
+            telemetry.passes_total().inc()
+            telemetry.dm_trials_total().inc(len(dms))
             if progress_cb is not None:
                 progress_cb({
                     "pass_idx": pass_idx + 1, "npasses": npasses,
@@ -885,20 +935,29 @@ def _hi_accel_pass(wspec, dm_chunk, T_s, params: SearchParams
         # when no rescue is possible does the chunk's hi stage skip
         # loudly: the beam keeps its SP, lo, fold, and other chunks'
         # hi science instead of dying with nothing recorded.
+        from tpulsar.obs import telemetry
         from tpulsar.resilience import rescue
         chunk_res = None
         if not getattr(exc, "rescue_exhausted", False):
-            chunk_res = rescue.rescue_accel_chunk(
-                wspec, bank, max_numharm=params.hi_accel_numharm,
-                topk=params.topk_per_stage)
+            with telemetry.trace.span("accel_chunk_rescue",
+                                      n=len(dm_chunk)):
+                chunk_res = rescue.rescue_accel_chunk(
+                    wspec, bank, max_numharm=params.hi_accel_numharm,
+                    topk=params.topk_per_stage)
         if chunk_res is None:
             degraded.count("accel_hi_chunk_skipped", len(dm_chunk),
                            len(dm_chunk), extra=str(exc)[:160])
+            telemetry.rescue_rows_total().inc(len(dm_chunk),
+                                              outcome="lost")
             import warnings
             warnings.warn(f"hi-accel chunk skipped: {exc}")
             return []
         res, lost_rows = chunk_res
         n_ok = len(dm_chunk) - len(lost_rows)
+        telemetry.rescue_rows_total().inc(n_ok, outcome="rescued")
+        if lost_rows:
+            telemetry.rescue_rows_total().inc(len(lost_rows),
+                                              outcome="lost")
         degraded.provenance_count(
             "accel_rows_rescued", n_ok, len(dm_chunk),
             extra="whole chunk refused by the runtime; recomputed on "

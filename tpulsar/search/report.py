@@ -15,6 +15,8 @@ import os
 import sys
 import time
 
+from tpulsar.obs import telemetry, trace
+
 
 STAGES = ("rfifind", "subbanding", "dedispersing", "single-pulse",
           "FFT", "lo-accelsearch", "hi-accelsearch", "sifting", "folding")
@@ -51,10 +53,15 @@ def _beat(stage: str = "", event: str = "", info: str = "") -> None:
     if not _HEARTBEAT:
         return
     t_stage = _CUR_STAGE[-1][1] if _CUR_STAGE else 0.0
-    rec = {"t": time.time(), "stage": stage, "event": event,
-           "t_stage": t_stage}
-    if info:
-        rec["info"] = info
+    # one event constructor shared with bench.py's progress lines
+    # (telemetry.event_record), so the bench supervisor's stall
+    # detector and this heartbeat cannot drift apart in shape; the
+    # stage/t_stage keys stay present even when empty — the
+    # historical heartbeat contract the parent's parser grew up on
+    rec = telemetry.event_record(event, stage=stage, info=info,
+                                 t_stage=t_stage)
+    rec.setdefault("stage", stage)
+    rec.setdefault("t_stage", t_stage)
     try:
         # atomic replace: the supervising parent reads this file
         # between polls, and a torn half-written JSON read as garbage
@@ -83,18 +90,32 @@ class StageTimers:
 
     @contextlib.contextmanager
     def timing(self, stage: str):
+        """One timed scope = one telemetry span + one histogram
+        observation + the times[] accumulation this class has always
+        done.  StageTimers is now a thin view over the span tracer:
+        span begin/end use the same clock reads as times[], so a
+        trace-file rollup reproduces the .report totals exactly (the
+        tools/trace_summarize.py contract) and the .report text stays
+        byte-stable."""
         self.times.setdefault(stage, 0.0)
         start = time.time()
         _CUR_STAGE.append((stage, start))
-        _beat(stage, "begin")
-        if _TRACE:
-            print(f"[stage-trace +{start - self._t0:8.1f}s] begin "
-                  f"{stage}", file=sys.stderr, flush=True)
         try:
-            yield
+            with trace.span(stage):
+                # beat + stderr trace INSIDE the span: their file/
+                # stream I/O (ms-scale on a loaded host) then counts
+                # toward both instruments identically instead of
+                # opening a per-scope gap between timer and span
+                _beat(stage, "begin")
+                if _TRACE:
+                    print(f"[stage-trace +{start - self._t0:8.1f}s] "
+                          f"begin {stage}", file=sys.stderr,
+                          flush=True)
+                yield
         finally:
             end = time.time()
             self.times[stage] += end - start
+            telemetry.stage_seconds().observe(end - start, stage=stage)
             if _CUR_STAGE and _CUR_STAGE[-1][0] == stage:
                 _CUR_STAGE.pop()
             _beat(stage, "end")
