@@ -82,9 +82,14 @@ import tempfile
 import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(_REPO, ".jax_cache"))
 sys.path.insert(0, _REPO)
+
+# the one cache-dir resolution (tpulsar.aot.cachedir: TPULSAR_CACHE_DIR
+# > existing JAX_COMPILATION_CACHE_DIR > <repo>/.jax_cache) — the gate,
+# the measured child, and the diagnostics must all warm the same cache
+from tpulsar.aot import cachedir as _aot_cachedir  # noqa: E402
+
+_aot_cachedir.activate()
 
 TARGET_SECONDS = 60.0   # BASELINE.json north-star target (v5e-4)
 
@@ -103,11 +108,14 @@ def _emit(result: dict) -> None:
     result.setdefault("schema", BENCH_SCHEMA)
     print(json.dumps(result), flush=True)
 
-NCHAN = 960
-TSAMP = 65.476e-6
-# divisible by every plan downsamp (1,2,3,5,6,10) and a rich 2^k factor
-T_FULL = 3_932_160      # ~257 s observation
-FCTR, BW = 1375.5, 322.617
+# beam geometry shared with the AOT gate's shape-builders — ONE
+# declaration (tpulsar/aot/registry.py; stdlib-only import), so the
+# gate compiles exactly the shapes the measured child executes.
+# T_FULL (~257 s observation) is divisible by every plan downsamp
+# (1,2,3,5,6,10) with a rich 2^k factor; NSAMP_QUANTUM preserves that
+# divisibility under TPULSAR_BENCH_SCALE.
+from tpulsar.aot.registry import (  # noqa: E402
+    BW, FCTR, NCHAN, NSAMP_QUANTUM, T_FULL, TSAMP)
 
 # DM 220 sits in the FIRST pass of the survey plan's second step, so
 # the injected pulsar stays inside the searched DM range even when
@@ -147,25 +155,20 @@ def _bench_dtype_name() -> str:
     """Validated TPULSAR_BENCH_DTYPE value, with NO jax import — the
     parent process must be able to fail fast on a misconfig without
     dialing the accelerator runtime (import jax hangs on a wedged
-    chip)."""
-    val = os.environ.get("TPULSAR_BENCH_DTYPE", "uint8")
-    if val in ("uint8", "bfloat16"):
-        return val
-    # reject rather than guess: a silently-coerced dtype changes the
-    # measured headline number with no warning
-    raise SystemExit(
-        f"TPULSAR_BENCH_DTYPE must be uint8|bfloat16, got {val!r}")
+    chip).  Delegates to the AOT registry, the ONE place the knob is
+    interpreted (the measured child, the focused configs, and the
+    gate's shape-builders must all agree on the dtype or the gate
+    compiles programs that never execute)."""
+    from tpulsar.aot.registry import block_dtype_name
+
+    return block_dtype_name()
 
 
 def _bench_dtype():
-    """Device block dtype from TPULSAR_BENCH_DTYPE — the ONE place the
-    knob is interpreted (the measured child, the focused configs, and
-    the AOT gate must all agree on the dtype or the gate compiles
-    programs that never execute)."""
-    import jax.numpy as jnp
+    """Device block dtype as a jnp dtype (see _bench_dtype_name)."""
+    from tpulsar.aot.registry import block_dtype
 
-    return (jnp.uint8 if _bench_dtype_name() == "uint8"
-            else jnp.bfloat16)
+    return block_dtype()
 
 
 def gen_block_chunk(key, delay_chunk, n: int, nc: int, dtype):
@@ -241,7 +244,7 @@ def run_focused_config(cfg: int) -> None:
 
     scale = float(os.environ.get("TPULSAR_BENCH_SCALE", "1.0"))
     nsamp = int(T_FULL * scale)
-    nsamp -= nsamp % 30720
+    nsamp -= nsamp % NSAMP_QUANTUM
     freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
     # reset the partial-evidence file so a timed-out focused run's
     # error record cannot absorb a previous headline run's passes
@@ -435,7 +438,7 @@ def run_measured() -> None:
     nbeams = max(1, int(os.environ.get("TPULSAR_BENCH_NBEAMS", "1")))
 
     nsamp = int(T_FULL * scale)
-    nsamp -= nsamp % 30720  # keep divisibility by all downsamps
+    nsamp -= nsamp % NSAMP_QUANTUM  # divisibility by all downsamps
     freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
     plan = ddplan.survey_plan("pdev")
     if scale < 0.999:

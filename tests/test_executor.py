@@ -345,3 +345,26 @@ def test_degraded_modes_surfaced(tmp_path, monkeypatch):
     assert "accel_batch_pinned" in rep
     # restore the module verdict for other tests in this process
     monkeypatch.setattr(accel_k, "_BATCH_OK", None)
+
+
+def test_bounded_cache_is_lru_not_fifo():
+    """_BoundedCache must touch-on-hit: refinement revisits the
+    hottest per-DM series as same-DM candidates interleave in the
+    sigma ordering, and FIFO evicted exactly those.  Pin the eviction
+    order: with capacity 2, re-reading A before inserting C must
+    evict B (the least recently USED), so A costs no recompute."""
+    calls = []
+    cache = executor._BoundedCache(lambda k: calls.append(k) or k * 10,
+                                   capacity=2)
+    assert cache("A") == "A" * 10
+    cache("B")
+    assert calls == ["A", "B"]
+    cache("A")                      # hit: must move A to MRU
+    cache("C")                      # evicts B under LRU (A under FIFO)
+    assert calls == ["A", "B", "C"]
+    assert cache("A") == "A" * 10   # still cached => no new call
+    assert calls == ["A", "B", "C"]
+    cache("B")                      # evicted => recomputed (evicts C)
+    assert calls == ["A", "B", "C", "B"]
+    assert cache("A") == "A" * 10   # A survived both evictions
+    assert calls == ["A", "B", "C", "B"]

@@ -2,6 +2,14 @@
 """AOT-compile the full-scale search programs and report their HBM
 footprints WITHOUT executing anything on the device.
 
+Thin wrapper over the tpulsar.aot subsystem: the program set and its
+canonical shapes live in tpulsar/aot/registry.py (the single source
+of truth the gate, the runtime, and the diagnostics share), the
+compile loop + warm-start manifest in tpulsar/aot/warmstart.py.
+`tpulsar aot compile|verify|ls` is the same machinery as CLI
+subcommands; this script survives for its operators and the
+aot_gate_loop.sh / tpu_campaign.sh callers.
+
 Why this exists: on the axon runtime a runtime HBM OOM can wedge the
 chip for hours (see docs/architecture.md memory discipline), while a
 compile-stage error is a clean HTTP error.  This tool lowers and
@@ -27,30 +35,16 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
-import traceback
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(_REPO, ".jax_cache"))
 
-NCHAN, TSAMP = 960, 65.476e-6
-T_FULL = 3_932_160
-FCTR, BW = 1375.5, 322.617
+from tpulsar.aot import cachedir  # noqa: E402  (stdlib-only)
 
-
-def _mem_stats(compiled) -> str:
-    try:
-        an = compiled.memory_analysis()
-        tot = (an.temp_size_in_bytes + an.argument_size_in_bytes
-               + an.output_size_in_bytes)
-        return (f"temp {an.temp_size_in_bytes / 2**30:.2f} GiB, "
-                f"args {an.argument_size_in_bytes / 2**30:.2f} GiB, "
-                f"out {an.output_size_in_bytes / 2**30:.2f} GiB, "
-                f"total {tot / 2**30:.2f} GiB")
-    except Exception:
-        return "(memory analysis unavailable)"
+# the one cache-dir resolution (TPULSAR_CACHE_DIR > existing
+# JAX_COMPILATION_CACHE_DIR > <repo>/.jax_cache), replacing this
+# tool's former private setdefault
+cachedir.activate()
 
 
 def main() -> int:
@@ -84,335 +78,25 @@ def main() -> int:
                          "are deferred and the tool exits rc 3 so the "
                          "caller can re-run (warm cache makes the "
                          "finished prefix instant).  0 = no deadline")
+    ap.add_argument("--verify", action="store_true",
+                    help="verify instead of gate: compile the same "
+                         "set against the existing warm-start "
+                         "manifest and exit 1 if any program misses "
+                         "the persistent cache (= would have "
+                         "recompiled in-line during a measured run)")
+    ap.add_argument("--only", default="",
+                    help="comma-separated substrings; gate only the "
+                         "registry programs / instance labels that "
+                         "match (tests and targeted re-gates)")
     args = ap.parse_args()
-    t0 = time.monotonic()
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    from tpulsar.aot import warmstart
 
-    import tpulsar
-
-    tpulsar.apply_platform_env()
-    print(f"device: {jax.devices()[0]}", flush=True)
-
-    from tpulsar.kernels import dedisperse as dd
-    from tpulsar.kernels import fourier as fr
-    from tpulsar.kernels import rfi as rfi_k
-    from tpulsar.kernels import singlepulse as sp_k
-    from tpulsar.plan import ddplan
-
-    nsamp = int(T_FULL * args.scale)
-    nsamp -= nsamp % 30720
-    freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
-    plan = ddplan.survey_plan("pdev")
-    # the measured run's device block dtype and synthesizer come from
-    # bench itself — the gate must compile the EXACT programs the
-    # measured child executes, not a copy that can drift
-    import bench as bench_mod
-    blk_dtype = bench_mod._bench_dtype()
-
-    failures: list[str] = []
-    deferred: list[str] = []
-
-    def check(name: str, jitted, *shaped_args, **kw):
-        """AOT-compile `jitted` — which MUST be the very jitted
-        callable the runtime invokes (same function, same static
-        values), NOT a wrapping lambda: a wrapper lowers to a
-        different HLO module (jit__lambda vs jit_<fn>) and its
-        persistent-cache entry never serves the measured run.  Proven
-        live on 2026-07-31: after a passing lambda-style gate, the
-        measured child recompiled jit__cell_stats_chan and
-        jit_apply_mask_chan from scratch, then sat >25 min in the next
-        uncached compile until the deadline kill wedged the chip."""
-        if args.deadline and time.monotonic() - t0 > args.deadline:
-            deferred.append(name)
-            print(f"  [defer] {name}: deadline reached; re-run to "
-                  "resume from the warm cache", flush=True)
-            return
-        try:
-            compiled = jitted.lower(*shaped_args, **kw).compile()
-            print(f"  [ok] {name}: {_mem_stats(compiled)}", flush=True)
-        except Exception as e:
-            failures.append(name)
-            msg = str(e).splitlines()
-            print(f"  [FAIL] {name}: {msg[0] if msg else e!r}",
-                  flush=True)
-            if os.environ.get("AOT_CHECK_VERBOSE"):
-                traceback.print_exc()
-
-    S = jax.ShapeDtypeStruct
-    blk = S((NCHAN, nsamp), blk_dtype)
-    nblocks = nsamp // 2048
-    from functools import partial as _partial
-
-    _gen_jit = _partial(jax.jit, static_argnames=("n", "nc", "dtype"))(
-        bench_mod.gen_block_chunk)
-
-    print("synth:", flush=True)
-    check("make_block_chunk", _gen_jit,
-          S((2,), jnp.uint32), S((120,), jnp.float32),
-          n=nsamp, nc=120, dtype=blk_dtype)
-
-    if args.config in (1, 3, 4):
-        # Focused-config gate: compile the exact programs
-        # bench.run_focused_config(cfg) will execute (one
-        # 128/32-trial pass at ds=1 on the full-length block; the
-        # runtime dedisperse path is the XLA scan — Pallas only
-        # engages behind its own smoke gate).
-        dms = np.arange(128) * 2.0
-        if args.config == 3:
-            dms = dms[:32]
-        ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 96, 140.0, dms,
-                                            TSAMP, 1)
-        pad1 = dd._pad_bucket(int(ch_sh.max(initial=0)))
-        pad2 = dd._pad_bucket(int(sub_sh.max(initial=0)))
-        ndms = sub_sh.shape[0]
-        print(f"config {args.config} (ndms={ndms}, T={nsamp}):",
-              flush=True)
-        if args.config == 1:
-            check("cell_stats_chan", rfi_k._cell_stats_chan,
-                  blk, block_len=2048)
-            check("apply_mask_chan", rfi_k.apply_mask_chan,
-                  blk, S((nblocks, NCHAN), jnp.bool_),
-                  S((NCHAN,), jnp.float32), block_len=2048)
-        check("form_subbands", dd._form_subbands_jit,
-              blk, S((NCHAN,), jnp.int32),
-              nsub=96, downsamp=1, pad=pad1)
-        check("dedisperse_scan", dd._dedisperse_subbands_scan,
-              S((96, nsamp), jnp.float32),
-              S((ndms, 96), jnp.int32), pad=pad2)
-        if args.config == 4:
-            # estimator resolved exactly as the measured run resolves
-            # it (TPULSAR_SP_DETREND is inherited by this subprocess)
-            # — a different estimator is a different static-arg
-            # program and must not reach the chip ungated
-            sers = S((ndms, nsamp), jnp.float32)
-            check("sp_normalize", sp_k.normalize_series, sers,
-                  estimator=sp_k.detrend_estimator())
-            check("sp_boxcars", sp_k.boxcar_search, sers)
-        if args.config == 3:
-            from tpulsar.kernels import accel as ak
-            nbins = nsamp // 2 + 1
-            sers = S((ndms, nsamp), jnp.float32)
-            pows = S((ndms, nbins), jnp.float32)
-            check("complex_spectrum", fr.complex_spectrum, sers)
-            # the exact jitted callable with the estimator resolved
-            # as the measured run resolves it (TPULSAR_WHITEN_ESTIMATOR
-            # is inherited by this subprocess) — fr.whiten_powers is
-            # the resolving wrapper, not the program
-            check("whiten_powers", fr._whiten_powers_jit, pows,
-                  edges=tuple(int(e) for e in fr._block_edges(nbins)),
-                  estimator=fr.whiten_estimator())
-            bank = ak.build_template_bank(200.0)
-            nz = len(bank.zs)
-            dmc = min(ndms, ak.plane_dm_chunk(nbins, nz))
-            print(f"accel z200 (nz={nz}, nbins={nbins}, "
-                  f"dm_chunk={dmc}):", flush=True)
-            spec_sh = S((ndms, nbins), jnp.complex64)
-            bank_sh = S(bank.bank_fft.shape, jnp.complex64)
-            i32 = S((), jnp.int32)
-            # accel_search_batch's chunk/row programs: full spectra
-            # argument + dynamic slice (the argument buffer is part
-            # of the gated footprint)
-            check("accel_chunk_z200", ak.accel_chunk_topk,
-                  spec_sh, bank_sh, i32, nrows=dmc, seg=bank.seg,
-                  step=bank.step, width=bank.width, nz=nz,
-                  max_numharm=16, topk=64)
-            check("accel_row_z200", ak.accel_row_topk,
-                  spec_sh, bank_sh, i32, seg=bank.seg,
-                  step=bank.step, width=bank.width, nz=nz,
-                  max_numharm=16, topk=64)
-        return _finish(failures, deferred)
-
-    print("rfi:", flush=True)
-    check("cell_stats_chan", rfi_k._cell_stats_chan, blk,
-          block_len=2048)
-    check("apply_mask_chan", rfi_k.apply_mask_chan,
-          blk, S((nblocks, NCHAN), jnp.bool_), S((NCHAN,), jnp.float32),
-          block_len=2048)
-
-    from tpulsar.search import executor as ex
-
-    # per-step geometry: (step, T_ds, ndms, pad_pairs, nfft, chunk).
-    # pad_pairs spans EVERY pass of the step: the pad bucket grows
-    # with the pass sub-DM, so a step's later passes use larger
-    # buckets than its first — gating only the first pass left most
-    # passes' block programs to compile in-line on the chip.
-    # --fast gates only the maximal-footprint entries.
-    geoms = []
-    for step in plan:
-        T_ds = nsamp // step.downsamp
-        pad_pairs = set()
-        ndms = step.dms_per_pass
-        for ppass in step.passes():
-            ch_sh, sub_sh = dd.plan_pass_shifts(
-                freqs, step.numsub, ppass.subdm, np.asarray(ppass.dms),
-                TSAMP, step.downsamp)
-            ndms = sub_sh.shape[0]
-            pad_pairs.add((dd._pad_bucket(int(ch_sh.max(initial=0))),
-                           dd._pad_bucket(int(sub_sh.max(initial=0)))))
-        nfft = ddplan.choose_n(T_ds)
-        # the executor's own chunk arithmetic (budget + even split),
-        # with run_hi_accel mirroring the measured run's accel setting
-        # — with the hi stage off it budgets a ~4/3 LARGER chunk, and
-        # the gate must compile that exact shape
-        chunk = ex.pass_chunk_size(
-            ndms=ndms, nfft=nfft,
-            params=ex.SearchParams(run_hi_accel=args.accel))
-        geoms.append((step, T_ds, ndms, pad_pairs, nfft, chunk))
-
-    if args.fast:
-        # ds=1 dominates every higher-downsamp variant of the block
-        # programs (same code, strictly larger shapes).  The
-        # sp/spectrum pair needs TWO argmaxes: sp_boxcars scales with
-        # chunk*T_ds but spectrum+whiten with chunk*nfft, and
-        # choose_n padding can make those maxima land on different
-        # steps — gate both (deduped) so neither program family can
-        # hide an ungated maximal footprint
-        block_geoms = [
-            (s, t, n, {max(pp)}, f, c)
-            for s, t, n, pp, f, c in geoms if s.downsamp == 1][:1]
-        sp_geoms = list({id(g): g for g in (
-            max(geoms, key=lambda g: g[5] * g[1]),    # chunk*T_ds
-            max(geoms, key=lambda g: g[5] * g[4]),    # chunk*nfft
-        )}.values())
-    else:
-        block_geoms = sp_geoms = geoms
-
-    for step, T_ds, ndms, pad_pairs, nfft, chunk in block_geoms:
-        print(f"step downsamp={step.downsamp} (T'={T_ds}, "
-              f"ndms={ndms}, pads={sorted(pad_pairs)}):", flush=True)
-        for pad1, pad2 in sorted(pad_pairs):
-            check(f"form_subbands ds={step.downsamp} pad={pad1}",
-                  dd._form_subbands_jit, blk, S((NCHAN,), jnp.int32),
-                  nsub=step.numsub, downsamp=step.downsamp, pad=pad1)
-            check(f"dedisperse_scan ds={step.downsamp} pad={pad2}",
-                  dd._dedisperse_subbands_scan,
-                  S((step.numsub, T_ds), jnp.float32),
-                  S((ndms, step.numsub), jnp.int32), pad=pad2)
-    _sp = ex.SearchParams(run_hi_accel=args.accel)
-    if args.accel:
-        from tpulsar.kernels import accel as ak
-        bank = ak.build_template_bank(float(_sp.hi_accel_zmax))
-        nz = len(bank.zs)
-        bank_sh = S(bank.bank_fft.shape, jnp.complex64)
-        i32 = S((), jnp.int32)
-    for step, T_ds, ndms, _pads, nfft, chunk in sp_geoms:
-        nbins = nfft // 2 + 1
-        # The executor's chunk loop (range(0, ndms, chunk)) produces
-        # TWO row counts per step when chunk doesn't divide
-        # dms_per_pass: the full chunk and the remainder — each a
-        # distinct compiled program for every stage.  The 03:49-style
-        # silent in-line compiles that survived the first direct-lower
-        # gate were exactly the remainder-shape programs.
-        sizes = [min(chunk, ndms)]
-        if chunk < ndms and ndms % chunk:
-            sizes.append(ndms % chunk)
-        for rows in sizes:
-            sers = S((rows, T_ds), jnp.float32)
-            tag = f"ds={step.downsamp} rows={rows}"
-            # estimator resolved exactly as the measured run resolves
-            # it (TPULSAR_SP_DETREND inherited by this subprocess).
-            # Each entry is the runtime's own jitted callable at the
-            # executor's exact shapes/statics — see check()'s
-            # docstring for why a wrapping lambda breaks the
-            # cache-warming property the campaign depends on.
-            check(f"sp_normalize {tag}",
-                  sp_k.normalize_series, sers,
-                  estimator=sp_k.detrend_estimator())
-            check(f"sp_boxcars {tag}",
-                  sp_k.boxcar_search,
-                  sers, tuple(_sp.sp_widths), sp_k.DEFAULT_TOPK)
-            # the fused pad->rfft->whiten->scale stage program, both
-            # with and without a zaplist keep-mask (search_beam always
-            # passes a zaplist; bench's search_block does not)
-            check(f"whitened_spectrum {tag}", fr.whitened_spectrum,
-                  sers, nfft=nfft)
-            check(f"whitened_spectrum_masked {tag}",
-                  fr.whitened_spectrum_masked,
-                  sers, S((nbins,), jnp.bool_), nfft=nfft)
-            check(f"lo_stages {tag}",
-                  fr.lo_stage_candidates,
-                  S((rows, nbins), jnp.complex64),
-                  tuple(fr.harmonic_stages(_sp.lo_accel_numharm)),
-                  _sp.topk_per_stage)
-            if args.accel:
-                # the hi stage runs at EVERY step geometry (the
-                # executor calls _hi_accel_pass inside the chunk loop
-                # of every pass), so each (rows, nbins) pair is its
-                # own program
-                dmc = min(rows, ak.plane_dm_chunk(nbins, nz))
-                spec_sh = S((rows, nbins), jnp.complex64)
-                check(f"accel_chunk {tag}",
-                      ak.accel_chunk_topk, spec_sh, bank_sh, i32,
-                      nrows=dmc, seg=bank.seg, step=bank.step,
-                      width=bank.width, nz=nz,
-                      max_numharm=_sp.hi_accel_numharm,
-                      topk=_sp.topk_per_stage)
-                check(f"accel_row {tag}",
-                      ak.accel_row_topk, spec_sh, bank_sh, i32,
-                      seg=bank.seg, step=bank.step, width=bank.width,
-                      nz=nz, max_numharm=_sp.hi_accel_numharm,
-                      topk=_sp.topk_per_stage)
-
-    # Refinement + fold prep: each fold-worthy candidate gets ONE
-    # full-resolution DM series (_dedisperse_single: single-DM
-    # subband + dedisperse at ds=1) and a rows=1 spectral family
-    # (refine_candidates) — distinct programs from the chunked pass
-    # shapes above.  The single-DM pad is a power-of-two bucket of
-    # the candidate DM's max shift, so sampling the survey DM range
-    # covers every bucket a real candidate can produce.
-    print("refinement/fold prep (single-DM, full resolution):",
-          flush=True)
-    nfft_full = ddplan.choose_n(nsamp)
-    nbins_full = nfft_full // 2 + 1
-    check("whitened_spectrum rows=1", fr.whitened_spectrum,
-          S((1, nsamp), jnp.float32), nfft=nfft_full)
-    check("whitened_spectrum_masked rows=1",
-          fr.whitened_spectrum_masked, S((1, nsamp), jnp.float32),
-          S((nbins_full,), jnp.bool_), nfft=nfft_full)
-    # refine_candidates' window gather: the one runtime device
-    # program that used to sit outside this gate (round-3 advisor
-    # finding).  Its (count, width) space is now closed — count is
-    # always refine._NWIN, width one of refine._WIDTH_BUCKETS — so
-    # gate every member against the full-resolution spectrum shape.
-    from tpulsar.search import refine as _refine
-    for w in _refine._WIDTH_BUCKETS:
-        check(f"refine_gather width={w}", _refine._gather_jit(),
-              S((nbins_full,), jnp.complex64),
-              S((_refine._NWIN,), jnp.int32), width=w)
-    # Dense sweep: pad buckets are powers of two, so the LOW buckets
-    # occupy DM intervals much narrower than a coarse sample spacing
-    # (the (256, 512) pair lives in DM ~15-31 alone) — 2048 samples
-    # bound the missable interval to ~0.5 DM, far below any bucket's
-    # width.
-    pads = set()
-    for dmval in np.linspace(0.0, plan[-1].hidm, 2048):
-        ch, sb = dd.plan_pass_shifts(freqs, 96, float(dmval),
-                                     [float(dmval)], TSAMP, 1)
-        pads.add((dd._pad_bucket(int(ch.max(initial=0))),
-                  dd._pad_bucket(int(sb.max(initial=0)))))
-    for p1, p2 in sorted(pads):
-        check(f"form_subbands 1dm pad={p1}", dd._form_subbands_jit,
-              blk, S((NCHAN,), jnp.int32), nsub=96, downsamp=1, pad=p1)
-        check(f"dedisperse_1dm pad={p2}", dd._dedisperse_subbands_scan,
-              S((96, nsamp), jnp.float32), S((1, 96), jnp.int32),
-              pad=p2)
-
-    return _finish(failures, deferred)
-
-
-def _finish(failures: list[str], deferred: list[str]) -> int:
-    if failures:
-        print(f"{len(failures)} FAILED: {', '.join(failures)}")
-        return 1
-    if deferred:
-        print(f"{len(deferred)} deferred past deadline: "
-              f"{', '.join(deferred)} — re-run to resume")
-        return 3
-    print("all programs compiled")
-    return 0
+    only = tuple(s for s in args.only.split(",") if s.strip())
+    return warmstart.run_gate(
+        scale=args.scale, accel=args.accel, config=args.config,
+        fast=args.fast, deadline=args.deadline, only=only,
+        verify=args.verify)
 
 
 if __name__ == "__main__":

@@ -25,8 +25,11 @@ import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(_REPO, ".jax_cache"))
+sys.path.insert(0, _REPO)
+
+from tpulsar.aot import cachedir  # noqa: E402  (stdlib-only)
+
+cachedir.activate()
 
 _PROBE = r"""
 import sys

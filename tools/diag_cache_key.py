@@ -1,23 +1,31 @@
 #!/usr/bin/env python
-"""Diagnose the gate-vs-child persistent-cache key mismatch ON TPU.
+"""Regression probe for the gate-vs-child persistent-cache key
+mismatch.
 
 Round-5 finding: the cfg1_full measured child spent 160.6 s of its
 176.5 s wall-clock recompiling `_form_subbands_jit` in-line even
 though the AOT gate had compiled the identical HLO minutes earlier
-(cache entries differ in hash AND size; CPU two-process repros HIT).
-This script runs both sides at a small scale on the real chip with
-the compilation-cache loggers at DEBUG so the two keys are printed
-and can be diffed.
+(cache entries differed in hash AND size; CPU two-process repros
+HIT).  Both sides now pull the program from the ONE registry
+(tpulsar/aot/registry.py) — the exact module-level jitted callable —
+so what this probes is the remaining surface: compile-options/config
+salt differences between a `.lower().compile()` gate and a plain
+dispatch.
 
-Usage (chip must be free — take the campaign lock first):
+Runs two subprocesses sharing one cache dir:
+  1. gate-style:  registry.jitted(...).lower(ShapeDtypeStruct...)
+                  .compile()   (exactly what tpulsar aot compile does)
+  2. bench-style: plain dispatch on real device arrays through the
+                  public wrapper (dd.form_subbands)
+with the compilation-cache loggers at DEBUG so the two keys are
+printed, then VERDICTS on the cache directory itself: if the
+bench-style side wrote any new `*-cache` entry for the subband
+program, its key missed the gate's entry — **exit 2** — so this runs
+as a regression gate (tiny scale, any backend), not a one-off
+log-diffing script.
+
+Usage (on TPU the chip must be free — take the campaign lock first):
     flock .campaign.lock python tools/diag_cache_key.py [--scale 0.02]
-
-Runs two subprocesses sharing JAX_COMPILATION_CACHE_DIR:
-  1. gate-style:  jit.lower(ShapeDtypeStruct...).compile()
-  2. bench-style: plain dispatch on real device arrays
-and prints each side's "Writing ... with key" / "cache hit" lines.
-A mismatch shows two different keys for byte-identical HLO — the
-delta must then be in the compile-options/config salt.
 """
 
 from __future__ import annotations
@@ -28,7 +36,13 @@ import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+sys.path.insert(0, _REPO)
+
+from tpulsar.aot import cachedir  # noqa: E402  (stdlib-only)
+
+# isolated by default so a diag run cannot pollute the campaign's
+# warm cache; TPULSAR_CACHE_DIR overrides through the one resolver
+os.environ.setdefault("TPULSAR_CACHE_DIR",
                       os.path.join(_REPO, ".jax_cache_diag"))
 
 _COMMON = r"""
@@ -37,11 +51,15 @@ sys.path.insert(0, %(repo)r)
 logging.basicConfig(level=logging.WARNING)
 for n in ("jax._src.compilation_cache", "jax._src.compiler"):
     logging.getLogger(n).setLevel(logging.DEBUG)
-import numpy as np, jax, jax.numpy as jnp
+from tpulsar.aot import cachedir, registry
+cachedir.activate()
+import numpy as np, jax
+import jax.numpy as jnp
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 from tpulsar.kernels import dedisperse as dd
-NCHAN, FCTR, BW, TSAMP = 960, 1375.5, 322.617, 65.476e-6
-T = int(%(scale)f * 3932160) // 2048 * 2048
+NCHAN, FCTR, BW = registry.NCHAN, registry.FCTR, registry.BW
+TSAMP = registry.TSAMP
+T = int(%(scale)f * registry.T_FULL) // 2048 * 2048
 freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
 dms = np.arange(128) * 2.0
 ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 96, 140.0, dms, TSAMP, 1)
@@ -51,7 +69,8 @@ print("dev:", jax.devices()[0], "T:", T, "pad1:", pad1)
 
 _GATE = _COMMON + r"""
 S = jax.ShapeDtypeStruct
-c = dd._form_subbands_jit.lower(
+fn = registry.jitted("dedisperse._form_subbands_jit")
+c = fn.lower(
     S((NCHAN, T), jnp.uint8), S((NCHAN,), jnp.int32),
     nsub=96, downsamp=1, pad=pad1).compile()
 print("GATE COMPILED")
@@ -77,6 +96,16 @@ def run(tag: str, src: str, timeout: float) -> None:
                                  "dev:", "Error", "error")):
             print("  " + ln[:300], flush=True)
     print(f"=== {tag} rc={res.returncode} ===", flush=True)
+    if res.returncode != 0:
+        raise SystemExit(f"{tag} subprocess failed (rc "
+                         f"{res.returncode})")
+
+
+def _subband_entries() -> frozenset[str]:
+    """The cache entries belonging to the subband program (the HLO
+    module name rides in the entry filename)."""
+    return frozenset(e for e in cachedir.cache_entries()
+                     if "form_subbands" in e)
 
 
 def main() -> int:
@@ -84,12 +113,33 @@ def main() -> int:
     ap.add_argument("--scale", type=float, default=0.02)
     ap.add_argument("--timeout", type=float, default=600.0)
     args = ap.parse_args()
+    cachedir.activate()
+    print(f"cache dir: {cachedir.resolve()}")
     sub = {"repo": _REPO, "scale": args.scale}
+
     run("gate-style", _GATE % sub, args.timeout)
+    after_gate = _subband_entries()
     run("bench-style", _BENCH % sub, args.timeout)
-    print("compare the two 'with key' lines above: same key = hit "
-          "(mismatch solved); different keys on identical HLO = "
-          "compile-options/config salt — diff the full DEBUG output.")
+    leaked = sorted(_subband_entries() - after_gate)
+
+    if not after_gate:
+        print("gate-style compile produced no form_subbands cache "
+              "entry — cache disabled? (inspect the DEBUG lines "
+              "above)")
+        return 1
+    if leaked:
+        print("KEY MISMATCH: the bench-style dispatch wrote "
+              f"{len(leaked)} new cache entr"
+              f"{'y' if len(leaked) == 1 else 'ies'} for the same "
+              "program the gate had already compiled:")
+        for e in leaked:
+            print(f"  {e}")
+        print("same registry callable + same shapes => the delta is "
+              "in the compile-options/config salt; diff the two "
+              "'with key' DEBUG lines above.")
+        return 2
+    print("cache keys MATCH: the bench-style dispatch was served "
+          "from the gate's cache entry (0 new entries).")
     return 0
 
 

@@ -1,0 +1,126 @@
+"""The ONE resolver for the persistent compilation-cache location.
+
+Before this module, four call sites set ``JAX_COMPILATION_CACHE_DIR``
+defaults independently — ``tools/aot_check.py`` and
+``tools/diag_accel_unimpl.py`` pinned ``<repo>/.jax_cache``,
+``tools/diag_cache_key.py`` pinned ``.jax_cache_diag``, and
+``tpulsar doctor`` fell back to ``~/.cache/tpulsar`` — so the gate
+could warm one cache while doctor inspected another.  Every layer now
+routes through :func:`resolve`:
+
+  1. ``TPULSAR_CACHE_DIR``            (canonical operator knob)
+  2. ``JAX_COMPILATION_CACHE_DIR``    (respected when already pinned,
+                                       e.g. by tpu_recovery_check.sh
+                                       or a test harness)
+  3. ``<repo>/.jax_cache``            (running from a checkout — what
+                                       the TPU campaign scripts warm)
+  4. ``~/.cache/tpulsar``             (installed package, no checkout)
+
+The same directory also holds the kernel smoke caches
+(``pallas_smoke_*.ok`` …) and the AOT warm-start manifest
+(``aot_manifest.json``), so "where does the cache live" has exactly
+one answer per process.
+
+stdlib-only: imported by bench.py's parent process and the CLI before
+(and instead of) any jax import.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+#: the AOT warm-start manifest filename inside the cache dir
+MANIFEST_NAME = "aot_manifest.json"
+
+
+def repo_root() -> str | None:
+    """The checkout root this package runs from, or None when tpulsar
+    is an installed package outside a checkout (detected by the
+    sibling ``tools/`` directory and ``bench.py``)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if (os.path.isdir(os.path.join(root, "tools"))
+            and os.path.isfile(os.path.join(root, "bench.py"))):
+        return root
+    return None
+
+
+def resolve() -> str:
+    """The persistent compilation-cache directory for this process
+    (not created; see :func:`ensured`)."""
+    for var in ("TPULSAR_CACHE_DIR", "JAX_COMPILATION_CACHE_DIR"):
+        val = os.environ.get(var, "").strip()
+        if val:
+            return os.path.abspath(os.path.expanduser(val))
+    root = repo_root()
+    if root is not None:
+        return os.path.join(root, ".jax_cache")
+    return os.path.join(os.path.expanduser("~"), ".cache", "tpulsar")
+
+
+def ensured() -> str:
+    """:func:`resolve`, with the directory created."""
+    d = resolve()
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def activate() -> str:
+    """Resolve the cache dir and export it to jax.
+
+    Sets ``JAX_COMPILATION_CACHE_DIR`` (overriding it when the
+    operator pinned ``TPULSAR_CACHE_DIR`` — the canonical knob wins)
+    and, when jax is already imported, pushes the path into the live
+    config too (the sitecustomize accelerator plugin can initialize
+    the backend before our env default lands)."""
+    d = ensured()
+    if os.environ.get("TPULSAR_CACHE_DIR", "").strip():
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = d
+    else:
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            jax.config.update("jax_compilation_cache_dir", d)
+            # jax's default 1 s floor silently excludes every
+            # fast-compiling program from the persistent cache — on
+            # the tunneled TPU runtime those same programs compile
+            # SLOWLY in-line, which is exactly the warm-start gap
+            # this subsystem closes.  Cache everything.
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            pass
+    return d
+
+
+def activate_if_configured() -> str | None:
+    """:func:`activate`, but only when the operator opted in by
+    setting ``TPULSAR_CACHE_DIR`` or ``JAX_COMPILATION_CACHE_DIR`` —
+    the library entry points (executor.search_beam) call this so the
+    canonical knob works end-to-end WITHOUT turning the persistent
+    cache on by default for every embedder."""
+    if (os.environ.get("TPULSAR_CACHE_DIR", "").strip()
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                              "").strip()):
+        return activate()
+    return None
+
+
+def manifest_path() -> str:
+    """Where the AOT warm-start manifest lives for this cache dir."""
+    return os.path.join(resolve(), MANIFEST_NAME)
+
+
+def cache_entries() -> frozenset[str]:
+    """The persistent-cache entry filenames currently on disk (the
+    ``*-cache`` payload files; ``-atime`` sidecars churn on every hit
+    and are excluded).  The warm-start manifest attributes entries to
+    programs by diffing this set around each compile."""
+    d = resolve()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return frozenset()
+    return frozenset(n for n in names if n.endswith("-cache"))

@@ -1,0 +1,701 @@
+"""Declarative registry of every jitted program in the pipeline.
+
+One table maps a program name to the EXACT module-level jitted
+callable the runtime invokes, and one set of shape-builders derives
+the canonical compile shapes from ``SearchParams``/``DDPlan``/scale.
+The AOT gate (tpulsar.aot.warmstart / tools/aot_check.py), the
+runtime, and the diagnostics (tools/diag_cache_key.py) all consume
+this table, so the gate-vs-child drift that cost the round-5 campaign
+a 160.6 s silent recompile cannot recur by omission: a jit site is
+either registered here or on the commented :data:`EXEMPT_SITES` list,
+and tests/test_aot.py walks the package ASTs to enforce exactly that.
+
+Why "the exact module-level callable" is load-bearing: a wrapping
+lambda lowers to a different HLO module name (``jit__lambda`` vs
+``jit_<fn>``), so its persistent-cache entry never serves the
+measured run — the round-3 pitfall that three modules used to dodge
+by hand-maintained convention (kernels/accel.py module-level jits,
+search/refine.py exposing ``_gather_jit``, tools/aot_check.py's
+``check()`` docstring).  The registry resolver returns the attribute
+itself, so there is no wrapper to get wrong.
+
+Import discipline: the table and its accessors are stdlib-only —
+``tpulsar aot ls`` and the completeness test run without jax.  The
+shape-builders (:func:`make_context`, :func:`gate_groups`) import
+numpy/jax/kernels lazily; they are only called by a process that is
+about to compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import os
+import sys
+import typing
+
+# ------------------------------------------------------------------
+# headline beam geometry (the survey's Mock beam — shared with
+# bench.py and previously re-declared by tools/aot_check.py)
+# ------------------------------------------------------------------
+NCHAN = 960
+TSAMP = 65.476e-6
+T_FULL = 3_932_160
+FCTR, BW = 1375.5, 322.617
+
+#: samples-per-scale quantum: nsamp is truncated to a multiple of
+#: this so every downsamp in the survey plan divides it
+NSAMP_QUANTUM = 30720
+
+
+def block_dtype_name() -> str:
+    """Validated TPULSAR_BENCH_DTYPE (no jax import — parents must be
+    able to fail fast on a misconfig without dialing the accelerator).
+    bench.py delegates here so the measured child, the focused
+    configs, and the AOT gate interpret the knob identically."""
+    val = os.environ.get("TPULSAR_BENCH_DTYPE", "uint8")
+    if val in ("uint8", "bfloat16"):
+        return val
+    raise SystemExit(
+        f"TPULSAR_BENCH_DTYPE must be uint8|bfloat16, got {val!r}")
+
+
+def block_dtype():
+    """The device block dtype as a jnp dtype (lazy jax import)."""
+    import jax.numpy as jnp
+
+    return (jnp.uint8 if block_dtype_name() == "uint8"
+            else jnp.bfloat16)
+
+
+# ------------------------------------------------------------------
+# the program table
+# ------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Program:
+    """One registered jitted program.
+
+    ``module``.``attr`` is the module-level jitted callable itself —
+    or, when ``factory`` is True, a zero-argument callable returning
+    it (search/refine.py builds its gather jit lazily so importing
+    the module stays jax-free).  ``site`` is the jit site this entry
+    covers, as ``<repo-relative-path>::<function-name>`` — the key the
+    AST completeness test matches on.  ``statics`` documents the
+    static-argument schema (names for keyword statics, positional
+    count otherwise)."""
+
+    name: str
+    module: str
+    attr: str
+    site: str
+    statics: tuple[str, ...] = ()
+    factory: bool = False
+    doc: str = ""
+
+
+def _k(mod: str, attr: str, statics: tuple[str, ...] = (),
+       doc: str = "", name_attr: str | None = None) -> Program:
+    """Kernel-module entry helper: name ``<mod>.<attr>``, site derived
+    from the module path."""
+    return Program(
+        name=f"{mod}.{name_attr or attr}",
+        module=f"tpulsar.kernels.{mod}",
+        attr=attr,
+        site=f"tpulsar/kernels/{mod}.py::{attr}",
+        statics=statics,
+        doc=doc,
+    )
+
+
+#: every registered program.  Grouped by module; the gate set (the
+#: programs with shape-builders in gate_groups) is a subset — the
+#: rest are registered for identity (diagnostics resolve the exact
+#: callable through here) and for the completeness test.
+PROGRAMS: tuple[Program, ...] = (
+    # ---- kernels/rfi.py
+    _k("rfi", "_cell_stats_chan", ("block_len", "chunk"),
+       doc="per-cell channel stats for the RFI mask"),
+    _k("rfi", "apply_mask_chan", ("block_len",),
+       doc="channelwise mask application at block granularity"),
+    _k("rfi", "apply_mask", ("block_len", "chunk"),
+       doc="whole-block mask application (chunked variant)"),
+    # ---- kernels/dedisperse.py
+    _k("dedisperse", "_shift_rows", ("pad",)),
+    _k("dedisperse", "_form_subbands_jit", ("nsub", "downsamp", "pad"),
+       doc="stage-1 subband formation — THE round-5 recompile victim"),
+    _k("dedisperse", "_dedisperse_subbands_scan", ("pad",),
+       doc="stage-2 XLA-scan dedispersion over DM trials"),
+    _k("dedisperse", "dedisperse_window_scan", ("out_len",)),
+    _k("dedisperse", "_dedisperse_tree", ("m", "pad1", "pad2")),
+    # ---- kernels/pallas_dd.py (engage behind their own smoke gates)
+    _k("pallas_dd", "_dedisperse_chunk",
+       ("block_t", "window", "interpret", "variant")),
+    _k("pallas_dd", "_pad_widen", ("pad",)),
+    _k("pallas_dd", "_form_subbands_block",
+       ("nsub", "block_t", "window", "interpret")),
+    # ---- kernels/fourier.py
+    _k("fourier", "pad_series", ("nfft",)),
+    _k("fourier", "complex_spectrum", ()),
+    _k("fourier", "power_spectrum", ()),
+    _k("fourier", "_whiten_powers_jit", ("edges", "estimator"),
+       doc="rednoise whitening; fourier.whiten_powers is the "
+           "resolving wrapper, not the program"),
+    _k("fourier", "whitened_spectrum", ("nfft",),
+       doc="fused pad->rfft->whiten->scale stage program"),
+    _k("fourier", "whitened_spectrum_masked", ("nfft",)),
+    _k("fourier", "interbin_powers", ()),
+    _k("fourier", "harmonic_sum", ("numharm",)),
+    _k("fourier", "blockmax_topk", ("topk", "block_r")),
+    _k("fourier", "stage_candidates", ("numharm", "topk")),
+    _k("fourier", "all_stage_candidates", ("stages", "topk")),
+    _k("fourier", "lo_stage_candidates", ("stages", "topk")),
+    # ---- kernels/singlepulse.py
+    _k("singlepulse", "normalize_series", ("detrend_block", "estimator")),
+    _k("singlepulse", "boxcar_search", ("widths", "topk")),
+    # ---- kernels/fold.py
+    _k("fold", "_fold_with_bins", ("nbin", "npart")),
+    _k("fold", "_shift_and_sum", ("nbin",)),
+    _k("fold", "_grid_chi2", ("nbin",)),
+    _k("fold", "_fold_subbands_with_bins", ("nbin", "npart", "nsub")),
+    _k("fold", "_dm_grid_chi2", ("nbin",)),
+    _k("fold", "_shift_sum_cube", ("nbin",)),
+    # ---- kernels/fold_batch.py
+    _k("fold_batch", "_fold_and_optimize_batch",
+       ("nbin", "npart", "L", "j0")),
+    # ---- kernels/accel.py
+    _k("accel", "_correlate_segments", ("seg", "step", "width")),
+    _k("accel", "_harmonic_sum_plane", ("numharm", "nz")),
+    _k("accel", "_accel_plane_topk",
+       ("seg", "step", "width", "nz", "max_numharm", "topk")),
+    _k("accel", "_correlate_block", ("seg", "step", "width", "nz")),
+    _k("accel", "_correlate_pieces", ("seg", "step", "width", "nz")),
+    _k("accel", "_accel_block_topk",
+       ("seg", "step", "width", "nz", "max_numharm", "topk")),
+    _k("accel", "accel_chunk_topk",
+       ("nrows", "seg", "step", "width", "nz", "max_numharm", "topk"),
+       doc="module-level jit on purpose: a wrapper lambda breaks the "
+           "persistent-cache key (see module docstring)"),
+    _k("accel", "accel_row_topk",
+       ("seg", "step", "width", "nz", "max_numharm", "topk")),
+    # ---- search/refine.py (lazy factory: the module imports jax-free)
+    Program(
+        name="refine.gather",
+        module="tpulsar.search.refine",
+        attr="_gather_jit",
+        site="tpulsar/search/refine.py::_gather_jit",
+        statics=("width",),
+        factory=True,
+        doc="refinement window gather; width from _WIDTH_BUCKETS, "
+            "count always _NWIN"),
+    # ---- bench.py (repo-root module): the beam synthesizer the
+    # measured run executes.  Outside the package AST walk, but the
+    # gate still compiles it through the registry so the synth
+    # program cannot drift either.
+    Program(
+        name="bench.gen_block_chunk",
+        module="bench",
+        attr="gen_block_chunk",
+        site="",
+        statics=("n", "nc", "dtype"),
+        doc="per-channel-chunk beam synthesizer (noise + injected "
+            "pulsar), jitted with the same statics bench.make_block "
+            "uses"),
+)
+
+
+#: jit sites that are deliberately NOT in the registry, with the
+#: reason.  Every entry here is a closure built at run time around a
+#: concrete device mesh (shard_map captures the Mesh object), so
+#: there is no module-level callable to register — these programs are
+#: exercised by the multichip rehearsal (MULTICHIP_*.json), not the
+#: single-chip AOT gate.  tests/test_aot.py fails if a new jit site
+#: is neither registered nor listed here.
+EXEMPT_SITES: dict[str, str] = {
+    "tpulsar/parallel/mesh.py::sharded_search_step":
+        "per-mesh shard_map closure (jit(step) captures the Mesh)",
+    "tpulsar/parallel/mesh.py::sharded_pass_fn":
+        "per-mesh shard_map closure over PassSpec",
+    "tpulsar/parallel/mesh.py::seq_dist_search":
+        "per-mesh single-pulse shard_map closure",
+    "tpulsar/parallel/seq_dedisperse.py::seq_dedisperse":
+        "per-mesh halo-exchange closure",
+    "tpulsar/parallel/dist_fft.py::_build_fft_fn":
+        "per-mesh distributed-FFT builder",
+    "tpulsar/parallel/dist_fft.py::_build_tail_fn":
+        "per-mesh distributed spectral-tail builder",
+}
+
+
+def programs() -> tuple[Program, ...]:
+    return PROGRAMS
+
+
+def get(name: str) -> Program:
+    for p in PROGRAMS:
+        if p.name == name:
+            return p
+    raise KeyError(f"no registered AOT program {name!r} "
+                   f"(tpulsar aot ls prints the registry)")
+
+
+def registered_sites() -> frozenset[str]:
+    return frozenset(p.site for p in PROGRAMS if p.site)
+
+
+def jitted(name: str):
+    """Resolve a registered program to its jitted callable — the very
+    object the runtime calls, never a wrapper (see module docstring
+    for why that identity is the whole point)."""
+    prog = get(name)
+    if prog.module == "bench":
+        return _bench_gen_jit()
+    mod = importlib.import_module(prog.module)
+    obj = getattr(mod, prog.attr)
+    if prog.factory:
+        obj = obj()
+    return obj
+
+
+def _bench_gen_jit():
+    """bench.gen_block_chunk jitted with the same statics
+    bench.make_block applies (bench lives at the repo root, not in
+    the package)."""
+    from functools import partial
+
+    import jax
+
+    from tpulsar.aot import cachedir
+
+    try:
+        import bench as bench_mod
+    except ImportError:
+        root = cachedir.repo_root()
+        if root is None:
+            raise
+        sys.path.insert(0, root)
+        import bench as bench_mod
+    return partial(jax.jit, static_argnames=("n", "nc", "dtype"))(
+        bench_mod.gen_block_chunk)
+
+
+# ------------------------------------------------------------------
+# shape-builders: canonical compile instances from SearchParams /
+# DDPlan / scale (ported verbatim from tools/aot_check.py, which is
+# now a thin wrapper over tpulsar.aot)
+# ------------------------------------------------------------------
+
+class Instance(typing.NamedTuple):
+    """One compile instance: a registered program plus the concrete
+    ShapeDtypeStructs/statics to lower it at.  ``label`` is the
+    display + manifest key (unique within a gate profile)."""
+
+    program: str
+    label: str
+    args: tuple
+    kwargs: dict
+
+
+@dataclasses.dataclass
+class GateContext:
+    """Derived geometry every shape-builder consumes."""
+
+    scale: float
+    accel: bool
+    nsamp: int
+    nblocks: int
+    freqs: "object"          # np.ndarray (lazy numpy)
+    plan: list
+    params: "object"         # executor.SearchParams
+    blk_dtype: "object"      # jnp dtype
+
+
+def make_context(scale: float = 1.0, accel: bool = False,
+                 plan_name: str = "pdev") -> GateContext:
+    import numpy as np
+
+    from tpulsar.plan import ddplan
+    from tpulsar.search import executor as ex
+
+    nsamp = int(T_FULL * scale)
+    nsamp -= nsamp % NSAMP_QUANTUM
+    freqs = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
+    return GateContext(
+        scale=scale, accel=accel, nsamp=nsamp,
+        nblocks=nsamp // 2048, freqs=freqs,
+        plan=ddplan.survey_plan(plan_name),
+        params=ex.SearchParams(run_hi_accel=accel),
+        blk_dtype=block_dtype(),
+    )
+
+
+def gate_groups(ctx: GateContext, config: int = 0,
+                fast: bool = False) -> list[tuple[str, list[Instance]]]:
+    """The gate program set as (group-header, instances) in compile
+    order.  ``config`` in (1, 3, 4) selects the focused bench
+    config's exact programs; otherwise the headline survey-plan set.
+    ``fast`` keeps only the maximal-footprint subset (bench.py's
+    pre-flight; see tools/aot_check.py --fast for the dominance
+    argument)."""
+    groups: list[tuple[str, list[Instance]]] = [
+        ("synth:", _synth_instances(ctx))]
+    if config in (1, 3, 4):
+        groups += _config_groups(ctx, config)
+    else:
+        groups += _headline_groups(ctx, fast=fast)
+    return groups
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _synth_instances(ctx: GateContext) -> list[Instance]:
+    import jax.numpy as jnp
+
+    return [Instance(
+        "bench.gen_block_chunk", "make_block_chunk",
+        (_sds((2,), jnp.uint32), _sds((120,), jnp.float32)),
+        dict(n=ctx.nsamp, nc=120, dtype=ctx.blk_dtype))]
+
+
+def _rfi_instances(ctx: GateContext) -> list[Instance]:
+    import jax.numpy as jnp
+
+    blk = _sds((NCHAN, ctx.nsamp), ctx.blk_dtype)
+    return [
+        Instance("rfi._cell_stats_chan", "cell_stats_chan",
+                 (blk,), dict(block_len=2048)),
+        Instance("rfi.apply_mask_chan", "apply_mask_chan",
+                 (blk, _sds((ctx.nblocks, NCHAN), jnp.bool_),
+                  _sds((NCHAN,), jnp.float32)),
+                 dict(block_len=2048)),
+    ]
+
+
+def _config_groups(ctx: GateContext,
+                   config: int) -> list[tuple[str, list[Instance]]]:
+    """Focused-config gate: the exact programs
+    bench.run_focused_config(cfg) will execute (one 128/32-trial pass
+    at ds=1 on the full-length block; the runtime dedisperse path is
+    the XLA scan — Pallas only engages behind its own smoke gate)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpulsar.kernels import dedisperse as dd
+    from tpulsar.kernels import fourier as fr
+    from tpulsar.kernels import singlepulse as sp_k
+
+    nsamp = ctx.nsamp
+    blk = _sds((NCHAN, nsamp), ctx.blk_dtype)
+    dms = np.arange(128) * 2.0
+    if config == 3:
+        dms = dms[:32]
+    ch_sh, sub_sh = dd.plan_pass_shifts(ctx.freqs, 96, 140.0, dms,
+                                        TSAMP, 1)
+    pad1 = dd._pad_bucket(int(ch_sh.max(initial=0)))
+    pad2 = dd._pad_bucket(int(sub_sh.max(initial=0)))
+    ndms = sub_sh.shape[0]
+
+    insts: list[Instance] = []
+    if config == 1:
+        insts += _rfi_instances(ctx)
+    insts += [
+        Instance("dedisperse._form_subbands_jit", "form_subbands",
+                 (blk, _sds((NCHAN,), jnp.int32)),
+                 dict(nsub=96, downsamp=1, pad=pad1)),
+        Instance("dedisperse._dedisperse_subbands_scan",
+                 "dedisperse_scan",
+                 (_sds((96, nsamp), jnp.float32),
+                  _sds((ndms, 96), jnp.int32)),
+                 dict(pad=pad2)),
+    ]
+    if config == 4:
+        # estimator resolved exactly as the measured run resolves it
+        # (TPULSAR_SP_DETREND is inherited by this subprocess) — a
+        # different estimator is a different static-arg program and
+        # must not reach the chip ungated
+        sers = _sds((ndms, nsamp), jnp.float32)
+        insts += [
+            Instance("singlepulse.normalize_series", "sp_normalize",
+                     (sers,),
+                     dict(estimator=sp_k.detrend_estimator())),
+            Instance("singlepulse.boxcar_search", "sp_boxcars",
+                     (sers,), {}),
+        ]
+    groups = [(f"config {config} (ndms={ndms}, T={nsamp}):", insts)]
+    if config == 3:
+        from tpulsar.kernels import accel as ak
+
+        nbins = nsamp // 2 + 1
+        sers = _sds((ndms, nsamp), jnp.float32)
+        pows = _sds((ndms, nbins), jnp.float32)
+        insts += [
+            Instance("fourier.complex_spectrum", "complex_spectrum",
+                     (sers,), {}),
+            # the exact jitted callable with the estimator resolved
+            # as the measured run resolves it
+            # (TPULSAR_WHITEN_ESTIMATOR is inherited by this
+            # subprocess) — fr.whiten_powers is the resolving
+            # wrapper, not the program
+            Instance("fourier._whiten_powers_jit", "whiten_powers",
+                     (pows,),
+                     dict(edges=tuple(int(e) for e in
+                                      fr._block_edges(nbins)),
+                          estimator=fr.whiten_estimator())),
+        ]
+        bank = ak.build_template_bank(200.0)
+        nz = len(bank.zs)
+        dmc = min(ndms, ak.plane_dm_chunk(nbins, nz))
+        spec_sh = _sds((ndms, nbins), jnp.complex64)
+        bank_sh = _sds(bank.bank_fft.shape, jnp.complex64)
+        i32 = _sds((), jnp.int32)
+        # accel_search_batch's chunk/row programs: full spectra
+        # argument + dynamic slice (the argument buffer is part of
+        # the gated footprint)
+        accel_insts = [
+            Instance("accel.accel_chunk_topk", "accel_chunk_z200",
+                     (spec_sh, bank_sh, i32),
+                     dict(nrows=dmc, seg=bank.seg, step=bank.step,
+                          width=bank.width, nz=nz, max_numharm=16,
+                          topk=64)),
+            Instance("accel.accel_row_topk", "accel_row_z200",
+                     (spec_sh, bank_sh, i32),
+                     dict(seg=bank.seg, step=bank.step,
+                          width=bank.width, nz=nz, max_numharm=16,
+                          topk=64)),
+        ]
+        groups.append((f"accel z200 (nz={nz}, nbins={nbins}, "
+                       f"dm_chunk={dmc}):", accel_insts))
+    return groups
+
+
+def step_geometries(ctx: GateContext) -> list[tuple]:
+    """Per-step geometry (step, T_ds, ndms, pad_pairs, nfft, chunk).
+
+    pad_pairs spans EVERY pass of the step: the pad bucket grows with
+    the pass sub-DM, so a step's later passes use larger buckets than
+    its first — gating only the first pass left most passes' block
+    programs to compile in-line on the chip.  ``chunk`` is the
+    executor's own arithmetic (budget + even split) via
+    executor.pass_chunk_size, mirroring the measured run's accel
+    setting — with the hi stage off it budgets a ~4/3 LARGER chunk,
+    and the gate must compile that exact shape."""
+    import numpy as np
+
+    from tpulsar.kernels import dedisperse as dd
+    from tpulsar.plan import ddplan
+    from tpulsar.search import executor as ex
+
+    geoms = []
+    for step in ctx.plan:
+        T_ds = ctx.nsamp // step.downsamp
+        pad_pairs = set()
+        ndms = step.dms_per_pass
+        for ppass in step.passes():
+            ch_sh, sub_sh = dd.plan_pass_shifts(
+                ctx.freqs, step.numsub, ppass.subdm,
+                np.asarray(ppass.dms), TSAMP, step.downsamp)
+            ndms = sub_sh.shape[0]
+            pad_pairs.add((dd._pad_bucket(int(ch_sh.max(initial=0))),
+                           dd._pad_bucket(int(sub_sh.max(initial=0)))))
+        nfft = ddplan.choose_n(T_ds)
+        chunk = ex.pass_chunk_size(ndms=ndms, nfft=nfft,
+                                   params=ctx.params)
+        geoms.append((step, T_ds, ndms, pad_pairs, nfft, chunk))
+    return geoms
+
+
+def _headline_groups(ctx: GateContext,
+                     fast: bool) -> list[tuple[str, list[Instance]]]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpulsar.kernels import dedisperse as dd
+    from tpulsar.kernels import fourier as fr
+    from tpulsar.kernels import singlepulse as sp_k
+    from tpulsar.plan import ddplan
+    from tpulsar.search import refine as _refine
+
+    _sp = ctx.params
+    blk = _sds((NCHAN, ctx.nsamp), ctx.blk_dtype)
+    groups: list[tuple[str, list[Instance]]] = [
+        ("rfi:", _rfi_instances(ctx))]
+
+    geoms = step_geometries(ctx)
+    if fast:
+        # ds=1 dominates every higher-downsamp variant of the block
+        # programs (same code, strictly larger shapes).  The
+        # sp/spectrum pair needs TWO argmaxes: sp_boxcars scales with
+        # chunk*T_ds but spectrum+whiten with chunk*nfft, and
+        # choose_n padding can make those maxima land on different
+        # steps — gate both (deduped) so neither program family can
+        # hide an ungated maximal footprint
+        block_geoms = [
+            (s, t, n, {max(pp)}, f, c)
+            for s, t, n, pp, f, c in geoms if s.downsamp == 1][:1]
+        sp_geoms = list({id(g): g for g in (
+            max(geoms, key=lambda g: g[5] * g[1]),    # chunk*T_ds
+            max(geoms, key=lambda g: g[5] * g[4]),    # chunk*nfft
+        )}.values())
+    else:
+        block_geoms = sp_geoms = geoms
+
+    for step, T_ds, ndms, pad_pairs, nfft, chunk in block_geoms:
+        insts = []
+        for pad1, pad2 in sorted(pad_pairs):
+            insts += [
+                Instance("dedisperse._form_subbands_jit",
+                         f"form_subbands ds={step.downsamp} pad={pad1}",
+                         (blk, _sds((NCHAN,), jnp.int32)),
+                         dict(nsub=step.numsub,
+                              downsamp=step.downsamp, pad=pad1)),
+                Instance("dedisperse._dedisperse_subbands_scan",
+                         f"dedisperse_scan ds={step.downsamp} "
+                         f"pad={pad2}",
+                         (_sds((step.numsub, T_ds), jnp.float32),
+                          _sds((ndms, step.numsub), jnp.int32)),
+                         dict(pad=pad2)),
+            ]
+        groups.append((f"step downsamp={step.downsamp} (T'={T_ds}, "
+                       f"ndms={ndms}, pads={sorted(pad_pairs)}):",
+                       insts))
+
+    if ctx.accel:
+        from tpulsar.kernels import accel as ak
+
+        bank = ak.build_template_bank(float(_sp.hi_accel_zmax))
+        nz = len(bank.zs)
+        bank_sh = _sds(bank.bank_fft.shape, jnp.complex64)
+        i32 = _sds((), jnp.int32)
+    for step, T_ds, ndms, _pads, nfft, chunk in sp_geoms:
+        nbins = nfft // 2 + 1
+        # The executor's chunk loop (range(0, ndms, chunk)) produces
+        # TWO row counts per step when chunk doesn't divide
+        # dms_per_pass: the full chunk and the remainder — each a
+        # distinct compiled program for every stage.  The 03:49-style
+        # silent in-line compiles that survived the first
+        # direct-lower gate were exactly the remainder-shape
+        # programs.
+        sizes = [min(chunk, ndms)]
+        if chunk < ndms and ndms % chunk:
+            sizes.append(ndms % chunk)
+        insts = []
+        for rows in sizes:
+            sers = _sds((rows, T_ds), jnp.float32)
+            tag = f"ds={step.downsamp} rows={rows}"
+            # estimator resolved exactly as the measured run
+            # resolves it (TPULSAR_SP_DETREND inherited by this
+            # subprocess)
+            insts += [
+                Instance("singlepulse.normalize_series",
+                         f"sp_normalize {tag}", (sers,),
+                         dict(estimator=sp_k.detrend_estimator())),
+                Instance("singlepulse.boxcar_search",
+                         f"sp_boxcars {tag}",
+                         (sers, tuple(_sp.sp_widths),
+                          sp_k.DEFAULT_TOPK), {}),
+                # the fused pad->rfft->whiten->scale stage program,
+                # both with and without a zaplist keep-mask
+                # (search_beam always passes a zaplist; bench's
+                # search_block does not)
+                Instance("fourier.whitened_spectrum",
+                         f"whitened_spectrum {tag}", (sers,),
+                         dict(nfft=nfft)),
+                Instance("fourier.whitened_spectrum_masked",
+                         f"whitened_spectrum_masked {tag}",
+                         (sers, _sds((nbins,), jnp.bool_)),
+                         dict(nfft=nfft)),
+                Instance("fourier.lo_stage_candidates",
+                         f"lo_stages {tag}",
+                         (_sds((rows, nbins), jnp.complex64),
+                          tuple(fr.harmonic_stages(
+                              _sp.lo_accel_numharm)),
+                          _sp.topk_per_stage), {}),
+            ]
+            if ctx.accel:
+                # the hi stage runs at EVERY step geometry (the
+                # executor calls _hi_accel_pass inside the chunk
+                # loop of every pass), so each (rows, nbins) pair is
+                # its own program
+                dmc = min(rows, ak.plane_dm_chunk(nbins, nz))
+                spec_sh = _sds((rows, nbins), jnp.complex64)
+                insts += [
+                    Instance("accel.accel_chunk_topk",
+                             f"accel_chunk {tag}",
+                             (spec_sh, bank_sh, i32),
+                             dict(nrows=dmc, seg=bank.seg,
+                                  step=bank.step, width=bank.width,
+                                  nz=nz,
+                                  max_numharm=_sp.hi_accel_numharm,
+                                  topk=_sp.topk_per_stage)),
+                    Instance("accel.accel_row_topk",
+                             f"accel_row {tag}",
+                             (spec_sh, bank_sh, i32),
+                             dict(seg=bank.seg, step=bank.step,
+                                  width=bank.width, nz=nz,
+                                  max_numharm=_sp.hi_accel_numharm,
+                                  topk=_sp.topk_per_stage)),
+                ]
+        groups.append(("", insts))
+
+    # Refinement + fold prep: each fold-worthy candidate gets ONE
+    # full-resolution DM series (_dedisperse_single: single-DM
+    # subband + dedisperse at ds=1) and a rows=1 spectral family
+    # (refine_candidates) — distinct programs from the chunked pass
+    # shapes above.
+    nfft_full = ddplan.choose_n(ctx.nsamp)
+    nbins_full = nfft_full // 2 + 1
+    insts = [
+        Instance("fourier.whitened_spectrum",
+                 "whitened_spectrum rows=1",
+                 (_sds((1, ctx.nsamp), jnp.float32),),
+                 dict(nfft=nfft_full)),
+        Instance("fourier.whitened_spectrum_masked",
+                 "whitened_spectrum_masked rows=1",
+                 (_sds((1, ctx.nsamp), jnp.float32),
+                  _sds((nbins_full,), jnp.bool_)),
+                 dict(nfft=nfft_full)),
+    ]
+    # refine_candidates' window gather: the one runtime device
+    # program that used to sit outside the gate (round-3 advisor
+    # finding).  Its (count, width) space is closed — count is
+    # always refine._NWIN, width one of refine._WIDTH_BUCKETS — so
+    # gate every member against the full-resolution spectrum shape.
+    for w in _refine._WIDTH_BUCKETS:
+        insts.append(Instance(
+            "refine.gather", f"refine_gather width={w}",
+            (_sds((nbins_full,), jnp.complex64),
+             _sds((_refine._NWIN,), jnp.int32)),
+            dict(width=w)))
+    groups.append(("refinement/fold prep (single-DM, full "
+                   "resolution):", insts))
+
+    # Dense sweep over the single-DM pad buckets: pad buckets are
+    # powers of two, so the LOW buckets occupy DM intervals much
+    # narrower than a coarse sample spacing (the (256, 512) pair
+    # lives in DM ~15-31 alone) — 2048 samples bound the missable
+    # interval to ~0.5 DM, far below any bucket's width.
+    pads = set()
+    for dmval in np.linspace(0.0, ctx.plan[-1].hidm, 2048):
+        ch, sb = dd.plan_pass_shifts(ctx.freqs, 96, float(dmval),
+                                     [float(dmval)], TSAMP, 1)
+        pads.add((dd._pad_bucket(int(ch.max(initial=0))),
+                  dd._pad_bucket(int(sb.max(initial=0)))))
+    insts = []
+    for p1, p2 in sorted(pads):
+        insts += [
+            Instance("dedisperse._form_subbands_jit",
+                     f"form_subbands 1dm pad={p1}",
+                     (blk, _sds((NCHAN,), jnp.int32)),
+                     dict(nsub=96, downsamp=1, pad=p1)),
+            Instance("dedisperse._dedisperse_subbands_scan",
+                     f"dedisperse_1dm pad={p2}",
+                     (_sds((96, ctx.nsamp), jnp.float32),
+                      _sds((1, 96), jnp.int32)),
+                     dict(pad=p2)),
+        ]
+    groups.append(("", insts))
+    return groups

@@ -1,0 +1,390 @@
+"""Persistent-cache warm-start: the gate driver, the compile
+manifest, and the runtime compile monitor.
+
+The gate (``run_gate``) lowers and compiles every registered program
+at its canonical shapes (tpulsar.aot.registry), WITHOUT executing
+anything on the device, and records in the **manifest**
+(``<cache_dir>/aot_manifest.json``) which persistent-cache entries
+each program produced plus a fingerprint of its compile signature.
+``run_gate(verify=True)`` replays the same set and reports a MISS for
+any program that had to write new cache entries — the round-5 failure
+mode (a child search spending 160.6 s of a 176.5 s wall-clock
+recompiling HLO the gate had already compiled) becomes a nonzero exit
+instead of a quietly slow bench number.
+
+Hit/miss accounting is a cache-directory file diff around each
+compile: a persistent-cache miss writes a new ``*-cache`` entry, a
+hit writes nothing (the ``-atime`` sidecars churn on hits and are
+ignored).  This observes the REAL cache behavior — key salts,
+compile-options drift, wrapper-lambda module renames all surface as
+misses — rather than re-deriving what the key ought to be.
+
+The **runtime monitor** (``install_runtime_monitor``) hooks
+jax.monitoring so every compilation-cache hit/miss and every backend
+compile anywhere in the process emits ``compile_cache_hit`` /
+``compile_cache_miss`` counters and a retroactive ``backend_compile``
+trace span through the PR-2 telemetry catalog.  The executor installs
+it at search start, so an in-line recompile inside a measured run
+shows up in the trace rollup (tools/trace_summarize.py) and the
+metrics export, attributed to the enclosing stage span.
+
+Exit-code contract (shared with tools/aot_check.py, whose callers
+loop on rc 3): 0 = every program compiled (and, with verify, zero
+misses); 1 = failures or manifest misses; 3 = the deadline elapsed
+with programs still pending — a clean between-compiles exit, re-run
+to resume from the warm cache.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+import traceback
+
+from tpulsar.aot import cachedir
+
+#: manifest schema tag (additive evolution, like bench/v2)
+MANIFEST_SCHEMA = "tpulsar-aot-manifest/v1"
+
+
+# ------------------------------------------------------------------
+# runtime compile monitor (jax.monitoring -> telemetry catalog)
+# ------------------------------------------------------------------
+
+_MONITOR_INSTALLED = False
+_PROGRAM_STACK: list[str] = []
+
+# per-thread outcome of the most recent persistent-cache lookup: jax
+# (0.4.x) records /jax/core/compile/backend_compile_duration around
+# the compile-OR-RETRIEVE step, so a cache hit also fires it — the
+# duration listener must not report a fast retrieval as a compile.
+# Events are sequential on the compiling thread (lookup outcome, then
+# duration), so remembering the last outcome is race-free.
+import threading as _threading
+
+_CACHE_STATE = _threading.local()
+
+
+def _program_label() -> str:
+    """The registered program currently being gated, or ``(inline)``
+    for compiles triggered by normal runtime dispatch."""
+    return _PROGRAM_STACK[-1] if _PROGRAM_STACK else "(inline)"
+
+
+@contextlib.contextmanager
+def _current_program(name: str):
+    _PROGRAM_STACK.append(name)
+    try:
+        yield
+    finally:
+        _PROGRAM_STACK.pop()
+
+
+def _on_event(name: str, **kw) -> None:
+    # listener runs inside jax's compile path: never raise
+    try:
+        from tpulsar.obs import telemetry, trace
+
+        if name == "/jax/compilation_cache/cache_hits":
+            _CACHE_STATE.last = "hit"
+            telemetry.compile_cache_hits_total().inc(
+                program=_program_label())
+        elif name == "/jax/compilation_cache/cache_misses":
+            _CACHE_STATE.last = "miss"
+            telemetry.compile_cache_misses_total().inc(
+                program=_program_label())
+            trace.instant("compile_cache_miss",
+                          program=_program_label())
+    except Exception:
+        pass
+
+
+def _on_duration(name: str, dur: float, **kw) -> None:
+    if name != "/jax/core/compile/backend_compile_duration":
+        return
+    try:
+        last, _CACHE_STATE.last = (getattr(_CACHE_STATE, "last",
+                                           None), None)
+        if last == "hit":
+            # persistent-cache retrieval, not a compile (see
+            # _CACHE_STATE comment) — the hit counter above already
+            # recorded it
+            return
+        from tpulsar.obs import telemetry, trace
+
+        telemetry.backend_compile_seconds().observe(
+            dur, program=_program_label())
+        trace.complete("backend_compile", dur,
+                       program=_program_label())
+    except Exception:
+        pass
+
+
+def install_runtime_monitor() -> bool:
+    """Register the jax.monitoring listeners (idempotent; listeners
+    cannot be unregistered through the public API, so exactly one set
+    is ever installed per process)."""
+    global _MONITOR_INSTALLED
+    if _MONITOR_INSTALLED:
+        return True
+    try:
+        import jax.monitoring as jmon
+    except Exception:
+        return False
+    jmon.register_event_listener(_on_event)
+    jmon.register_event_duration_secs_listener(_on_duration)
+    _MONITOR_INSTALLED = True
+    return True
+
+
+# ------------------------------------------------------------------
+# manifest
+# ------------------------------------------------------------------
+
+def _render_value(v) -> str:
+    """Stable text for one lower() argument: ShapeDtypeStructs render
+    as shape+dtype, statics as repr (all gate statics are ints/
+    strings/tuples/dtypes — no id()-bearing reprs)."""
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"sds{tuple(shape)}:{dtype}"
+    return repr(v)
+
+
+def fingerprint(inst) -> str:
+    """Compile-signature fingerprint of one registry Instance: the
+    program, its shapes/statics, and the jax/backend pair.  A verify
+    run whose fingerprint differs from the manifest's compiled a
+    DIFFERENT program under the same label — shape-builder or
+    environment drift — which is exactly the gate-vs-child bug class
+    this subsystem exists to catch."""
+    import hashlib
+
+    import jax
+
+    blob = "|".join(
+        [inst.program, inst.label]
+        + [_render_value(a) for a in inst.args]
+        + [f"{k}={_render_value(v)}"
+           for k, v in sorted(inst.kwargs.items())]
+        + [jax.__version__, jax.default_backend()],
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+def load_manifest(path: str | None = None) -> dict | None:
+    path = path or cachedir.manifest_path()
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if data.get("schema") != MANIFEST_SCHEMA:
+        return None
+    return data
+
+
+def _save_manifest(manifest: dict, path: str | None = None) -> str:
+    path = path or cachedir.manifest_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def _new_manifest(cache_dir: str) -> dict:
+    import jax
+
+    return {
+        "schema": MANIFEST_SCHEMA,
+        "created": time.time(),
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "cache_dir": cache_dir,
+        "programs": {},
+    }
+
+
+# ------------------------------------------------------------------
+# the gate driver
+# ------------------------------------------------------------------
+
+def _mem_stats(compiled) -> str:
+    try:
+        an = compiled.memory_analysis()
+        tot = (an.temp_size_in_bytes + an.argument_size_in_bytes
+               + an.output_size_in_bytes)
+        return (f"temp {an.temp_size_in_bytes / 2**30:.2f} GiB, "
+                f"args {an.argument_size_in_bytes / 2**30:.2f} GiB, "
+                f"out {an.output_size_in_bytes / 2**30:.2f} GiB, "
+                f"total {tot / 2**30:.2f} GiB")
+    except Exception:
+        return "(memory analysis unavailable)"
+
+
+def _selected(inst, only: tuple[str, ...]) -> bool:
+    if not only:
+        return True
+    return any(pat in inst.program or pat in inst.label
+               for pat in only)
+
+
+def run_gate(scale: float = 1.0, accel: bool = False, config: int = 0,
+             fast: bool = False, deadline: float = 0.0,
+             only: tuple[str, ...] = (), verify: bool = False,
+             echo=print) -> int:
+    """Compile (or verify) the registered gate program set.  See the
+    module docstring for the exit-code contract."""
+    t0 = time.monotonic()
+    cache_dir = cachedir.activate()
+
+    import jax
+
+    import tpulsar
+    from tpulsar.obs import trace
+
+    tpulsar.apply_platform_env()
+    # tiny-scale CPU gates finish in <1 s per program; without this
+    # the persistent cache skips them and verify can never hit
+    try:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+    install_runtime_monitor()
+    if trace.enabled():
+        # scope the gate's aot_compile spans to THIS run, like
+        # search_beam does per beam; saved below next to the manifest
+        trace.start(clear=True)
+    echo(f"device: {jax.devices()[0]}")
+
+    from tpulsar.aot import registry
+
+    ctx = registry.make_context(scale=scale, accel=accel)
+    groups = registry.gate_groups(ctx, config=config, fast=fast)
+
+    manifest = load_manifest()
+    if verify and manifest is None:
+        echo(f"no manifest at {cachedir.manifest_path()} — run "
+             "`tpulsar aot compile` (or tools/aot_check.py) first")
+        return 1
+    if manifest is None or manifest.get("cache_dir") != cache_dir:
+        manifest = _new_manifest(cache_dir)
+    manifest["updated"] = time.time()
+    manifest["profile"] = {"scale": scale, "accel": accel,
+                           "config": config, "fast": fast}
+
+    failures: list[str] = []
+    deferred: list[str] = []
+    n_hit = n_miss = n_total = 0
+
+    for header, insts in groups:
+        insts = [i for i in insts if _selected(i, only)]
+        if not insts:
+            continue
+        if header:
+            echo(header)
+        for inst in insts:
+            if deadline and time.monotonic() - t0 > deadline:
+                deferred.append(inst.label)
+                echo(f"  [defer] {inst.label}: deadline reached; "
+                     "re-run to resume from the warm cache")
+                continue
+            n_total += 1
+            try:
+                fn = registry.jitted(inst.program)
+                before = cachedir.cache_entries()
+                with _current_program(inst.program), \
+                        trace.span("aot_compile",
+                                   program=inst.program,
+                                   label=inst.label):
+                    t1 = time.monotonic()
+                    compiled = fn.lower(*inst.args,
+                                        **inst.kwargs).compile()
+                    dt = time.monotonic() - t1
+            except Exception as e:
+                failures.append(inst.label)
+                msg = str(e).splitlines()
+                echo(f"  [FAIL] {inst.label}: "
+                     f"{msg[0] if msg else e!r}")
+                if os.environ.get("AOT_CHECK_VERBOSE"):
+                    traceback.print_exc()
+                continue
+            new_entries = sorted(cachedir.cache_entries() - before)
+            fp = fingerprint(inst)
+            rec = manifest["programs"].get(inst.label)
+            if verify:
+                if new_entries:
+                    n_miss += 1
+                    echo(f"  [MISS] {inst.label}: recompiled "
+                         f"({len(new_entries)} new cache entries, "
+                         f"{dt:.1f} s)")
+                elif rec is None:
+                    n_miss += 1
+                    echo(f"  [MISS] {inst.label}: cache hit but not "
+                         "in the manifest (gate never compiled it)")
+                elif rec.get("fingerprint") != fp:
+                    n_miss += 1
+                    echo(f"  [MISS] {inst.label}: compile signature "
+                         "drifted since the manifest was written")
+                else:
+                    n_hit += 1
+                    echo(f"  [hit] {inst.label}")
+            else:
+                if not new_entries and rec is not None:
+                    # warm resume: keep the original entry
+                    # attribution, refresh the fingerprint
+                    rec["fingerprint"] = fp
+                    n_hit += 1
+                else:
+                    manifest["programs"][inst.label] = {
+                        "program": inst.program,
+                        "fingerprint": fp,
+                        "entries": new_entries,
+                        "compile_s": round(dt, 3),
+                    }
+                    if new_entries:
+                        n_miss += 1
+                    else:
+                        n_hit += 1
+                echo(f"  [ok] {inst.label}: {_mem_stats(compiled)}")
+
+    if not verify:
+        _save_manifest(manifest)
+    if trace.enabled():
+        # *_trace.json suffix so find_trace_file / `tpulsar trace`
+        # pick it up; trace_summarize's compile rollup then shows
+        # per-program gate compile times
+        echo("trace: " + trace.save(
+            os.path.join(cache_dir, "aot_gate_trace.json")))
+    if n_total == 0 and not deferred and not failures:
+        # an --only pattern that matches nothing must not green-light
+        # an unverified cache (rc-0 here defeats the whole contract)
+        echo("no gate programs matched"
+             + (f" --only {','.join(only)}" if only else ""))
+        return 1
+    return _finish(failures, deferred, echo=echo, verify=verify,
+                   n_hit=n_hit, n_miss=n_miss, n_total=n_total)
+
+
+def _finish(failures: list[str], deferred: list[str], echo=print,
+            verify: bool = False, n_hit: int = 0, n_miss: int = 0,
+            n_total: int = 0) -> int:
+    if failures:
+        echo(f"{len(failures)} FAILED: {', '.join(failures)}")
+        return 1
+    if deferred:
+        echo(f"{len(deferred)} deferred past deadline: "
+             f"{', '.join(deferred)} — re-run to resume")
+        return 3
+    if verify:
+        echo(f"manifest verify: {n_hit}/{n_total} hits, "
+             f"{n_miss} misses")
+        return 0 if n_miss == 0 else 1
+    echo("all programs compiled")
+    return 0

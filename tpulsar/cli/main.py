@@ -568,6 +568,49 @@ def cmd_search(args):
     return search_job.main(argv)
 
 
+def cmd_aot(args):
+    """The AOT compile layer's operator surface (tpulsar/aot/):
+
+      compile — gate the registered program set into the persistent
+                cache and write the warm-start manifest
+      verify  — replay the set against the manifest; exit 1 if any
+                program would recompile in-line (cache miss)
+      ls      — print the program registry + exemption list
+
+    compile/verify share tools/aot_check.py's machinery and rc
+    contract (0 ok / 1 failures-or-misses / 3 deadline deferral)."""
+    from tpulsar.aot import cachedir, registry, warmstart
+
+    if args.aot_cmd == "ls":
+        print(f"cache dir: {cachedir.resolve()}")
+        manifest = warmstart.load_manifest()
+        manifested = (set(manifest["programs"][k]["program"]
+                          for k in manifest["programs"])
+                      if manifest else set())
+        print(f"manifest:  {cachedir.manifest_path()}"
+              + ("" if manifest else " (absent)"))
+        print(f"{len(registry.PROGRAMS)} registered programs:")
+        for prog in registry.PROGRAMS:
+            mark = "*" if prog.name in manifested else " "
+            statics = (f" statics=({', '.join(prog.statics)})"
+                       if prog.statics else "")
+            print(f"  {mark} {prog.name:36s} "
+                  f"{prog.module}.{prog.attr}{statics}")
+        if manifest:
+            print("  (* = in the warm-start manifest)")
+        print(f"{len(registry.EXEMPT_SITES)} exempt jit sites "
+              "(per-mesh closures, multichip-rehearsal gated):")
+        for site, why in sorted(registry.EXEMPT_SITES.items()):
+            print(f"    {site}: {why}")
+        return 0
+
+    only = tuple(s for s in args.only.split(",") if s.strip())
+    return warmstart.run_gate(
+        scale=args.scale, accel=args.accel, config=args.aot_config,
+        fast=args.fast, deadline=args.deadline, only=only,
+        verify=args.aot_cmd == "verify")
+
+
 def cmd_doctor(args):
     """Environment probe: the reference's install_test.py dependency
     check and test_job.py worker-node probe (imports, directories
@@ -720,9 +763,15 @@ def cmd_doctor(args):
     print("fallback paths (smoke caches + env pins):")
     import glob
 
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "tpulsar"))
+    # the same resolver the tools and kernels use
+    # (tpulsar.aot.cachedir) — doctor and the gate can no longer
+    # disagree about where the cache lives
+    from tpulsar.aot import cachedir as aot_cachedir
+
+    cache_dir = aot_cachedir.resolve()
+    print(f"  [dir] compilation cache: {cache_dir}"
+          + (" (exists)" if os.path.isdir(cache_dir)
+             else " (not created yet)"))
     for label, pat in [("pallas dedisperse", "pallas_smoke_*.ok"),
                        ("pallas subbands", "pallas_sb_smoke_*.ok"),
                        ("batched accel", "accel_batch_*.ok")]:
@@ -854,6 +903,40 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--device-timeout", type=float, default=60.0,
                     help="accelerator probe timeout, seconds")
     sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser(
+        "aot",
+        help="AOT compile layer: gate the registered programs into "
+             "the persistent cache (compile), check warm-start "
+             "against the manifest (verify), or list the registry "
+             "(ls)")
+    asub = sp.add_subparsers(dest="aot_cmd", required=True)
+    for name, hlp in (
+            ("compile", "compile the gate set + write the manifest"),
+            ("verify", "replay the gate set; exit 1 on any "
+                       "persistent-cache miss")):
+        ap = asub.add_parser(name, help=hlp)
+        ap.add_argument("--scale", type=float, default=1.0)
+        ap.add_argument("--accel", action="store_true",
+                        help="include the hi-accel correlation block")
+        ap.add_argument("--config", type=int, default=0,
+                        dest="aot_config",
+                        help="focused bench config (1/3/4) instead "
+                             "of the headline survey-plan set")
+        ap.add_argument("--fast", action="store_true",
+                        help="maximal-footprint subset only (see "
+                             "tools/aot_check.py --fast)")
+        ap.add_argument("--deadline", type=float, default=0.0,
+                        help="soft budget, checked between compiles; "
+                             "rc 3 defers the tail (re-run resumes "
+                             "from the warm cache)")
+        ap.add_argument("--only", default="",
+                        help="comma-separated program/label "
+                             "substrings to gate")
+        ap.set_defaults(fn=cmd_aot)
+    ap = asub.add_parser("ls", help="list the program registry, "
+                                    "exemptions, and manifest state")
+    ap.set_defaults(fn=cmd_aot)
     return p
 
 

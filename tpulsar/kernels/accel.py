@@ -570,11 +570,11 @@ print("ACCEL_BATCH_OK", jax.default_backend())
 
 
 def _smoke_cache_path() -> str:
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "tpulsar"))
-    os.makedirs(cache_dir, exist_ok=True)
-    return os.path.join(cache_dir, f"accel_batch_{jax.__version__}.ok")
+    # same resolver as the AOT gate and doctor (tpulsar.aot.cachedir)
+    from tpulsar.aot import cachedir
+
+    return os.path.join(cachedir.ensured(),
+                        f"accel_batch_{jax.__version__}.ok")
 
 
 def _batch_path_usable() -> bool:

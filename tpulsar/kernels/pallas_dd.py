@@ -434,14 +434,15 @@ def forced() -> bool:
 
 
 def _smoke_cache_path() -> str:
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "tpulsar"))
-    os.makedirs(cache_dir, exist_ok=True)
+    # same resolver as the AOT gate and doctor (tpulsar.aot.cachedir)
+    # so the smoke caches live next to the compilation cache they
+    # validate
+    from tpulsar.aot import cachedir
+
     # variant-keyed: a cached pass for the roll kernel must never
     # validate the slice kernel (or vice versa)
     return os.path.join(
-        cache_dir,
+        cachedir.ensured(),
         f"pallas_smoke_{jax.__version__}_{kernel_variant()}.ok")
 
 
@@ -573,11 +574,9 @@ print("PALLAS_SB_SMOKE_OK")
 
 
 def _sb_smoke_cache_path() -> str:
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "tpulsar"))
-    os.makedirs(cache_dir, exist_ok=True)
-    return os.path.join(cache_dir,
+    from tpulsar.aot import cachedir
+
+    return os.path.join(cachedir.ensured(),
                         f"pallas_sb_smoke_{jax.__version__}.ok")
 
 

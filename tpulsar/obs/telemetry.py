@@ -138,6 +138,40 @@ def heartbeats_total() -> metrics.Counter:
         labelnames=("event",))
 
 
+#: histogram buckets for XLA backend-compile time: sub-second CPU
+#: compiles up to the multi-minute whole-beam TPU programs (the
+#: round-5 silent recompile burned 160.6 s — squarely mid-range)
+COMPILE_BUCKETS = (0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 180.0, 600.0,
+                   1800.0)
+
+
+def compile_cache_hits_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_compile_cache_hits_total",
+        "persistent compilation-cache hits (one per XLA module served "
+        "from the cache dir); program = the registered AOT program "
+        "being gated, or (inline) for runtime dispatch compiles",
+        labelnames=("program",))
+
+
+def compile_cache_misses_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_compile_cache_misses_total",
+        "persistent compilation-cache misses — an (inline) miss "
+        "during a measured run is a silent recompile the AOT gate "
+        "should have absorbed (tpulsar aot verify localizes it)",
+        labelnames=("program",))
+
+
+def backend_compile_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_backend_compile_seconds",
+        "XLA backend compile time per module (cache hits skip the "
+        "backend compile entirely, so every observation here is a "
+        "real compile)",
+        labelnames=("program",), buckets=COMPILE_BUCKETS)
+
+
 # --------------------------------------------------------------------
 # the shared heartbeat/progress event shape
 # --------------------------------------------------------------------

@@ -148,6 +148,31 @@ def span(name: str, **attrs):
         })
 
 
+def complete(name: str, dur_s: float, **attrs) -> None:
+    """Retroactive completed span ending NOW with the given duration.
+
+    For durations learned after the fact — jax.monitoring reports a
+    backend compile's seconds only once it finishes, so the AOT
+    runtime monitor cannot wrap it in ``span``.  The event still
+    lands on the caller's thread track with the enclosing span noted
+    in args, so Perfetto shows the compile inside the stage that
+    triggered it."""
+    if not enabled():
+        return
+    t_end = time.time()
+    args = dict(attrs)
+    parent = current_span()
+    if parent:
+        args["parent"] = parent
+    _append({
+        "name": name, "cat": "tpulsar", "ph": "X",
+        "ts": round((t_end - dur_s - _T0) * 1e6, 1),
+        "dur": round(dur_s * 1e6, 1),
+        "pid": os.getpid(), "tid": threading.get_ident(),
+        "args": args,
+    })
+
+
 def instant(name: str, **attrs) -> None:
     """Zero-duration marker (circuit transitions, rescue decisions):
     shows as a tick on the Perfetto track."""
@@ -225,24 +250,31 @@ def find_trace_file(path: str) -> str:
     return hits[-1]
 
 
-def summarize_file(trace_path: str) -> dict:
-    """Rollup summary of a saved trace file: {trace_file, rollup,
+def summarize_events(trace_events: list, trace_file: str = "") -> dict:
+    """Rollup summary of a traceEvents list: {trace_file, rollup,
     root_seconds, n_events}.  The one implementation behind both
     `tpulsar trace` and tools/trace_summarize.py — root_seconds is
     the search_block span when present, else the total of top-level
-    (depth-0) spans."""
-    with open(trace_path) as fh:
-        obj = json.load(fh)
-    trace_events = obj.get("traceEvents", [])
+    (depth-0) spans.  Split from summarize_file so a caller that
+    already parsed the JSON (trace_summarize's compile rollup shares
+    the same load) doesn't parse it twice."""
     roll = rollup(trace_events)
     root_s = roll.get("search_block", {}).get("seconds", 0.0)
     if not root_s:
         root_s = sum(e.get("dur", 0.0) / 1e6 for e in trace_events
                      if e.get("ph") == "X"
                      and e.get("args", {}).get("depth") == 0)
-    return {"trace_file": trace_path, "rollup": roll,
+    return {"trace_file": trace_file, "rollup": roll,
             "root_seconds": round(root_s, 3),
             "n_events": len(trace_events)}
+
+
+def summarize_file(trace_path: str) -> dict:
+    """summarize_events over a saved trace file."""
+    with open(trace_path) as fh:
+        obj = json.load(fh)
+    return summarize_events(obj.get("traceEvents", []),
+                            trace_file=trace_path)
 
 
 def render_summary(summary: dict) -> str:

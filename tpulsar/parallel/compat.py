@@ -1,0 +1,28 @@
+"""jax version compatibility for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map`` (and renamed ``check_rep`` to ``check_vma``) across
+the jax versions this pipeline meets in the wild; the container's
+0.4.x only has the experimental spelling.  One resolver keeps the
+call sites on the modern keyword API while running on either."""
+
+from __future__ import annotations
+
+_RESOLVED: tuple | None = None
+
+
+def shard_map(f, **kw):
+    """jax's shard_map, whichever spelling this jax provides, with
+    modern ``check_vma`` translated to legacy ``check_rep``."""
+    global _RESOLVED
+    if _RESOLVED is None:
+        try:
+            from jax import shard_map as sm
+            _RESOLVED = (sm, "check_vma")
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as sm
+            _RESOLVED = (sm, "check_rep")
+    sm, check_kw = _RESOLVED
+    if check_kw == "check_rep" and "check_vma" in kw:
+        kw["check_rep"] = kw.pop("check_vma")
+    return sm(f, **kw)

@@ -111,6 +111,11 @@ def sharded_search_step(mesh: Mesh, spec: SearchStepSpec):
     Sharding: beams over the `beam` axis, DM trials over `dm`; output
     candidate blocks are all_gathered over `dm` so every host sees the
     full candidate set.
+
+    AOT note: this module's jit sites are per-mesh shard_map closures
+    (the jit captures the live Mesh), so they cannot be registered in
+    tpulsar/aot/registry.py — they are on its EXEMPT_SITES list and
+    validated by the multichip rehearsal, not the single-chip gate.
     """
 
     def step(subbands, sub_shifts, keep_mask):
@@ -123,7 +128,7 @@ def sharded_search_step(mesh: Mesh, spec: SearchStepSpec):
                         jax.lax.all_gather(b, "dm", axis=0, tiled=True)[None])
                     for h, (v, b) in res.items()}
 
-        from jax import shard_map
+        from tpulsar.parallel.compat import shard_map
         return shard_map(
             per_shard, mesh=mesh,
             in_specs=(P("beam", None, None), P("beam", "dm", None), P()),
@@ -228,7 +233,7 @@ def sharded_pass_fn(mesh: Mesh, spec: PassSpec):
     reference's embarrassingly-parallel per-DM loop
     (PALFA2_presto_search.py:532-594, SURVEY.md section 2.4).
     """
-    from jax import shard_map
+    from tpulsar.parallel.compat import shard_map
 
     from tpulsar.kernels import accel as ak
     from tpulsar.kernels import fourier as fr
@@ -383,7 +388,7 @@ def seq_dist_search(mesh: Mesh, subbands, sub_shifts, dms, dt_ds: float,
         return (jax.lax.all_gather(snr, axis_name, axis=2, tiled=True),
                 jax.lax.all_gather(idx, axis_name, axis=2, tiled=True))
 
-    from jax import shard_map
+    from tpulsar.parallel.compat import shard_map
     sp_fn = jax.jit(shard_map(
         sp_body, mesh=mesh, in_specs=P(None, axis_name),
         out_specs=(P(), P()), check_vma=False))

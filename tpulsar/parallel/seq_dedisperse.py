@@ -21,7 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from tpulsar.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

@@ -207,6 +207,16 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     # already, so this is the earliest library point where the pin
     # can still take effect.
     tpulsar.apply_platform_env()
+    # every in-line XLA compile during this search emits
+    # compile_cache_hit/miss counters and a backend_compile trace
+    # event — a recompile the AOT gate should have absorbed can no
+    # longer hide inside a stage timing (round-5: 160.6 s of a
+    # 176.5 s child spent recompiling gated HLO, invisibly)
+    from tpulsar.aot import cachedir as _cachedir
+    from tpulsar.aot import warmstart as _warmstart
+
+    _cachedir.activate_if_configured()
+    _warmstart.install_runtime_monitor()
     params = params or SearchParams()
     if trace_mod.enabled():
         # one trace file per beam: clear events at beam start so the
@@ -776,9 +786,15 @@ def pass_chunk_size(ndms: int, nfft: int, params: SearchParams) -> int:
 
 
 class _BoundedCache:
-    """Tiny FIFO-bounded memo for per-DM device arrays (a long
+    """Tiny LRU-bounded memo for per-DM device arrays (a long
     beam's full-resolution series is too big to keep one per
-    candidate DM)."""
+    candidate DM).
+
+    LRU, not FIFO: refinement revisits the handful of hottest DM
+    values as same-DM candidates interleave in the sigma ordering, so
+    FIFO evicted exactly the series about to be re-requested.  A hit
+    re-inserts the key (dicts iterate in insertion order, so the
+    first key is always the least recently USED, not the oldest)."""
 
     def __init__(self, fn, capacity: int = 4):
         self._fn = fn
@@ -786,7 +802,9 @@ class _BoundedCache:
         self._d: dict = {}
 
     def __call__(self, key):
-        if key not in self._d:
+        if key in self._d:
+            self._d[key] = self._d.pop(key)     # touch: move to MRU
+        else:
             while len(self._d) >= self._cap:
                 self._d.pop(next(iter(self._d)))
             self._d[key] = self._fn(key)

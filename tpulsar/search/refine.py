@@ -160,10 +160,12 @@ def _width_bucket(span: int) -> int:
 
 
 def _gather_jit():
-    """The (lazily created) jitted window gather, exposed so
-    tools/aot_check.py can lower the exact runtime callable (the
-    lambda-wrapping pitfall of round 3 produced different persistent-
-    cache keys than the runtime's own calls)."""
+    """The (lazily created) jitted window gather, registered as
+    ``refine.gather`` in tpulsar/aot/registry.py so the AOT gate
+    lowers the exact runtime callable (the lambda-wrapping pitfall of
+    round 3 produced different persistent-cache keys than the
+    runtime's own calls).  Lazy factory because this module must
+    import jax-free."""
     import jax
     import jax.numpy as jnp
 
