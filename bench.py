@@ -943,6 +943,155 @@ def run_aot_gate(timeout: float, accel: bool, scale: float,
     return rec
 
 
+# --------------------------------------------------------------- serve bench
+
+def run_serve() -> None:
+    """``bench.py --serve``: push N synthetic beams through ONE
+    resident server (tpulsar/serve/) and report cold-first-beam vs
+    warm-steady-state per-beam wall time — the number that justifies
+    the warm-worker subsystem (PR 3 measured 160 s of a 176 s cold
+    child spent off the hot path; residency pays it once).
+
+    Also times one real process-per-beam child on the same beam with its
+    own cold cache (``TPULSAR_SERVE_COLD=0`` skips it) so the serve
+    payload carries the deployment-shaped comparison, not only the
+    within-server contrast.  Emits one bench/v2 record with an
+    additive ``serve`` key."""
+    import shutil
+    import statistics
+    import subprocess
+    import tempfile
+
+    from tpulsar.config import TpulsarConfig, set_settings
+    from tpulsar.io import synth
+    from tpulsar.serve import protocol
+    from tpulsar.serve.server import SearchServer
+
+    nbeams = int(os.environ.get("TPULSAR_SERVE_NBEAMS", "3"))
+    nchan = int(os.environ.get("TPULSAR_SERVE_NCHAN", "32"))
+    nsamp = int(os.environ.get("TPULSAR_SERVE_NSAMP", str(1 << 13)))
+    dm_max = float(os.environ.get("TPULSAR_SERVE_DM_MAX", "60"))
+    accel = os.environ.get("TPULSAR_SERVE_ACCEL", "0") == "1"
+    base = tempfile.mkdtemp(prefix="tpulsar_servebench_")
+
+    cfg = TpulsarConfig()
+    cfg.basic.log_dir = os.path.join(base, "logs")
+    cfg.background.jobtracker_db = os.path.join(base, "jt.db")
+    cfg.download.datadir = os.path.join(base, "raw")
+    cfg.processing.base_working_directory = os.path.join(base, "work")
+    cfg.processing.base_results_directory = os.path.join(base, "res")
+    cfg.resultsdb.url = os.path.join(base, "results.db")
+    cfg.searching.dm_max = dm_max
+    cfg.searching.use_hi_accel = accel
+    cfg.searching.max_cands_to_fold = 2
+    cfg.check_sanity(create_dirs=True)
+    set_settings(cfg)
+
+    psr = synth.PulsarSpec(period_s=0.05, dm=20.0,
+                           snr_per_sample=1.5)
+    beams = []
+    for i in range(nbeams):
+        spec = synth.BeamSpec(nchan=nchan, nsamp=nsamp, nsblk=64,
+                              nbits=4, tsamp_s=5.24288e-4,
+                              scan=100 + i)
+        beams.append(synth.synth_beam(
+            os.path.join(base, f"data{i}"), spec, pulsars=[psr],
+            merged=True))
+
+    # deployment-shaped baseline: one fork-per-beam child on beam 0,
+    # with its own empty compile cache — Python/JAX startup, cache
+    # probing, serial stage-in all included, exactly what every beam
+    # pays in the batch model
+    cold_process_s = None
+    if os.environ.get("TPULSAR_SERVE_COLD", "1") != "0":
+        cfg_file = os.path.join(base, "worker_config.yaml")
+        with open(cfg_file, "w") as fh:
+            fh.write(
+                "searching:\n"
+                f"  dm_max: {dm_max}\n"
+                f"  use_hi_accel: {str(accel).lower()}\n"
+                "  max_cands_to_fold: 2\n"
+                "processing:\n"
+                f"  base_working_directory: "
+                f"{cfg.processing.base_working_directory}\n"
+                f"  base_results_directory: "
+                f"{cfg.processing.base_results_directory}\n"
+                f"basic:\n  log_dir: {cfg.basic.log_dir}\n")
+        env = dict(os.environ)
+        env["TPULSAR_CONFIG"] = cfg_file
+        env["TPULSAR_CACHE_DIR"] = os.path.join(base, "cache_cold")
+        _log(f"cold process-per-beam child on beam 0 ...")
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpulsar.cli.search_job"]
+            + beams[0] + ["--outdir", os.path.join(base, "out_cold")],
+            env=env, capture_output=True, text=True)
+        if proc.returncode == 0:
+            cold_process_s = round(time.time() - t0, 3)
+            _log(f"cold child: {cold_process_s:.1f} s")
+        else:
+            _log("cold child failed rc "
+                 f"{proc.returncode}: "
+                 f"{(proc.stderr or '').strip()[-200:]}")
+
+    # the resident server: fresh cache of its own, every beam through
+    # one process — beam 1 pays the compiles, the rest ride the jit
+    # cache and the prefetch overlap
+    os.environ["TPULSAR_CACHE_DIR"] = os.path.join(base, "cache_serve")
+    _aot_cachedir.activate()
+    spool = os.path.join(base, "spool")
+    tickets = []
+    for i, fns in enumerate(beams):
+        tid = f"bench-{i}"
+        protocol.write_ticket(spool, tid, fns,
+                              os.path.join(base, f"out{i}"), job_id=i)
+        tickets.append(tid)
+    _log(f"serving {nbeams} beams from one warm worker ...")
+    t0 = time.time()
+    server = SearchServer(spool=spool, cfg=cfg, warm_boot=False,
+                          poll_s=0.1)
+    server.serve(once=True)
+    serve_wall = round(time.time() - t0, 3)
+
+    per_beam, misses, failed = [], [], []
+    for tid in tickets:
+        rec = protocol.read_result(spool, tid) or {}
+        if rec.get("status") != "done":
+            failed.append(tid)
+            continue
+        per_beam.append(round(rec.get("beam_seconds", 0.0), 3))
+        misses.append(int(rec.get("compile_misses", -1)))
+    result = {
+        "metric": "serve_steady_state_beam_wallclock",
+        "value": (round(statistics.median(per_beam[1:]), 3)
+                  if len(per_beam) > 1 else -1.0),
+        "unit": "s",
+        "serve": {
+            "nbeams": nbeams,
+            "beams_done": len(per_beam),
+            "beams_failed": failed,
+            "per_beam_s": per_beam,
+            "compile_misses_per_beam": misses,
+            "cold_first_beam_s": per_beam[0] if per_beam else -1.0,
+            "warm_steady_state_s": (
+                round(statistics.median(per_beam[1:]), 3)
+                if len(per_beam) > 1 else -1.0),
+            "cold_process_beam_s": cold_process_s,
+            "server_wallclock_s": serve_wall,
+            "accel": accel, "dm_max": dm_max,
+            "nchan": nchan, "nsamp": nsamp,
+        },
+    }
+    if cold_process_s and len(per_beam) > 1:
+        result["serve"]["warm_vs_cold_process_speedup"] = round(
+            cold_process_s / max(1e-9,
+                                 result["serve"]["warm_steady_state_s"]),
+            2)
+    _emit(result)
+    if os.environ.get("TPULSAR_SERVE_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _acquire_campaign_lock() -> "object | None":
     """Serialize chip access with tools/tpu_campaign.sh via its
     .campaign.lock flock.  Two clients of the single axon chip corrupt
@@ -993,6 +1142,9 @@ def _acquire_campaign_lock() -> "object | None":
 def main() -> None:
     if "--measured" in sys.argv:
         run_measured()
+        return
+    if "--serve" in sys.argv:
+        run_serve()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

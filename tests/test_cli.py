@@ -168,6 +168,43 @@ def test_stats_and_monitor(tmp_path, capsys):
     assert "downloads" in out
 
 
+def test_get_datafns_strips_whitespace(monkeypatch):
+    """Scheduler-templated DATAFILES can carry spaces around the ';'
+    separators — they must not become part of the filenames."""
+    import argparse
+
+    from tpulsar.cli import search_job
+
+    monkeypatch.setenv("DATAFILES",
+                       " /d/a.fits ; /d/b.fits ;; /d/c.fits ")
+    args = argparse.Namespace(files=[])
+    assert search_job.get_datafns(args) == [
+        "/d/a.fits", "/d/b.fits", "/d/c.fits"]
+
+
+def test_search_job_sigterm_unwinds_for_cleanup(monkeypatch):
+    """A queue manager's plain TERM must raise through the worker's
+    try/finally (workspace cleanup) instead of killing the process
+    with the stack intact — and with the shell's 128+sig exit code
+    so had_errors() still sees a failure."""
+    import signal
+
+    from tpulsar.cli import search_job
+
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    try:
+        search_job.install_signal_handlers()
+        handler = signal.getsignal(signal.SIGTERM)
+        with pytest.raises(SystemExit) as ei:
+            handler(signal.SIGTERM, None)
+        assert ei.value.code == 128 + signal.SIGTERM
+        assert signal.getsignal(signal.SIGINT) is handler
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+
+
 def test_short_observation_clean_skip(tmp_path, capsys, monkeypatch):
     """A below-threshold beam must exit 0 with a skip marker, not a
     stderr-visible failure the scheduler would retry forever."""

@@ -111,6 +111,39 @@ def test_pool_failure_retry_then_terminal(tracker, tmp_path):
     assert "boom" in sub["details"] or "exit code" in sub["details"]
 
 
+def test_local_get_errors_attributes_beam(tracker, tmp_path):
+    """A dead pid's error text names the beam it was searching (the
+    DATAFILES/OUTDIR contract recorded in the qid state file), so a
+    restarted daemon can attribute failures without the tracker DB."""
+    qm = LocalProcessManager(
+        max_jobs_running=2,
+        script=_fake_worker_script(tmp_path, "exit 7\n"),
+        state_dir=str(tmp_path / "localq"))
+    qid = qm.submit([str(tmp_path / "data" / "beamZ.fits")],
+                    str(tmp_path / "outZ"), job_id=9)
+    for _ in range(50):
+        if not qm.is_running(qid):
+            break
+        time.sleep(0.1)
+    assert qm.had_errors(qid)
+    err = qm.get_errors(qid)
+    assert "exit code 7" in err
+    assert "beamZ.fits" in err and "outZ" in err
+
+
+def test_pool_shutdown_delegates_to_backend(tracker, tmp_path):
+    qm = LocalProcessManager(
+        max_jobs_running=2,
+        script=_fake_worker_script(tmp_path, "sleep 60\n"),
+        state_dir=str(tmp_path / "localq"))
+    _add_beam_files(tracker, tmp_path)
+    pool = JobPool(tracker, qm, str(tmp_path / "results"))
+    pool.rotate()
+    assert qm.status()[1] == 1
+    assert pool.shutdown() == 1          # reaped the running child
+    assert qm.status()[1] == 0
+
+
 def test_queue_manager_registry():
     qm = get_queue_manager("local", max_jobs_running=1)
     assert qm.can_submit()

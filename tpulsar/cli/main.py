@@ -4,6 +4,8 @@
   daemons:   downloader | jobpool | uploader   (StartDownloader.py,
              StartJobPool.py, StartJobUploader.py — incl. the
              crash-notification wrapper and exponential backoff)
+             serve — resident warm-worker search server (no
+             reference counterpart: fork-per-beam amortized away)
   bootstrap: init-db        (create_database.py)
   ingest:    add-files      (add_files.py)
   control:   kill-jobs, stop-jobs, remove-files
@@ -156,7 +158,22 @@ def _queue_manager_kwargs(cfg) -> dict:
         qm_kw = {"hosts": hosts,
                  "launcher": cfg.jobpooler.tpu_launcher,
                  "state_file": os.path.join(state_dir, "tpu_slice.json")}
+    elif cfg.jobpooler.queue_manager == "warm":
+        fb = {"max_jobs_running": cfg.jobpooler.max_jobs_running,
+              "state_dir": os.path.join(
+                  cfg.processing.base_working_directory, ".localq")}
+        if cfg.jobpooler.submit_script:
+            fb["script"] = cfg.jobpooler.submit_script
+        qm_kw = {"spool": _serve_spool(cfg),
+                 "max_queue_depth": cfg.jobpooler.serve_queue_depth,
+                 "fallback_kwargs": fb}
     return qm_kw
+
+
+def _serve_spool(cfg) -> str:
+    """The one spool path the server and the warm backend share."""
+    from tpulsar.serve import protocol
+    return cfg.jobpooler.serve_spool or protocol.default_spool_dir(cfg)
 
 
 def _make_pool(args, cfg):
@@ -236,6 +253,35 @@ def cmd_uploader(args):
         return 0
     return _daemon_loop("uploader", up.run, lambda: None,
                         cfg.background.sleep, _notify(cfg))
+
+
+def cmd_serve(args):
+    """Resident warm-worker search server (tpulsar/serve/): activate
+    the AOT cache and warm-start once, then process beams from the
+    spool admission queue until drained (SIGTERM) — or, with --once,
+    until the spool's current contents are processed (CI mode)."""
+    from tpulsar.config import settings
+    from tpulsar.serve.server import SearchServer
+
+    cfg = settings()
+    server = SearchServer(
+        spool=args.spool or _serve_spool(cfg), cfg=cfg,
+        max_queue_depth=cfg.jobpooler.serve_queue_depth,
+        beam_deadline_s=args.beam_deadline,
+        warm_boot=not args.no_warmstart,
+        warm_boot_scale=args.warmstart_scale,
+        prefetch_depth=args.prefetch_depth)
+    server.install_signal_handlers()
+    print(f"serve: spool {server.spool} "
+          f"(depth {server.max_queue_depth}, "
+          f"warm boot {'on' if server.warm_boot else 'off'}"
+          + (f", beam deadline {args.beam_deadline:g} s"
+             if args.beam_deadline else "") + ")")
+    try:
+        rc = server.serve(once=args.once)
+    finally:
+        _export_metrics("serve")
+    return rc
 
 
 def cmd_status(args):
@@ -831,6 +877,31 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--once", action="store_true")
     sp.add_argument("--remote-root", default=None)
     sp.set_defaults(fn=cmd_downloader)
+
+    sp = sub.add_parser(
+        "serve",
+        help="resident warm-worker search server: one device-owning "
+             "process drains the spool admission queue (warm-start "
+             "paid once per boot, not once per beam)")
+    sp.add_argument("--once", action="store_true",
+                    help="process the spool's current tickets, then "
+                         "exit 0 (CI / cron mode)")
+    sp.add_argument("--spool", default=None,
+                    help="spool dir (default: jobpooler.serve_spool "
+                         "or <base_working_directory>/.serve_spool)")
+    sp.add_argument("--no-warmstart", action="store_true",
+                    help="skip the boot-time AOT gate (cache "
+                         "activation still applies)")
+    sp.add_argument("--warmstart-scale", type=float, default=0.05,
+                    help="AOT gate scale for the boot warm-start")
+    sp.add_argument("--beam-deadline", type=float, default=0.0,
+                    help="per-beam watchdog seconds (0 = none): a "
+                         "hung beam fails its ticket instead of "
+                         "wedging the server")
+    sp.add_argument("--prefetch-depth", type=int, default=1,
+                    help="beams the stage-in thread prepares ahead "
+                         "of the device")
+    sp.set_defaults(fn=cmd_serve)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
 

@@ -14,6 +14,7 @@ import argparse
 import logging
 import os
 import shutil
+import signal
 import sys
 import tempfile
 import warnings
@@ -29,10 +30,29 @@ def get_datafns(args) -> list[str]:
     if args.files:
         return args.files
     env = os.environ.get("DATAFILES", "")
-    fns = [f for f in env.split(";") if f]
+    # strip whitespace around each entry: schedulers that template the
+    # env var from a file list can leave "a.fits; b.fits" — the space
+    # must not become part of the filename
+    fns = [f.strip() for f in env.split(";") if f.strip()]
     if not fns:
         raise SystemExit("no data files: pass paths or set DATAFILES")
     return fns
+
+
+def install_signal_handlers() -> None:
+    """Convert SIGTERM/SIGINT into SystemExit so ``try/finally``
+    workspace cleanup actually runs.
+
+    Queue managers kill jobs with a plain TERM (local.py delete(),
+    qdel, scancel); the default disposition terminates the process
+    without unwinding the stack, leaking the ``tpulsar_*`` scratch
+    tmpdir on every operator kill.  128+signum matches the shell's
+    exit-code convention so had_errors() still sees a nonzero rc."""
+    def _raise_exit(signum, frame):
+        raise SystemExit(128 + signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _raise_exit)
 
 
 def get_outdir(args) -> str:
@@ -96,6 +116,75 @@ def choose_zaplist(fns: list[str], zapdir: str | None,
     return parse_zaplist(packaged) if os.path.exists(packaged) else None
 
 
+def prepare_inputs(fns: list[str], workdir: str,
+                   zaplist_dir: str | None = None,
+                   default_zaplist: str | None = None,
+                   cfg=None) -> tuple[list[str], np.ndarray | None]:
+    """The host-side half of a beam job: stage raw files into the
+    workspace, preprocess (Mock subband merge), refresh the custom
+    zaplist cache, and pick the zaplist.
+
+    Library function shared by the process-per-beam path (main below)
+    and the resident server's prefetch thread (serve/stagein.py) —
+    device-free by construction, so a background thread can run it
+    while the device computes another beam."""
+    if cfg is None:
+        from tpulsar.config import settings
+        cfg = settings()
+    staged = stage_in(fns, workdir)
+    ppfns = datafile.preprocess(staged)
+    zapdir = zaplist_dir or cfg.processing.zaplistdir or None
+    if zapdir and cfg.processing.zaplist_url:
+        # refresh the custom-zaplist cache when the remote tarball is
+        # newer; a refresh failure must not fail the search — the
+        # cached lists (or the default) still apply
+        from tpulsar.orchestrate.zaplists import refresh_zaplists
+        try:
+            refresh_zaplists(zapdir, cfg.processing.zaplist_url)
+        except Exception as e:
+            warnings.warn(f"zaplist refresh from "
+                          f"{cfg.processing.zaplist_url} failed: {e}")
+    zap = choose_zaplist(
+        ppfns, zapdir,
+        default_zaplist or cfg.processing.default_zaplist or None)
+    return ppfns, zap
+
+
+def run_search(ppfns: list[str], workdir: str, outdir: str,
+               params: "executor.SearchParams",
+               zap: np.ndarray | None,
+               log=print) -> "executor.SearchOutcome | None":
+    """Search a prepared beam and make the results durable in outdir
+    (the device-owning half of a beam job, shared with serve/).
+
+    Checkpoints live in the durable output dir, so a retried
+    submission resumes at the first incomplete DDplan pass; a
+    permanently-short observation is a clean skip (None return + a
+    skipped.txt marker), not a failure the scheduler retries
+    forever.  Returns the SearchOutcome, or None for a skip — both
+    mean job success (rc 0)."""
+    ckdir = os.path.join(outdir, ".checkpoint")
+    try:
+        outcome = executor.search_beam(
+            ppfns, workdir, os.path.join(workdir, "results"),
+            params=params, zaplist=zap, checkpoint_dir=ckdir)
+    except executor.TooShortToSearchError as e:
+        os.makedirs(outdir, exist_ok=True)
+        with open(os.path.join(outdir, "skipped.txt"), "w") as fh:
+            fh.write(str(e) + "\n")
+        log(f"skipped: {e}")
+        return None
+    os.makedirs(outdir, exist_ok=True)
+    for name in os.listdir(outcome.resultsdir):
+        shutil.copy2(os.path.join(outcome.resultsdir, name),
+                     os.path.join(outdir, name))
+    # only after results are durable is resume state disposable
+    shutil.rmtree(ckdir, ignore_errors=True)
+    log(f"search complete: {len(outcome.candidates)} candidates, "
+        f"{outcome.num_dm_trials} DM trials")
+    return outcome
+
+
 def _keep_stderr_clean() -> None:
     """Route warnings and log chatter to stdout.
 
@@ -121,6 +210,9 @@ def main(argv=None) -> int:
 
     tpulsar.apply_platform_env()
     _keep_stderr_clean()
+    # a queue manager's kill is a plain TERM: without a handler the
+    # try/finally below never runs and the tpulsar_* scratch dir leaks
+    install_signal_handlers()
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("files", nargs="*", help="raw data files")
     p.add_argument("--outdir", default=None)
@@ -141,50 +233,13 @@ def main(argv=None) -> int:
     workdir = init_workspace(args.workdir_base
                              or cfg.processing.base_working_directory)
     try:
-        staged = stage_in(fns, workdir)
-        ppfns = datafile.preprocess(staged)
-        zapdir = args.zaplist_dir or cfg.processing.zaplistdir or None
-        if zapdir and cfg.processing.zaplist_url:
-            # refresh the custom-zaplist cache when the remote tarball
-            # is newer; a refresh failure must not fail the search —
-            # the cached lists (or the default) still apply
-            from tpulsar.orchestrate.zaplists import refresh_zaplists
-            try:
-                refresh_zaplists(zapdir, cfg.processing.zaplist_url)
-            except Exception as e:
-                warnings.warn(f"zaplist refresh from "
-                              f"{cfg.processing.zaplist_url} failed: {e}")
-        zap = choose_zaplist(
-            ppfns,
-            zapdir,
-            args.default_zaplist or cfg.processing.default_zaplist or None)
+        ppfns, zap = prepare_inputs(
+            fns, workdir, zaplist_dir=args.zaplist_dir,
+            default_zaplist=args.default_zaplist, cfg=cfg)
         params = executor.SearchParams.from_config(cfg.searching)
         if args.no_accel:
             params.run_hi_accel = False
-        # checkpoints live in the durable output dir, so a retried
-        # submission resumes at the first incomplete DDplan pass
-        ckdir = os.path.join(outdir, ".checkpoint")
-        try:
-            outcome = executor.search_beam(
-                ppfns, workdir, os.path.join(workdir, "results"),
-                params=params, zaplist=zap, checkpoint_dir=ckdir)
-        except executor.TooShortToSearchError as e:
-            # a permanently-short observation is a clean skip, not a
-            # job failure (stderr would make the scheduler retry it
-            # forever) — record why in the output dir and succeed
-            os.makedirs(outdir, exist_ok=True)
-            with open(os.path.join(outdir, "skipped.txt"), "w") as fh:
-                fh.write(str(e) + "\n")
-            print(f"skipped: {e}")
-            return 0
-        os.makedirs(outdir, exist_ok=True)
-        for name in os.listdir(outcome.resultsdir):
-            shutil.copy2(os.path.join(outcome.resultsdir, name),
-                         os.path.join(outdir, name))
-        # only after results are durable is resume state disposable
-        shutil.rmtree(ckdir, ignore_errors=True)
-        print(f"search complete: {len(outcome.candidates)} candidates, "
-              f"{outcome.num_dm_trials} DM trials")
+        run_search(ppfns, workdir, outdir, params, zap)
         return 0
     finally:
         shutil.rmtree(workdir, ignore_errors=True)

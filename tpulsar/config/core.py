@@ -81,7 +81,8 @@ class ProcessingConfig:
 
 @dataclasses.dataclass
 class JobPoolerConfig:
-    queue_manager: str = "local"     # local | slurm | pbs | moab | tpu_slice
+    queue_manager: str = "local"     # local | slurm | pbs | moab |
+    #                                  tpu_slice | warm
     max_jobs_running: int = 2
     max_jobs_queued: int = 1
     max_attempts: int = 2
@@ -90,6 +91,11 @@ class JobPoolerConfig:
     walltime_per_gb: float = 50.0          # hours/GB heuristic (moab.py:14)
     tpu_hosts: str = ""                    # comma-separated, for tpu_slice
     tpu_launcher: str = "ssh {host} {cmd}"
+    serve_spool: str = ""                  # warm backend spool dir; ""
+    #                                        = <base_working_directory>/
+    #                                        .serve_spool
+    serve_queue_depth: int = 8             # warm admission-queue bound
+    #                                        (can_submit backpressure)
 
 
 @dataclasses.dataclass
@@ -210,10 +216,12 @@ class TpulsarConfig:
         if self.jobpooler.max_attempts < 1:
             problems.append("jobpooler.max_attempts must be >= 1")
         if self.jobpooler.queue_manager not in (
-                "local", "slurm", "pbs", "moab", "tpu_slice"):
+                "local", "slurm", "pbs", "moab", "tpu_slice", "warm"):
             problems.append(
                 f"jobpooler.queue_manager unknown: "
                 f"{self.jobpooler.queue_manager!r}")
+        if self.jobpooler.serve_queue_depth < 1:
+            problems.append("jobpooler.serve_queue_depth must be >= 1")
         if (self.jobpooler.queue_manager == "tpu_slice"
                 and not self.jobpooler.tpu_hosts.strip()):
             problems.append(

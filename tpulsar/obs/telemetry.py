@@ -172,6 +172,61 @@ def backend_compile_seconds() -> metrics.Histogram:
         labelnames=("program",), buckets=COMPILE_BUCKETS)
 
 
+#: histogram buckets for serve-loop waits: admission latencies from
+#: immediate claims up to a queue that backed up for most of an hour
+SERVE_WAIT_BUCKETS = (0.1, 0.5, 2.0, 10.0, 30.0, 120.0, 600.0, 3600.0)
+
+
+def serve_queue_depth() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_serve_queue_depth",
+        "tickets waiting in the serve spool admission queue "
+        "(incoming, not yet claimed by the server)")
+
+
+def serve_admission_wait_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_serve_admission_wait_seconds",
+        "ticket submit -> server claim latency (how long beams wait "
+        "in the admission queue before the warm worker picks them up)",
+        buckets=SERVE_WAIT_BUCKETS)
+
+
+def serve_beam_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_serve_beam_seconds",
+        "per-beam wall time inside the resident server, labelled by "
+        "compile temperature: cold = the beam paid at least one "
+        "compile-cache miss, warm = it compiled nothing",
+        labelnames=("mode",), buckets=STAGE_BUCKETS)
+
+
+def serve_beams_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_serve_beams_total",
+        "beams processed by the resident server, by outcome "
+        "(done | failed | skipped)",
+        labelnames=("outcome",))
+
+
+def serve_drain_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_serve_drain_seconds",
+        "SIGTERM-to-exit drain duration (finishing the in-flight "
+        "beam, stopping the prefetch thread, final heartbeat)",
+        buckets=SERVE_WAIT_BUCKETS)
+
+
+def serve_stagein_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_serve_stagein_seconds",
+        "host-side stage-in + preprocess time per beam in the "
+        "prefetch thread (overlapped with device compute of the "
+        "previous beam, so this only costs wall time when it exceeds "
+        "the device time)",
+        buckets=STAGE_BUCKETS)
+
+
 # --------------------------------------------------------------------
 # the shared heartbeat/progress event shape
 # --------------------------------------------------------------------

@@ -45,6 +45,18 @@ class JobPool:
 
     # ------------------------------------------------------------- status
 
+    def shutdown(self) -> int:
+        """Release backend resources on daemon exit or test teardown:
+        backends that own subprocesses (local; warm's fallback)
+        expose shutdown() and reap them here so search children never
+        outlive the daemon that submitted them.  Returns the number
+        of jobs the backend killed (0 for cluster backends, whose
+        jobs rightly outlive the submitting daemon)."""
+        qm_shutdown = getattr(self.qm, "shutdown", None)
+        if callable(qm_shutdown):
+            return qm_shutdown()
+        return 0
+
     def status(self) -> dict[str, int]:
         counts = {}
         for row in self.t.query(

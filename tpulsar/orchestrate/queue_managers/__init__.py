@@ -169,4 +169,8 @@ def get_queue_manager(name: str, **kw) -> PipelineQueueManager:
         from tpulsar.orchestrate.queue_managers.tpu_slice import (
             TPUSliceManager)
         return TPUSliceManager(**kw)
+    if name == "warm":
+        from tpulsar.orchestrate.queue_managers.warm import (
+            WarmServerManager)
+        return WarmServerManager(**kw)
     raise ValueError(f"unknown queue manager {name!r}")

@@ -64,6 +64,16 @@ class LocalProcessManager:
 
     @staticmethod
     def _pid_alive(pid: int) -> bool:
+        # Reap first: a killed child of THIS process is a zombie until
+        # waited on, and a zombie still answers kill(pid, 0) — without
+        # this, delete()d jobs counted as running forever in the
+        # process that submitted them.  Other processes' pids raise
+        # ChildProcessError and fall through to the signal probe.
+        try:
+            if os.waitpid(pid, os.WNOHANG) != (0, 0):
+                return False
+        except OSError:
+            pass
         try:
             os.kill(pid, 0)
         except ProcessLookupError:
@@ -111,10 +121,15 @@ class LocalProcessManager:
                                     stdout=subprocess.DEVNULL,
                                     stderr=errfh,
                                     start_new_session=True)
+        # the env contract travels with the queue state: get_errors()
+        # on a dead pid can then say WHICH beam the job was searching
+        # (a bare "exit code 1" from a restarted daemon was previously
+        # unattributable without the tracker DB)
         with open(self._state_path(qid), "w") as fh:
             json.dump({"qid": qid, "pid": proc.pid, "stderr": errpath,
                        "rc_file": rc_path, "outdir": outdir,
-                       "job_id": job_id, "submitted_at": time.time()}, fh)
+                       "job_id": job_id, "submitted_at": time.time(),
+                       "datafiles": list(datafiles)}, fh)
         return qid
 
     # ------------------------------------------------------------- queries
@@ -198,6 +213,15 @@ class LocalProcessManager:
         rc = self._exit_code(st)
         if rc not in (0, None):
             parts.append(f"exit code {rc}")
+            # which beam the dead pid belonged to, from the recorded
+            # DATAFILES/OUTDIR contract — readable even after a
+            # daemon restart, without the tracker DB
+            fns = st.get("datafiles") or []
+            if fns:
+                parts.append("beam: " + ";".join(
+                    os.path.basename(f) for f in fns))
+            if st.get("outdir"):
+                parts.append(f"outdir: {st['outdir']}")
         err = st["stderr"]
         if os.path.exists(err) and os.path.getsize(err):
             with open(err, errors="replace") as fh:

@@ -53,6 +53,7 @@ FAULT_POINTS = (
     "download.transfer",    # transport fetch inside a download thread
     "upload.write",         # results-DB upload transaction
     "queue.submit",         # queue-manager job submission
+    "serve.beam",           # resident-server per-beam device work
 )
 
 MODES = ("unimplemented", "hang", "poison")

@@ -180,6 +180,13 @@ class SearchOutcome:
     masked_fraction: float
     num_dm_trials: int
     timers: StageTimers
+    #: persistent compilation-cache traffic attributable to THIS beam
+    #: (the runtime monitor's counter delta, same numbers as the
+    #: results dir's metrics.json).  A warm worker's steady state is
+    #: compile_misses == 0; any other value is a recompile the AOT
+    #: gate / resident cache should have absorbed.
+    compile_hits: int = 0
+    compile_misses: int = 0
 
 
 def search_beam(fns: list[str], workdir: str, resultsdir: str,
@@ -351,17 +358,25 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
         trace_mod.save(os.path.join(resultsdir,
                                     f"{basenm}_trace.json"))
     import json as _json
+    mdelta = telemetry.metrics.diff_snapshots(
+        telemetry.metrics.REGISTRY.snapshot(), metrics_base)
     with open(os.path.join(resultsdir, "metrics.json"), "w") as fh:
-        _json.dump(telemetry.metrics.diff_snapshots(
-            telemetry.metrics.REGISTRY.snapshot(), metrics_base), fh,
-            indent=1)
+        _json.dump(mdelta, fh, indent=1)
     _tar_result_classes(resultsdir, basenm)
+
+    def _counter_total(name: str) -> int:
+        return int(sum((mdelta.get(name) or {}).get("series",
+                                                    {}).values()))
 
     return SearchOutcome(basenm=basenm, resultsdir=resultsdir,
                          candidates=final, folded=folded,
                          sp_events=sp_events,
                          masked_fraction=mask.masked_fraction,
-                         num_dm_trials=num_trials, timers=timers)
+                         num_dm_trials=num_trials, timers=timers,
+                         compile_hits=_counter_total(
+                             "tpulsar_compile_cache_hits_total"),
+                         compile_misses=_counter_total(
+                             "tpulsar_compile_cache_misses_total"))
 
 
 def _budget_dm_chunk(nfft: int, hi: bool, budget: int) -> int:

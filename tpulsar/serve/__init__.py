@@ -1,0 +1,21 @@
+"""Resident warm-worker serving: one device-owning process searches a
+stream of beams, paying Python/JAX startup, AOT warm-start, and
+compile-cache probing once per boot instead of once per beam.
+
+  protocol.py   filesystem spool (job tickets in, results out,
+                server heartbeat) — no network stack needed
+  stagein.py    host-side prefetch: stage beam N+1 while the device
+                computes beam N
+  server.py     the server loop: admission queue with backpressure,
+                per-beam deadlines, crash isolation, graceful drain
+
+Clients reach it through the ``warm`` queue backend
+(orchestrate/queue_managers/warm.py), which falls back to
+process-per-beam submission whenever no server heartbeat is fresh —
+or through ``tpulsar serve`` directly.
+"""
+
+from tpulsar.serve import protocol  # noqa: F401
+from tpulsar.serve.server import SearchServer  # noqa: F401
+from tpulsar.serve.stagein import (  # noqa: F401
+    PreparedBeam, StageInPipeline, prepare_beam)

@@ -1,0 +1,255 @@
+"""The serve spool: a filesystem job-ticket protocol.
+
+The resident server and its clients (the ``warm`` queue backend,
+``bench.py --serve``, CI smoke scripts) coordinate through a spool
+directory — job tickets in, result records out — so no network stack
+is needed and every state transition is a crash-safe rename:
+
+    <spool>/incoming/<ticket_id>.json    admission queue (bounded)
+    <spool>/claimed/<ticket_id>.json     accepted, being processed
+    <spool>/done/<ticket_id>.json        result/status record
+    <spool>/server.json                  server heartbeat
+
+A ticket moves ``incoming -> claimed`` by atomic rename (exactly-one
+claimer even with several servers on one spool) and is deleted from
+``claimed`` only after its result record is durable in ``done/``.  A
+server that dies mid-beam therefore leaves the ticket in ``claimed``;
+``requeue_stale_claims`` (run at server boot) moves such orphans back
+to ``incoming`` so the beam is retried, never lost.
+
+All writes are tmp-file + ``os.replace`` so a reader can never observe
+a torn JSON document.
+
+Ticket shape (written by clients):
+    {"ticket": ..., "datafiles": [...], "outdir": ..., "job_id": ...,
+     "submitted_at": unix_time}
+
+Result shape (written by the server):
+    {"ticket": ..., "status": "done"|"failed"|"skipped", "rc": int,
+     "error": str, "beam_seconds": float, "compile_misses": int,
+     "warm": bool, "outdir": ..., "finished_at": unix_time}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+#: heartbeats older than this are stale: the server is gone (crashed,
+#: drained, or never started) and clients must fall back to
+#: process-per-beam submission
+HEARTBEAT_MAX_AGE_S = 120.0
+
+_STATES = ("incoming", "claimed", "done")
+
+
+def default_spool_dir(cfg=None) -> str:
+    """One spool per deployment, under the working-directory root the
+    server and the job-pool daemon already share."""
+    if cfg is None:
+        from tpulsar.config import settings
+        cfg = settings()
+    return os.path.join(cfg.processing.base_working_directory,
+                        ".serve_spool")
+
+
+def ensure_spool(spool: str) -> str:
+    for state in _STATES:
+        os.makedirs(os.path.join(spool, state), exist_ok=True)
+    return spool
+
+
+def _atomic_write_json(path: str, rec: dict) -> None:
+    # tmp name unique per writer: the heartbeat is written by both
+    # the server's main thread and its heartbeat thread, and two
+    # writers sharing one tmp path can interleave truncate/rename
+    # into a torn server.json — which reads as a DEAD server and
+    # makes the warm backend abandon live tickets
+    import threading
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def ticket_path(spool: str, ticket_id: str, state: str) -> str:
+    assert state in _STATES, state
+    return os.path.join(spool, state, f"{ticket_id}.json")
+
+
+# ------------------------------------------------------------- tickets
+
+def write_ticket(spool: str, ticket_id: str, datafiles: list[str],
+                 outdir: str, job_id: int | None = None,
+                 **extra) -> str:
+    """Enqueue a beam: one JSON file in incoming/.  Returns the
+    ticket id.  Callers enforce admission depth via pending_count()
+    BEFORE writing (the queue-backend contract's can_submit)."""
+    ensure_spool(spool)
+    rec = {"ticket": ticket_id, "datafiles": list(datafiles),
+           "outdir": outdir, "job_id": job_id,
+           "submitted_at": time.time(), **extra}
+    _atomic_write_json(ticket_path(spool, ticket_id, "incoming"), rec)
+    return ticket_id
+
+
+def list_tickets(spool: str, state: str) -> list[str]:
+    """Ticket ids in a spool state, oldest submission first (FIFO
+    admission — directory listing order is not arrival order)."""
+    d = os.path.join(spool, state)
+    try:
+        names = [n for n in os.listdir(d) if n.endswith(".json")]
+    except OSError:
+        return []
+    def _key(name: str):
+        rec = _read_json(os.path.join(d, name)) or {}
+        return (rec.get("submitted_at", 0.0), name)
+    return [n[:-5] for n in sorted(names, key=_key)]
+
+
+def pending_count(spool: str) -> int:
+    return len(list_tickets(spool, "incoming"))
+
+
+def claim_next_ticket(spool: str) -> dict | None:
+    """Atomically move the oldest incoming ticket to claimed/ and
+    return its record (None when the queue is empty).  Rename is the
+    claim: two servers on one spool cannot claim the same ticket."""
+    for tid in list_tickets(spool, "incoming"):
+        src = ticket_path(spool, tid, "incoming")
+        dst = ticket_path(spool, tid, "claimed")
+        try:
+            os.rename(src, dst)
+        except OSError:
+            continue            # lost the race; try the next ticket
+        rec = _read_json(dst)
+        if rec is not None:
+            rec["claimed_at"] = time.time()
+            rec["claimed_by"] = os.getpid()
+            _atomic_write_json(dst, rec)
+            return rec
+        os.unlink(dst)          # torn/garbage ticket: drop it
+    return None
+
+
+def cancel_ticket(spool: str, ticket_id: str) -> bool:
+    """Remove a ticket still waiting for admission.  A claimed ticket
+    cannot be cancelled from outside (the server owns it — there is
+    no cross-process way to abort the in-flight device work)."""
+    try:
+        os.unlink(ticket_path(spool, ticket_id, "incoming"))
+        return True
+    except OSError:
+        return False
+
+
+def _pid_alive(pid) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except (ProcessLookupError, ValueError, TypeError, OverflowError):
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def requeue_stale_claims(spool: str) -> list[str]:
+    """Move claimed-but-unfinished tickets back to incoming (server
+    boot recovery: a predecessor that died mid-beam left them there).
+    Claims whose recorded owner pid is still alive belong to a LIVE
+    co-server on this spool and are left alone — stealing them would
+    double-process the beam.  Tickets that already have a result
+    record are completed work the dead server just failed to unlink —
+    finish the bookkeeping instead of re-running the beam."""
+    ensure_spool(spool)
+    me = os.getpid()
+    requeued = []
+    for tid in list_tickets(spool, "claimed"):
+        src = ticket_path(spool, tid, "claimed")
+        if os.path.exists(ticket_path(spool, tid, "done")):
+            try:
+                os.unlink(src)
+            except OSError:
+                pass
+            continue
+        rec = _read_json(src)
+        if rec is None:
+            continue
+        owner = rec.get("claimed_by")
+        if owner is not None and owner != me and _pid_alive(owner):
+            continue            # a live co-server owns this beam
+        rec.pop("claimed_at", None)
+        rec.pop("claimed_by", None)
+        _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
+        try:
+            os.unlink(src)
+        except OSError:
+            pass
+        requeued.append(tid)
+    return requeued
+
+
+# ------------------------------------------------------------- results
+
+def write_result(spool: str, ticket_id: str, status: str,
+                 rc: int = 0, error: str = "", **extra) -> None:
+    """Record a beam's outcome in done/ and release its claim.  The
+    result is durable BEFORE the claim is unlinked, so a crash
+    between the two leaves a finished ticket (requeue_stale_claims
+    reconciles it), never a lost one."""
+    ensure_spool(spool)
+    rec = {"ticket": ticket_id, "status": status, "rc": rc,
+           "error": error, "finished_at": time.time(), **extra}
+    _atomic_write_json(ticket_path(spool, ticket_id, "done"), rec)
+    try:
+        os.unlink(ticket_path(spool, ticket_id, "claimed"))
+    except OSError:
+        pass
+
+
+def read_result(spool: str, ticket_id: str) -> dict | None:
+    return _read_json(ticket_path(spool, ticket_id, "done"))
+
+
+def ticket_state(spool: str, ticket_id: str) -> str:
+    """'incoming' | 'claimed' | 'done' | 'unknown'."""
+    for state in ("done", "claimed", "incoming"):
+        if os.path.exists(ticket_path(spool, ticket_id, state)):
+            return state
+    return "unknown"
+
+
+# ----------------------------------------------------------- heartbeat
+
+def heartbeat_path(spool: str) -> str:
+    return os.path.join(spool, "server.json")
+
+
+def write_heartbeat(spool: str, **fields) -> None:
+    ensure_spool(spool)
+    rec = {"t": time.time(), "pid": os.getpid(), **fields}
+    _atomic_write_json(heartbeat_path(spool), rec)
+
+
+def read_heartbeat(spool: str) -> dict | None:
+    return _read_json(heartbeat_path(spool))
+
+
+def heartbeat_fresh(spool: str,
+                    max_age_s: float = HEARTBEAT_MAX_AGE_S) -> bool:
+    """A live server wrote the heartbeat recently AND is not
+    draining.  A draining server still finishes its claimed beams but
+    must receive no new work."""
+    hb = read_heartbeat(spool)
+    if hb is None or hb.get("status") in ("draining", "stopped"):
+        return False
+    return (time.time() - hb.get("t", 0.0)) <= max_age_s

@@ -1,0 +1,314 @@
+"""The resident warm-worker search server.
+
+One long-lived, device-owning process replaces the fork-per-beam
+model: it activates the persistent compile cache and (optionally)
+runs the AOT warm-start gate once at boot, then loops over the spool
+admission queue (serve/protocol.py).  Every beam after the first
+reuses the process's jitted programs, template banks, and compile
+cache — PR 3 measured 160 s of a 176 s cold child spent off the hot
+path, and this server pays that once per boot instead of once per
+beam.
+
+Properties the batch path cannot offer:
+
+  * admission queue with bounded depth — the ``warm`` queue backend's
+    can_submit() refuses tickets past ``max_queue_depth`` (spool
+    backpressure, not an unbounded directory);
+  * stage-in prefetch — serve/stagein.py overlaps host-side staging
+    of beam N+1 with device compute of beam N;
+  * per-beam deadlines — resilience.policy.run_with_deadline converts
+    a hung dispatch into a failed ticket instead of a wedged server;
+  * crash isolation — a poisoned beam (fault point ``serve.beam``)
+    marks THAT ticket failed and the loop continues;
+  * graceful drain — SIGTERM finishes the in-flight beam, requeues
+    claimed-but-unstarted tickets, and stamps the heartbeat
+    ``stopped`` so clients fall back to process-per-beam submission.
+
+Per-beam results are produced by the same ``cli.search_job``
+library functions the batch path runs, so the output directory layout
+(search_params.txt, report, tarballs, metrics.json) is identical and
+the uploader/results_db code is untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from tpulsar.obs import telemetry
+from tpulsar.obs.log import get_logger
+from tpulsar.resilience import faults, policy
+from tpulsar.serve import protocol
+from tpulsar.serve.stagein import PreparedBeam, StageInPipeline
+
+
+class SearchServer:
+    def __init__(self, spool: str | None = None, cfg=None, *,
+                 max_queue_depth: int = 8,
+                 beam_deadline_s: float = 0.0,
+                 warm_boot: bool = True,
+                 warm_boot_scale: float = 0.05,
+                 prefetch_depth: int = 1,
+                 poll_s: float = 0.5,
+                 heartbeat_interval_s: float = 10.0,
+                 beam_fn=None, logger=None):
+        if cfg is None:
+            from tpulsar.config import settings
+            cfg = settings()
+        self.cfg = cfg
+        self.spool = spool or protocol.default_spool_dir(cfg)
+        self.max_queue_depth = max_queue_depth
+        self.beam_deadline_s = beam_deadline_s
+        self.warm_boot = warm_boot
+        self.warm_boot_scale = warm_boot_scale
+        self.poll_s = poll_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        #: injectable for tests: callable(PreparedBeam) ->
+        #: SearchOutcome | None (None = clean skip)
+        self.beam_fn = beam_fn or self._search_one
+        self.log = logger or get_logger("serve")
+        self.pipeline = StageInPipeline(
+            claim=lambda: protocol.claim_next_ticket(self.spool),
+            workdir_base=cfg.processing.base_working_directory,
+            cfg=cfg, depth=prefetch_depth, poll_s=poll_s,
+            logger=self.log)
+        self._drain = threading.Event()
+        self._stopped = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+        self._hb_last = 0.0
+        self.beams = {"done": 0, "failed": 0, "skipped": 0}
+        self.started_at = time.time()
+
+    # ------------------------------------------------------------ control
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT request a graceful drain: finish the
+        in-flight beam, requeue the rest, heartbeat ``stopped``."""
+        def _on_term(signum, frame):
+            self.log.info("signal %d: draining", signum)
+            self.request_drain()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _on_term)
+
+    def request_drain(self) -> None:
+        self._drain.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._drain.is_set()
+
+    # ------------------------------------------------------------ boot
+
+    def boot(self) -> None:
+        protocol.ensure_spool(self.spool)
+        requeued = protocol.requeue_stale_claims(self.spool)
+        if requeued:
+            self.log.warning(
+                "requeued %d ticket(s) a dead server left claimed: %s",
+                len(requeued), ", ".join(requeued))
+        # the whole point of residency: one cache activation + one
+        # warm-start for EVERY beam this process will ever search
+        from tpulsar.aot import cachedir, warmstart
+
+        cachedir.activate()
+        warmstart.install_runtime_monitor()
+        if self.warm_boot:
+            self.log.info("AOT warm-start (scale %g) ...",
+                          self.warm_boot_scale)
+            # verify-first: a restarted server over a warm cache pays
+            # an all-hits replay (seconds), not a full re-gate.  The
+            # accel block is gated iff this deployment searches it —
+            # otherwise the first accel beam pays its compiles inline
+            rc = warmstart.warm_boot(
+                scale=self.warm_boot_scale,
+                accel=self.cfg.searching.use_hi_accel,
+                echo=lambda s: self.log.info("gate: %s", s))
+            if rc not in (0, 3):
+                # a failed gate is a degraded boot, not a fatal one:
+                # beams still search, they just pay inline compiles
+                # (visible as compile_misses in every result record)
+                self.log.warning("warm-start gate rc %d — serving "
+                                 "with a cold cache", rc)
+        self._heartbeat("running", force=True)
+
+    def _heartbeat(self, status: str, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._hb_last < self.heartbeat_interval_s:
+            return
+        depth = protocol.pending_count(self.spool)
+        telemetry.serve_queue_depth().set(depth)
+        protocol.write_heartbeat(
+            self.spool, status=status, queue_depth=depth,
+            max_queue_depth=self.max_queue_depth,
+            beams=dict(self.beams), started_at=self.started_at)
+        self._hb_last = now
+
+    def _heartbeat_loop(self) -> None:
+        """Background freshness writer: a beam can hold the main
+        thread for many minutes, and a heartbeat that goes stale
+        mid-compute would make the warm backend abandon tickets a
+        perfectly healthy server still owns."""
+        while not self._stopped.wait(self.heartbeat_interval_s):
+            try:
+                self._heartbeat(
+                    "draining" if self.draining else "running",
+                    force=True)
+            except OSError:
+                pass            # a full disk must not kill the writer
+
+    # ------------------------------------------------------------ serving
+
+    def serve(self, once: bool = False) -> int:
+        """The server loop.  once=True drains the spool's current
+        contents and exits 0 (CI / cron mode); otherwise loops until
+        a drain is requested."""
+        # liveness BEFORE boot work: a cold-cache warm-start gate can
+        # run for minutes, and without a fresh heartbeat through that
+        # window the warm backend would abandon (fail) every ticket
+        # already queued for this perfectly healthy, booting server
+        protocol.ensure_spool(self.spool)
+        self._heartbeat("running", force=True)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="serve-heartbeat",
+            daemon=True)
+        self._hb_thread.start()
+        self.boot()
+        self.pipeline.start()
+        try:
+            while not self.draining:
+                self._heartbeat("running")
+                prepared = self.pipeline.next(timeout=self.poll_s)
+                if prepared is not None:
+                    self._process(prepared)
+                    continue
+                if once and protocol.pending_count(self.spool) == 0 \
+                        and not protocol.list_tickets(self.spool,
+                                                      "claimed"):
+                    break
+        finally:
+            self._shutdown()
+        return 0
+
+    def _shutdown(self) -> None:
+        t0 = time.time()
+        self._stopped.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        self.pipeline.stop()
+        requeued = protocol.requeue_stale_claims(self.spool)
+        if requeued:
+            self.log.info("drain requeued %d unstarted ticket(s)",
+                          len(requeued))
+        self._heartbeat("stopped", force=True)
+        dt = time.time() - t0
+        telemetry.serve_drain_seconds().observe(dt)
+        self.log.info(
+            "server stopped after %.0f s: %d done, %d failed, "
+            "%d skipped (drain took %.2f s)",
+            time.time() - self.started_at, self.beams["done"],
+            self.beams["failed"], self.beams["skipped"], dt)
+
+    # ------------------------------------------------------------ one beam
+
+    def _search_one(self, prepared: PreparedBeam):
+        """The real beam runner: the same library calls the batch
+        worker makes, so results are layout-identical."""
+        from tpulsar.cli import search_job
+        from tpulsar.search import executor
+
+        # deterministic poisoned-beam injection point: fires before
+        # any device work, shaped like a runtime refusal
+        faults.fire("serve.beam",
+                    detail=f"ticket {prepared.ticket_id}")
+        params = executor.SearchParams.from_config(self.cfg.searching)
+        return search_job.run_search(
+            prepared.ppfns, prepared.workdir,
+            prepared.ticket["outdir"], params, prepared.zaplist,
+            log=lambda msg: self.log.info("[%s] %s",
+                                          prepared.ticket_id, msg))
+
+    def _process(self, prepared: PreparedBeam) -> None:
+        tid = prepared.ticket_id
+        outdir = prepared.ticket.get("outdir", "")
+        t0 = time.time()
+        telemetry.trace.instant("serve_beam_start", ticket=tid)
+        if prepared.error:
+            self.log.error("ticket %s stage-in failed: %s", tid,
+                           prepared.error.splitlines()[0]
+                           if prepared.error else "?")
+            self._finish(tid, "failed", t0, outdir,
+                         error=prepared.error)
+            return
+        misses0 = self._compile_misses_total()
+        try:
+            outcome = policy.run_with_deadline(
+                lambda: self.beam_fn(prepared),
+                self.beam_deadline_s, label=f"serve beam {tid}")
+        except policy.DeadlineExceeded as e:
+            # the abandoned runner thread still holds the device AND
+            # the workdir — deliberately LEAK the scratch dir rather
+            # than rmtree it under a live thread; the ticket is
+            # answered now, the leak is bounded per deadline kill
+            self.log.error(
+                "ticket %s exceeded its %.0f s deadline; workdir %s "
+                "left to the abandoned runner", tid,
+                self.beam_deadline_s, prepared.workdir)
+            self._finish(
+                tid, "failed", t0, outdir, error=str(e),
+                compile_misses=self._compile_misses_total() - misses0)
+            return
+        except Exception as e:
+            # crash isolation: THIS ticket failed; the server (and
+            # the device) live on
+            import traceback
+            self.log.exception("ticket %s failed", tid)
+            prepared.cleanup()
+            self._finish(
+                tid, "failed", t0, outdir,
+                error=f"{e}\n{traceback.format_exc()}"[:4000],
+                compile_misses=self._compile_misses_total() - misses0)
+            return
+        prepared.cleanup()
+        if outcome is None:                 # TooShort clean skip
+            self._finish(tid, "skipped", t0, outdir)
+        else:
+            self._finish(tid, "done", t0, outdir,
+                         compile_misses=outcome.compile_misses,
+                         compile_hits=outcome.compile_hits,
+                         candidates=len(outcome.candidates),
+                         dm_trials=outcome.num_dm_trials)
+
+    @staticmethod
+    def _compile_misses_total() -> int:
+        """Process-cumulative persistent-cache misses (the runtime
+        monitor's counter): failure paths label their result records
+        from the delta over the beam, since no SearchOutcome exists
+        to carry it."""
+        snap = telemetry.metrics.REGISTRY.snapshot()
+        rec = snap.get("tpulsar_compile_cache_misses_total") or {}
+        return int(sum(rec.get("series", {}).values()))
+
+    def _finish(self, tid: str, status: str, t0: float, outdir: str,
+                error: str = "", **extra) -> None:
+        dt = time.time() - t0
+        # a beam is warm when it compiled nothing: the steady state
+        # this subsystem exists to reach (failed beams are labelled
+        # by their measured compile traffic too — a deadline kill
+        # during a compile is a cold failure)
+        warm = extra.get("compile_misses", 0) == 0
+        protocol.write_result(
+            self.spool, tid, status,
+            rc=0 if status in ("done", "skipped") else 1,
+            error=error, beam_seconds=dt, warm=warm,
+            outdir=outdir, **extra)
+        self.beams[status] = self.beams.get(status, 0) + 1
+        telemetry.serve_beams_total().inc(outcome=status)
+        if status != "skipped":
+            telemetry.serve_beam_seconds().observe(
+                dt, mode="warm" if warm else "cold")
+        self._heartbeat("running", force=True)
+        self.log.info("ticket %s -> %s in %.2f s (%s)", tid, status,
+                      dt, "warm" if warm else "cold")

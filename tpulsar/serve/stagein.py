@@ -1,0 +1,164 @@
+"""Host-side stage-in prefetch for the resident server.
+
+The process-per-beam path pays stage-in (copy raw files to the
+node-local workspace), Mock subband merge, and zaplist selection
+serially before any device work.  In a resident worker those are pure
+host/disk operations, so one background thread prepares beam N+1
+while the device computes beam N — the handoff is a bounded queue
+(depth 1 by default: prefetching further ahead only grows the scratch
+footprint, the device can only consume one beam at a time).
+
+The preparation itself is ``cli.search_job.prepare_inputs`` — the
+same library function the batch path runs — so a beam staged by the
+prefetch thread is byte-identical to one staged by a cold process.
+
+A preparation failure (missing file, corrupt FITS, full disk) is
+carried in ``PreparedBeam.error`` instead of raised: the server marks
+that one job failed and keeps serving — a poisoned input must not
+kill the worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import shutil
+import threading
+import time
+import traceback
+from typing import Callable
+
+import numpy as np
+
+from tpulsar.obs import telemetry
+from tpulsar.obs.log import get_logger
+
+
+@dataclasses.dataclass
+class PreparedBeam:
+    """A ticket plus everything the device loop needs to search it."""
+    ticket: dict
+    workdir: str = ""
+    ppfns: list[str] = dataclasses.field(default_factory=list)
+    zaplist: np.ndarray | None = None
+    error: str = ""              # non-empty: stage-in/preprocess failed
+    stagein_seconds: float = 0.0
+
+    @property
+    def ticket_id(self) -> str:
+        return self.ticket.get("ticket", "?")
+
+    def cleanup(self) -> None:
+        if self.workdir:
+            shutil.rmtree(self.workdir, ignore_errors=True)
+
+
+def prepare_beam(ticket: dict, workdir_base: str | None = None,
+                 cfg=None) -> PreparedBeam:
+    """Stage one ticket's beam into a fresh workspace (device-free:
+    safe on a background thread while the device is busy)."""
+    from tpulsar.cli import search_job
+
+    if cfg is None:
+        from tpulsar.config import settings
+        cfg = settings()
+    t0 = time.time()
+    workdir = search_job.init_workspace(
+        workdir_base or cfg.processing.base_working_directory)
+    try:
+        ppfns, zap = search_job.prepare_inputs(
+            ticket["datafiles"], workdir, cfg=cfg)
+    except BaseException as e:
+        shutil.rmtree(workdir, ignore_errors=True)
+        return PreparedBeam(
+            ticket=ticket,
+            error=f"stage-in failed: {e}\n{traceback.format_exc()}"[:4000])
+    dt = time.time() - t0
+    telemetry.serve_stagein_seconds().observe(dt)
+    return PreparedBeam(ticket=ticket, workdir=workdir, ppfns=ppfns,
+                        zaplist=zap, stagein_seconds=dt)
+
+
+class StageInPipeline:
+    """One background thread: claim tickets, prepare them, hand them
+    over through a bounded queue.
+
+    ``claim`` is any callable returning the next ticket record or
+    None (the server passes protocol.claim_next_ticket on its spool).
+    The bounded handoff queue is the backpressure: with depth 1 the
+    thread stages at most one beam ahead of the device and then
+    blocks, so scratch disk holds at most two staged beams."""
+
+    def __init__(self, claim: Callable[[], dict | None],
+                 workdir_base: str | None = None, cfg=None,
+                 depth: int = 1, poll_s: float = 0.5, logger=None):
+        self.claim = claim
+        self.workdir_base = workdir_base
+        self.cfg = cfg
+        self.poll_s = poll_s
+        self.log = logger or get_logger("serve.stagein")
+        self._out: queue.Queue[PreparedBeam] = queue.Queue(
+            maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- thread
+
+    def start(self) -> "StageInPipeline":
+        self._thread = threading.Thread(
+            target=self._run, name="serve-stagein", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ticket = self.claim()
+            except Exception:
+                self.log.exception("ticket claim failed")
+                ticket = None
+            if ticket is None:
+                self._stop.wait(self.poll_s)
+                continue
+            waited = time.time() - ticket.get("submitted_at",
+                                              time.time())
+            telemetry.serve_admission_wait_seconds().observe(
+                max(0.0, waited))
+            prepared = prepare_beam(ticket, self.workdir_base, self.cfg)
+            while not self._stop.is_set():
+                try:
+                    self._out.put(prepared, timeout=0.25)
+                    break
+                except queue.Full:
+                    continue
+            else:
+                # stopping with an unconsumed beam: drop the scratch
+                # dir; the still-claimed ticket is requeued by the
+                # server's drain (requeue_stale_claims)
+                prepared.cleanup()
+
+    # ----------------------------------------------------------- caller
+
+    def next(self, timeout: float | None = None) -> PreparedBeam | None:
+        """The next prepared beam, or None on timeout."""
+        try:
+            return self._out.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> list[PreparedBeam]:
+        """Stop the thread and return any prepared-but-unconsumed
+        beams (already cleaned up; their tickets are still claimed in
+        the spool for the caller to requeue)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        leftovers = []
+        while True:
+            try:
+                b = self._out.get_nowait()
+            except queue.Empty:
+                break
+            b.cleanup()
+            leftovers.append(b)
+        return leftovers
