@@ -1092,6 +1092,260 @@ def run_serve() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _usable_cpus() -> list:
+    """The CPU ids this process may actually run on, for taskset
+    pinning (a cgroup cpuset need not start at 0 or be contiguous)."""
+    if hasattr(os, "sched_getaffinity"):
+        return sorted(os.sched_getaffinity(0))
+    return list(range(os.cpu_count() or 1))
+
+
+def run_fleet() -> None:
+    """``bench.py --fleet``: the same synthetic beam set through a
+    1-worker and a 2-worker fleet (tpulsar/fleet/) on one spool, and
+    report aggregate beams/s — the number that justifies horizontal
+    scale-out on top of the warm path.  Workers share one persistent
+    compile cache (scaling is the contrast being measured, not
+    caching), and the aggregate rate is computed over the result
+    records' own timestamps (first beam start -> last beam finish),
+    so worker boot (JAX import, cache activation) is excluded exactly
+    as the serve bench excludes it.
+
+    Every worker (in BOTH configs) is pinned to its own CPU core
+    (taskset) with a single-threaded XLA pool: in the deployment this
+    models, a fleet worker owns one device — on CPU that means one
+    core each, so the contrast measures horizontal scaling at fixed
+    per-worker resources rather than letting the single worker's XLA
+    thread pool absorb every core and calling that the baseline
+    (override via TPULSAR_FLEET_PIN=0).  Emits one bench/v2 record
+    with an additive ``fleet`` key."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from tpulsar.fleet.controller import FleetController
+    from tpulsar.io import synth
+    from tpulsar.serve import protocol
+
+    nbeams = int(os.environ.get("TPULSAR_FLEET_NBEAMS", "6"))
+    nchan = int(os.environ.get("TPULSAR_FLEET_NCHAN", "16"))
+    nsamp = int(os.environ.get("TPULSAR_FLEET_NSAMP", str(1 << 12)))
+    dm_max = float(os.environ.get("TPULSAR_FLEET_DM_MAX", "30"))
+    base = tempfile.mkdtemp(prefix="tpulsar_fleetbench_")
+
+    cfg_file = os.path.join(base, "config.yaml")
+    with open(cfg_file, "w") as fh:
+        fh.write(
+            "searching:\n"
+            f"  dm_max: {dm_max}\n"
+            "  use_hi_accel: false\n"
+            "  max_cands_to_fold: 2\n"
+            "processing:\n"
+            f"  base_working_directory: {base}/work\n"
+            f"  base_results_directory: {base}/res\n"
+            f"basic:\n  log_dir: {base}/logs\n")
+    # worker subprocesses read both of these from the environment
+    os.environ["TPULSAR_CONFIG"] = cfg_file
+    os.environ["TPULSAR_CACHE_DIR"] = os.path.join(base, "cache")
+
+    psr = synth.PulsarSpec(period_s=0.05, dm=20.0,
+                           snr_per_sample=1.5)
+    beams = []
+    for i in range(nbeams):
+        spec = synth.BeamSpec(nchan=nchan, nsamp=nsamp, nsblk=64,
+                              nbits=4, tsamp_s=5.24288e-4,
+                              scan=100 + i)
+        beams.append(synth.synth_beam(
+            os.path.join(base, f"data{i}"), spec, pulsars=[psr],
+            merged=True))
+
+    def run_config(nworkers: int, tag: str) -> dict:
+        spool = os.path.join(base, f"spool{tag}")
+        tickets = []
+        for i, fns in enumerate(beams):
+            tid = f"fleet{tag}-{i}"
+            protocol.write_ticket(
+                spool, tid, fns,
+                os.path.join(base, f"out{tag}_{i}"), job_id=i)
+            tickets.append(tid)
+        _log(f"fleet config: {nbeams} beams through {nworkers} "
+             f"worker(s) ...")
+        pin = os.environ.get("TPULSAR_FLEET_PIN", "1") != "0"
+        cpus = _usable_cpus()
+        worker_env = None
+        if pin:
+            env_pin = {
+                "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                              " --xla_cpu_multi_thread_eigen=false"
+                              ).strip(),
+                "OMP_NUM_THREADS": "1",
+                "OPENBLAS_NUM_THREADS": "1",
+                "MKL_NUM_THREADS": "1",
+            }
+            worker_env = lambda wid: env_pin     # noqa: E731
+
+        def worker_cmd(wid: str) -> list:
+            argv = []
+            if pin:
+                # one core per worker, like one device per worker —
+                # indexed into the ACTUAL affinity mask (a cgroup
+                # cpuset need not start at cpu 0)
+                argv += ["taskset", "-c",
+                         str(cpus[int(wid[1:]) % len(cpus)])]
+            argv += [sys.executable, "-m", "tpulsar.cli",
+                     "--config", cfg_file,
+                     "serve", "--spool", spool, "--worker-id", wid,
+                     "--once", "--no-warmstart"]
+            return argv
+
+        t0 = time.time()
+        ctrl = FleetController(
+            spool, workers=nworkers, once=True, poll_s=0.2,
+            max_worker_restarts=1, worker_env=worker_env,
+            worker_cmd=worker_cmd)
+        rc = ctrl.run()
+        wall = round(time.time() - t0, 3)
+        done = [r for r in (protocol.read_result(spool, t)
+                            for t in tickets)
+                if r and r.get("status") == "done"]
+        rec: dict = {"nworkers": nworkers, "rc": rc,
+                     "beams_done": len(done),
+                     "controller_wallclock_s": wall}
+        if done:
+            def span_bps(recs):
+                starts = [r["finished_at"]
+                          - r.get("beam_seconds", 0.0) for r in recs]
+                span = (max(r["finished_at"] for r in recs)
+                        - min(starts))
+                return round(span, 3), round(
+                    len(recs) / max(1e-9, span), 4)
+
+            rec["serving_span_s"], rec["aggregate_beams_per_s"] = \
+                span_bps(done)
+            by_worker: dict[str, list] = {}
+            for r in sorted(done, key=lambda r: r["finished_at"]):
+                by_worker.setdefault(r.get("worker", "?"),
+                                     []).append(r)
+            rec["per_worker_beam_s"] = {
+                w: [round(r.get("beam_seconds", 0.0), 3) for r in rs]
+                for w, rs in by_worker.items()}
+            # the warm regime: drop each worker's FIRST beam — it
+            # pays the per-process jit traces a resident fleet
+            # amortizes over days; steady-state throughput is what
+            # scale-out buys
+            rec["per_worker_warm_steady_s"] = {
+                w: round(statistics.median(
+                    [r.get("beam_seconds", 0.0) for r in rs[1:]]), 3)
+                for w, rs in by_worker.items() if len(rs) > 1}
+            warm = [r for rs in by_worker.values() for r in rs[1:]]
+            if warm:
+                rec["warm_span_s"], \
+                    rec["aggregate_warm_beams_per_s"] = span_bps(warm)
+        return rec
+
+    def host_ceiling() -> dict:
+        """Measure what 2-process scaling THIS host can physically
+        deliver for jax CPU work (one fixed FFT loop, single vs two
+        pinned copies).  On a dedicated 2-core box this reads ~2.0;
+        on a noisy/sandboxed host it can be ~1.0 — and no fleet can
+        scale past it, so the fleet speedup below is reported
+        alongside this ceiling rather than pretending the host is
+        quiet."""
+        probe = os.path.join(base, "probe.py")
+        with open(probe, "w") as fh:
+            fh.write(
+                "import os, time\n"
+                "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                "import jax, jax.numpy as jnp\n"
+                "f = jax.jit(lambda x: jnp.fft.rfft(x, axis=-1)"
+                ".real.sum())\n"
+                "x = jnp.ones((512, 4096), jnp.float32)\n"
+                "f(x).block_until_ready()\n"
+                "t0 = time.time(); n = 0\n"
+                "while time.time() - t0 < 6.0:\n"
+                "    f(x).block_until_ready(); n += 1\n"
+                "print(n)\n")
+        import subprocess as sp
+
+        cpus = _usable_cpus()
+
+        def spawn(slot):
+            argv = ([]
+                    if os.environ.get("TPULSAR_FLEET_PIN", "1") == "0"
+                    else ["taskset", "-c",
+                          str(cpus[slot % len(cpus)])])
+            return sp.Popen(argv + [sys.executable, probe],
+                            stdout=sp.PIPE, text=True)
+
+        def iters(proc):
+            out, _ = proc.communicate(timeout=120)
+            return int(out.strip().splitlines()[-1])
+
+        # bracket the dual measurement with two singles: host
+        # capacity drifts minute-to-minute, and a capacity swing
+        # between the single and dual phases would fake (or mask)
+        # scaling in the probe exactly as it would in the fleet run
+        single_a = iters(spawn(0))
+        pair = [spawn(0), spawn(1)]
+        dual = sum(iters(p) for p in pair)
+        single_b = iters(spawn(0))
+        import statistics as _st
+        single = _st.median([single_a, single_b])
+        return {"single_iters": [single_a, single_b],
+                "dual_iters": dual,
+                "scaling": round(dual / max(1, single), 2)}
+
+    _log("probing the host's 2-process jax scaling ceiling ...")
+    ceiling = host_ceiling()
+    _log(f"host ceiling: {ceiling['scaling']}x")
+
+    # the 1-worker baseline is measured BOTH before and after the
+    # 2-worker run: this (noisy, shared) host's capacity drifts on
+    # the minutes timescale, and bracketing the fleet run keeps a
+    # capacity swing from masquerading as (or hiding) scaling
+    one = run_config(1, "1a")
+    two = run_config(2, "2")
+    one_b = run_config(1, "1b")
+    steadies = [s for r in (one, one_b)
+                for s in (r.get("per_worker_warm_steady_s") or {}
+                          ).values()]
+    steady1 = statistics.median(steadies) if steadies else None
+    two_warm = two.get("aggregate_warm_beams_per_s")
+    result = {
+        "metric": "fleet_aggregate_warm_beams_per_s",
+        "value": two_warm if two_warm else -1.0,
+        "unit": "beams/s",
+        "fleet": {
+            "nbeams": nbeams, "nchan": nchan, "nsamp": nsamp,
+            "dm_max": dm_max,
+            "one_worker": one, "two_worker": two,
+            "one_worker_post": one_b,
+            "host_parallel_ceiling": ceiling,
+        },
+    }
+    if steady1 and two_warm:
+        # the headline contrast: 2-worker warm aggregate throughput
+        # vs the 1-worker warm steady state expressed as beams/s
+        result["fleet"]["one_worker_warm_beams_per_s"] = round(
+            1.0 / steady1, 4)
+        speedup = round(two_warm * steady1, 2)
+        result["fleet"]["speedup_vs_one_worker_warm"] = speedup
+        if ceiling.get("scaling"):
+            # ~1.0 means the fleet layer added no overhead on top of
+            # whatever parallelism the host could physically give
+            result["fleet"]["scaling_efficiency_vs_host_ceiling"] = \
+                round(speedup / ceiling["scaling"], 2)
+    one_aggs = [r["aggregate_warm_beams_per_s"]
+                for r in (one, one_b)
+                if r.get("aggregate_warm_beams_per_s")]
+    if one_aggs and two_warm:
+        result["fleet"]["speedup_vs_one_worker_aggregate"] = round(
+            two_warm / statistics.median(one_aggs), 2)
+    _emit(result)
+    if os.environ.get("TPULSAR_FLEET_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _acquire_campaign_lock() -> "object | None":
     """Serialize chip access with tools/tpu_campaign.sh via its
     .campaign.lock flock.  Two clients of the single axon chip corrupt
@@ -1145,6 +1399,9 @@ def main() -> None:
         return
     if "--serve" in sys.argv:
         run_serve()
+        return
+    if "--fleet" in sys.argv:
+        run_fleet()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

@@ -1,0 +1,83 @@
+"""A minimal spool worker for fleet-controller tests.
+
+Speaks the full serve/protocol.py contract — per-worker heartbeat,
+claim-by-rename, durable result before claim release, graceful drain
+on SIGTERM with attempt-neutral requeue — WITHOUT importing jax or
+running a real search, so controller tests (spawn, restart budget,
+janitor work-stealing, quarantine, rolling restart, drain) run in
+milliseconds per beam.  Crash behavior is a hard ``os._exit(70)``
+after claiming the N-th ticket (``--crash-after``), which is exactly
+the footprint the ``fleet.worker`` fault point leaves in the real
+server: claim in place, no result, no drain.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tpulsar.serve import protocol  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--spool", required=True)
+    p.add_argument("--worker-id", required=True)
+    p.add_argument("--beam-s", type=float, default=0.05)
+    p.add_argument("--depth", type=int, default=4)
+    p.add_argument("--crash-after", type=int, default=0,
+                   help="os._exit(70) right after claiming the N-th "
+                        "ticket (0 = never crash)")
+    p.add_argument("--exit-rc", type=int, default=-1,
+                   help="exit immediately with this rc (spawn-crash "
+                        "simulation; -1 = serve normally)")
+    p.add_argument("--once", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.exit_rc >= 0:
+        return args.exit_rc
+
+    draining = []
+    signal.signal(signal.SIGTERM, lambda *a: draining.append(1))
+    signal.signal(signal.SIGINT, lambda *a: draining.append(1))
+
+    def beat(status="running"):
+        protocol.write_heartbeat(
+            args.spool, worker_id=args.worker_id, status=status,
+            queue_depth=protocol.pending_count(args.spool),
+            max_queue_depth=args.depth)
+
+    beat()
+    claims = 0
+    while not draining:
+        rec = protocol.claim_next_ticket(args.spool, args.worker_id)
+        if rec is None:
+            if args.once and protocol.pending_count(args.spool) == 0 \
+                    and protocol.claimed_count(args.spool) == 0:
+                break
+            beat()
+            time.sleep(0.02)
+            continue
+        claims += 1
+        if args.crash_after and claims >= args.crash_after:
+            os._exit(70)
+        time.sleep(args.beam_s)
+        protocol.write_result(
+            args.spool, rec["ticket"], "done", rc=0,
+            beam_seconds=args.beam_s, warm=True,
+            worker=args.worker_id,
+            attempts=rec.get("attempts", 0),
+            outdir=rec.get("outdir", ""))
+        beat()
+    if draining:
+        protocol.requeue_own_claims(args.spool)
+    beat("stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

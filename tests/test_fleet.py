@@ -1,0 +1,548 @@
+"""Fleet-serving tests: worker-aware work-stealing requeue, attempts
+counting + poisoned-beam quarantine, exactly-once claims under
+multi-process contention, aggregate admission control, and the
+controller's spawn/restart/janitor/drain/rolling-restart machinery
+(driven against tests/fleet_stub_worker.py — a protocol-faithful
+worker with millisecond beams and deterministic crashes)."""
+
+import json
+import multiprocessing
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpulsar.fleet import controller as fleet_ctl
+from tpulsar.orchestrate.queue_managers.warm import WarmServerManager
+from tpulsar.resilience import faults
+from tpulsar.serve import protocol
+from tpulsar.serve.server import SearchServer
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fleet_stub_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    yield
+    faults.reset()
+
+
+def _dead_pid() -> int:
+    p = subprocess.Popen(["true"])
+    p.wait()                                  # reaped: pid is dead
+    return p.pid
+
+
+def _reclaim(spool, tid, owner, worker=""):
+    """Forge a claim owned by `owner` (a pid) on a claimed ticket."""
+    path = protocol.ticket_path(spool, tid, "claimed")
+    rec = json.load(open(path))
+    rec["claimed_by"] = owner
+    if worker:
+        rec["claimed_by_worker"] = worker
+    protocol._atomic_write_json(path, rec)
+
+
+def _stub_cmd(spool, extra=()):
+    def cmd(wid):
+        return [sys.executable, STUB, "--spool", spool,
+                "--worker-id", wid, *extra]
+    return cmd
+
+
+# ----------------------------------------------------------- protocol
+
+def test_ticket_carries_attempts_and_worker_claim(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/a.fits"], "/o", job_id=1)
+    rec = json.load(open(protocol.ticket_path(spool, "t1",
+                                              "incoming")))
+    assert rec["attempts"] == 0
+    claimed = protocol.claim_next_ticket(spool, "w3")
+    assert claimed["claimed_by"] == os.getpid()
+    assert claimed["claimed_by_worker"] == "w3"
+
+
+def test_dead_owner_requeue_counts_attempts_then_quarantines(tmp_path):
+    """A crash-shaped requeue increments attempts; at the cap the
+    beam is quarantined and failed into done/ with reason
+    max_attempts — no worker ever claims it again."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "bad", ["/x"], "/o", job_id=1)
+
+    # crash 1: requeued with one strike
+    protocol.claim_next_ticket(spool, "w0")
+    _reclaim(spool, "bad", _dead_pid(), "w0")
+    assert protocol.requeue_stale_claims(spool, max_attempts=2) \
+        == ["bad"]
+    rec = json.load(open(protocol.ticket_path(spool, "bad",
+                                              "incoming")))
+    assert rec["attempts"] == 1
+    assert "claimed_by" not in rec and "claimed_by_worker" not in rec
+
+    # crash 2 reaches the cap: quarantined, not requeued
+    protocol.claim_next_ticket(spool, "w1")
+    _reclaim(spool, "bad", _dead_pid(), "w1")
+    assert protocol.requeue_stale_claims(spool, max_attempts=2) == []
+    assert protocol.list_tickets(spool, "quarantine") == ["bad"]
+    assert protocol.pending_count(spool) == 0
+    result = protocol.read_result(spool, "bad")
+    assert result["status"] == "failed"
+    assert result["reason"] == "max_attempts"
+    assert result["attempts"] == 2
+    assert protocol.ticket_state(spool, "bad") == "done"
+    # nothing left to claim
+    assert protocol.claim_next_ticket(spool, "w2") is None
+
+
+def test_requeue_leaves_live_coworker_claims_alone(tmp_path):
+    """Work stealing must only steal from the dead: a claim owned by
+    a live co-worker pid survives every janitor pass untouched."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "live", ["/x"], "/o", job_id=1)
+    time.sleep(0.01)
+    protocol.write_ticket(spool, "orphan", ["/y"], "/o2", job_id=2)
+    protocol.claim_next_ticket(spool, "wa")
+    protocol.claim_next_ticket(spool, "wb")
+    live = subprocess.Popen(["sleep", "5"])
+    try:
+        _reclaim(spool, "live", live.pid, "wa")
+        _reclaim(spool, "orphan", _dead_pid(), "wb")
+        assert protocol.requeue_stale_claims(spool) == ["orphan"]
+        assert protocol.ticket_state(spool, "live") == "claimed"
+        assert protocol.ticket_state(spool, "orphan") == "incoming"
+    finally:
+        live.kill()
+        live.wait()
+
+
+def test_requeue_own_claims_is_attempt_neutral(tmp_path):
+    """A graceful drain returns unstarted beams without a strike —
+    only crash-shaped (dead-owner) requeues count attempts."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    assert protocol.requeue_own_claims(spool) == ["t1"]
+    rec = json.load(open(protocol.ticket_path(spool, "t1",
+                                              "incoming")))
+    assert rec["attempts"] == 0
+    assert "claimed_by" not in rec
+
+
+def test_abandoned_takeover_is_recovered(tmp_path):
+    """A janitor that died mid-requeue leaves <tid>.json.takeover.<pid>;
+    the next janitor pass restores and requeues it — tickets are never
+    lost to a crashed janitor."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    _reclaim(spool, "t1", _dead_pid())
+    src = protocol.ticket_path(spool, "t1", "claimed")
+    os.rename(src, f"{src}.takeover.{_dead_pid()}")
+    assert protocol.ticket_state(spool, "t1") == "claimed"
+    assert protocol.claimed_count(spool) == 1   # takeover still counts
+    assert protocol.requeue_stale_claims(spool) == ["t1"]
+    assert protocol.ticket_state(spool, "t1") == "incoming"
+
+
+def test_stale_takeover_never_clobbers_a_moved_on_ticket(tmp_path):
+    """A dead janitor's takeover file whose ticket was ALREADY
+    requeued (and possibly re-claimed by a live worker) is a stale
+    duplicate: recovery must delete it, not rename it over the live
+    claim (which would fork the ticket into double processing)."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    src = protocol.ticket_path(spool, "t1", "claimed")
+    # dead janitor took the claim over AND finished the incoming
+    # write, but died before unlinking its takeover file
+    stale = f"{src}.takeover.{_dead_pid()}"
+    os.rename(src, stale)
+    rec = json.load(open(stale))
+    rec.pop("claimed_by", None)
+    protocol._atomic_write_json(
+        protocol.ticket_path(spool, "t1", "incoming"), rec)
+    # a live co-worker (a real foreign pid) re-claims the ticket
+    reclaimed = protocol.claim_next_ticket(spool, "w1")
+    assert reclaimed["claimed_by_worker"] == "w1"
+    live_proc = subprocess.Popen(["sleep", "5"])
+    try:
+        _reclaim(spool, "t1", live_proc.pid, "w1")
+        protocol.requeue_stale_claims(spool)
+        # the live claim survived; the stale takeover is gone;
+        # exactly one copy of the ticket exists
+        assert not os.path.exists(stale)
+        live = json.load(open(src))
+        assert live["claimed_by_worker"] == "w1"
+        assert protocol.pending_count(spool) == 0
+        assert protocol.claimed_count(spool) == 1
+    finally:
+        live_proc.kill()
+        live_proc.wait()
+
+
+def _claim_worker(spool, wid, outfile):
+    got = []
+    while True:
+        rec = protocol.claim_next_ticket(spool, wid)
+        if rec is None:
+            break
+        got.append(rec["ticket"])
+    with open(outfile, "w") as fh:
+        json.dump(got, fh)
+
+
+def test_concurrent_claims_exactly_once(tmp_path):
+    """The invariant the whole fleet rests on: N processes hammering
+    claim_next_ticket on one spool, every ticket claimed EXACTLY once
+    (rename is exclusive)."""
+    spool = str(tmp_path / "spool")
+    tickets = [f"t{i:03d}" for i in range(24)]
+    for tid in tickets:
+        protocol.write_ticket(spool, tid, ["/x"], "/o", job_id=0)
+    nproc = 4
+    ctx = multiprocessing.get_context("fork")
+    outfiles = [str(tmp_path / f"claims{i}.json")
+                for i in range(nproc)]
+    procs = [ctx.Process(target=_claim_worker,
+                         args=(spool, f"w{i}", outfiles[i]))
+             for i in range(nproc)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=60)
+        assert p.exitcode == 0
+    per_proc = [json.load(open(f)) for f in outfiles]
+    all_claims = [t for claims in per_proc for t in claims]
+    assert sorted(all_claims) == sorted(tickets)      # none lost
+    assert len(all_claims) == len(set(all_claims))    # none doubled
+    assert protocol.pending_count(spool) == 0
+
+
+# ------------------------------------------------- heartbeats/admission
+
+def test_fleet_capacity_aggregates_fresh_workers(tmp_path):
+    spool = str(tmp_path / "spool")
+    assert protocol.fleet_capacity(spool) is None     # no workers
+    protocol.write_heartbeat(spool, worker_id="w0", status="running",
+                             max_queue_depth=3)
+    protocol.write_heartbeat(spool, worker_id="w1", status="running",
+                             max_queue_depth=2)
+    protocol.write_heartbeat(spool, worker_id="w2", status="draining",
+                             max_queue_depth=8)      # not counted
+    protocol._atomic_write_json(                     # long dead
+        protocol.heartbeat_path(spool, "w3"),
+        {"t": time.time() - 9999, "pid": 1, "worker": "w3",
+         "status": "running", "max_queue_depth": 8})
+    assert set(protocol.fresh_workers(spool)) == {"w0", "w1"}
+    assert protocol.heartbeat_fresh(spool)
+    assert protocol.fleet_capacity(spool) == 5
+    protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    protocol.write_ticket(spool, "t2", ["/y"], "/o")
+    assert protocol.fleet_capacity(spool) == 3
+
+
+def test_warm_backend_aggregate_admission_and_load_shed(tmp_path):
+    """can_submit scales with the number of fresh workers; the local
+    fallback is used only when ZERO workers are fresh."""
+    spool = str(tmp_path / "spool")
+    protocol.write_heartbeat(spool, worker_id="w0", status="running",
+                             max_queue_depth=2)
+    protocol.write_heartbeat(spool, worker_id="w1", status="running",
+                             max_queue_depth=2)
+    qm = WarmServerManager(
+        spool=spool, max_queue_depth=2,
+        fallback_kwargs={"state_dir": str(tmp_path / "localq")})
+    for i in range(4):                  # 2 workers x depth 2
+        assert qm.can_submit()
+        qm.submit(["/a.fits"], str(tmp_path / f"o{i}"), i)
+    assert not qm.can_submit()          # full fleet: backpressure
+    # one worker drains: capacity shrinks but no load-shed (w1 fresh)
+    protocol.write_heartbeat(spool, worker_id="w0", status="draining",
+                             max_queue_depth=2)
+    assert qm.server_available()
+    # zero fresh: load-shed to the embedded local manager
+    protocol.write_heartbeat(spool, worker_id="w1", status="stopped",
+                             max_queue_depth=2)
+    assert not qm.server_available()
+    assert qm.can_submit() == qm.fallback.can_submit()
+
+
+# ------------------------------------------------------- server hooks
+
+@pytest.fixture()
+def cfg(tmp_path):
+    from tpulsar.config import TpulsarConfig, set_settings
+
+    cfg = TpulsarConfig()
+    cfg.basic.log_dir = str(tmp_path / "logs")
+    cfg.background.jobtracker_db = str(tmp_path / "jt.db")
+    cfg.download.datadir = str(tmp_path / "raw")
+    cfg.processing.base_working_directory = str(tmp_path / "work")
+    cfg.processing.base_results_directory = str(tmp_path / "res")
+    cfg.resultsdb.url = str(tmp_path / "results.db")
+    cfg.check_sanity(create_dirs=True)
+    set_settings(cfg)
+    yield cfg
+    set_settings(TpulsarConfig())
+
+
+def _beam_files(tmp_path, n=1):
+    from tpulsar.io import synth
+    out = []
+    for i in range(n):
+        spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64,
+                              scan=100 + i)
+        out.append(synth.synth_beam(str(tmp_path / f"data{i}"), spec,
+                                    merged=True))
+    return out
+
+
+def test_server_worker_identity_and_result_stamp(tmp_path, cfg):
+    import types
+    spool = tmp_path / "spool"
+    (fns,) = _beam_files(tmp_path, 1)
+    protocol.write_ticket(str(spool), "t0", fns,
+                          str(tmp_path / "out"), job_id=0)
+    outcome = types.SimpleNamespace(compile_misses=0, compile_hits=1,
+                                    candidates=[], num_dm_trials=4)
+    srv = SearchServer(spool=str(spool), cfg=cfg, worker_id="w7",
+                       warm_boot=False, poll_s=0.05,
+                       beam_fn=lambda p: outcome)
+    assert srv.serve(once=True) == 0
+    hb = protocol.read_heartbeat(str(spool), "w7")
+    assert hb["worker"] == "w7" and hb["status"] == "stopped"
+    assert os.path.exists(os.path.join(str(spool), "server.w7.json"))
+    rec = protocol.read_result(str(spool), "t0")
+    assert rec["worker"] == "w7" and rec["attempts"] == 0
+
+
+def test_server_fleet_worker_fault_crashes_not_fails(tmp_path, cfg):
+    """The fleet.worker fault point must look like a CRASH: hard exit
+    with the claim in place and no result record — not a handled
+    per-beam failure."""
+    spool = tmp_path / "spool"
+    (fns,) = _beam_files(tmp_path, 1)
+    protocol.write_ticket(str(spool), "t0", fns,
+                          str(tmp_path / "out"), job_id=0)
+    faults.configure("fleet.worker:unimplemented:count=1")
+    crashes = []
+    srv = SearchServer(spool=str(spool), cfg=cfg, worker_id="w0",
+                       warm_boot=False, poll_s=0.05,
+                       beam_fn=lambda p: pytest.fail(
+                           "beam ran after the crash point"))
+
+    def fake_exit(rc):
+        crashes.append(rc)
+        srv.request_drain()          # stand-in for process death
+    srv._crash = fake_exit
+    srv.serve(once=True)
+    assert crashes == [70]
+    assert faults.fired("fleet.worker") == 1
+    assert protocol.read_result(str(spool), "t0") is None
+    # the drain stand-in requeued it; a REAL crash leaves it claimed
+    # for the janitor — either way there is no result record
+    assert protocol.ticket_state(str(spool), "t0") in ("incoming",
+                                                       "claimed")
+
+
+def test_server_drain_requeues_staged_handoff_beams(tmp_path, cfg):
+    """Satellite: at drain the prefetch thread is joined and beams it
+    already staged into the handoff queue are requeued (attempt-
+    neutral), not stranded in claimed/."""
+    import types
+    spool = tmp_path / "spool"
+    beams = _beam_files(tmp_path, 4)
+    for i, fns in enumerate(beams):
+        protocol.write_ticket(str(spool), f"d{i}", fns,
+                              str(tmp_path / f"out{i}"), job_id=i)
+    started = threading.Event()
+
+    def slow(prepared):
+        started.set()
+        time.sleep(0.7)
+        return types.SimpleNamespace(compile_misses=0, compile_hits=0,
+                                     candidates=[], num_dm_trials=4)
+
+    srv = SearchServer(spool=str(spool), cfg=cfg, warm_boot=False,
+                       poll_s=0.05, prefetch_depth=2, beam_fn=slow)
+    th = threading.Thread(target=srv.serve, daemon=True)
+    th.start()
+    assert started.wait(timeout=20.0)
+    time.sleep(0.3)          # let the prefetch thread stage ahead
+    srv.request_drain()
+    th.join(timeout=30.0)
+    assert not th.is_alive()
+    assert protocol.list_tickets(str(spool), "claimed") == []
+    done = protocol.list_tickets(str(spool), "done")
+    incoming = protocol.list_tickets(str(spool), "incoming")
+    assert len(done) + len(incoming) == 4
+    assert len(done) >= 1            # the in-flight beam finished
+    for tid in incoming:             # requeues carried no strike
+        rec = json.load(open(protocol.ticket_path(str(spool), tid,
+                                                  "incoming")))
+        assert rec["attempts"] == 0
+
+
+# ----------------------------------------------------- the controller
+
+def _controller(spool, **kw):
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("restart_backoff_s", 0.05)
+    kw.setdefault("drain_timeout_s", 20.0)
+    return fleet_ctl.FleetController(spool, **kw)
+
+
+def test_controller_drains_spool_with_two_workers(tmp_path):
+    spool = str(tmp_path / "spool")
+    tickets = [f"t{i}" for i in range(8)]
+    for tid in tickets:
+        protocol.write_ticket(spool, tid, ["/x"], "/o", job_id=0)
+        time.sleep(0.002)
+    ctrl = _controller(
+        spool, workers=2, once=True,
+        worker_cmd=_stub_cmd(spool, ("--once", "--beam-s", "0.15")))
+    assert ctrl.run() == 0
+    recs = [protocol.read_result(spool, t) for t in tickets]
+    assert all(r and r["status"] == "done" for r in recs)
+    # the work really spread across the fleet
+    assert {r["worker"] for r in recs} == {"w0", "w1"}
+    fleet = json.load(open(os.path.join(spool, "fleet.json")))
+    assert fleet["status"] == "stopped"
+    assert fleet["done"] == 8 and fleet["pending"] == 0
+    assert {w["id"] for w in fleet["workers"]} == {"w0", "w1"}
+    assert os.path.exists(os.path.join(spool, "fleet.prom"))
+
+
+def test_controller_crash_recovery_exactly_once(tmp_path):
+    """The acceptance scenario: one of two workers crashes mid-beam;
+    every submitted beam still ends with exactly one done result, and
+    the victim's beam is finished by the surviving worker."""
+    spool = str(tmp_path / "spool")
+    tickets = [f"t{i}" for i in range(6)]
+    for tid in tickets:
+        protocol.write_ticket(spool, tid, ["/x"], "/o", job_id=0)
+        time.sleep(0.002)
+
+    def cmd(wid):
+        extra = ("--crash-after", "1") if wid == "w0" else ()
+        return [sys.executable, STUB, "--spool", spool,
+                "--worker-id", wid, "--once", "--beam-s", "0.1",
+                *extra]
+
+    ctrl = _controller(spool, workers=2, once=True, worker_cmd=cmd,
+                       max_worker_restarts=0, ticket_max_attempts=3)
+    assert ctrl.run() == 0
+    recs = [protocol.read_result(spool, t) for t in tickets]
+    assert all(r and r["status"] == "done" for r in recs)
+    assert len({r["ticket"] for r in recs}) == 6      # exactly once
+    crashed = [r for r in recs if r["attempts"] > 0]
+    assert crashed                    # the victim's beam was retried
+    assert all(r["worker"] == "w1" for r in crashed)  # ...elsewhere
+    assert protocol.list_tickets(spool, "claimed") == []
+    assert protocol.list_tickets(spool, "quarantine") == []
+    fleet = json.load(open(os.path.join(spool, "fleet.json")))
+    w0 = next(w for w in fleet["workers"] if w["id"] == "w0")
+    assert w0["gave_up"] and w0["last_rc"] == 70
+
+
+def test_controller_restart_budget_backoff(tmp_path):
+    """A worker that cannot stay up is restarted under the backoff
+    budget, then left down — the controller does not thrash."""
+    spool = str(tmp_path / "spool")
+    ctrl = _controller(
+        spool, workers=1, once=True, max_worker_restarts=2,
+        worker_cmd=_stub_cmd(spool, ("--exit-rc", "1")))
+    assert ctrl.run() == 0            # empty spool: nothing stranded
+    fleet = json.load(open(os.path.join(spool, "fleet.json")))
+    w0 = fleet["workers"][0]
+    assert w0["crash_restarts"] == 2 and w0["gave_up"]
+    assert w0["incarnation"] == 3     # initial spawn + 2 restarts
+
+
+def test_controller_quarantines_crash_looping_beam(tmp_path):
+    """A beam that kills its worker on every attempt lands in
+    quarantine after max_attempts and the fleet moves on (exit 0,
+    failed result with reason max_attempts)."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "poison", ["/x"], "/o", job_id=0)
+    ctrl = _controller(
+        spool, workers=1, once=True, max_worker_restarts=5,
+        ticket_max_attempts=2,
+        worker_cmd=_stub_cmd(spool, ("--once", "--crash-after", "1",
+                                     "--beam-s", "0.05")))
+    assert ctrl.run() == 0
+    assert protocol.list_tickets(spool, "quarantine") == ["poison"]
+    rec = protocol.read_result(spool, "poison")
+    assert rec["status"] == "failed"
+    assert rec["reason"] == "max_attempts" and rec["attempts"] == 2
+    assert protocol.pending_count(spool) == 0
+    assert protocol.list_tickets(spool, "claimed") == []
+
+
+def test_controller_rolling_restart_and_drain_control(tmp_path):
+    """fleet.ctl drives a running controller: rolling-restart cycles
+    workers one at a time (new pids, fresh heartbeats, no crash
+    budget spent), drain stops the fleet."""
+    spool = str(tmp_path / "spool")
+    ctrl = _controller(spool, workers=2,
+                       worker_cmd=_stub_cmd(spool, ("--beam-s",
+                                                    "0.01")))
+    th = threading.Thread(target=ctrl.run, daemon=True)
+    th.start()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            fleet = fleet_ctl.protocol._read_json(
+                os.path.join(spool, "fleet.json")) or {}
+            if fleet and all(w["state"] == "fresh"
+                             for w in fleet["workers"]):
+                break
+            time.sleep(0.05)
+        pids0 = {w["id"]: w["pid"] for w in fleet["workers"]}
+        assert len(pids0) == 2
+
+        fleet_ctl.write_control(spool, "rolling-restart")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            fleet = fleet_ctl.protocol._read_json(
+                os.path.join(spool, "fleet.json")) or {}
+            ws = fleet.get("workers", [])
+            if ws and all(w["incarnation"] == 2
+                          and w["state"] == "fresh" for w in ws):
+                break
+            time.sleep(0.05)
+        assert all(w["incarnation"] == 2 for w in fleet["workers"])
+        assert all(w["crash_restarts"] == 0
+                   for w in fleet["workers"])
+        pids1 = {w["id"]: w["pid"] for w in fleet["workers"]}
+        assert all(pids1[wid] != pids0[wid] for wid in pids0)
+
+        fleet_ctl.write_control(spool, "drain")
+        th.join(timeout=30.0)
+        assert not th.is_alive()
+    finally:
+        ctrl.request_drain()
+        th.join(timeout=30.0)
+    fleet = json.load(open(os.path.join(spool, "fleet.json")))
+    assert fleet["status"] == "stopped"
+    for wid in ("w0", "w1"):
+        hb = protocol.read_heartbeat(spool, wid)
+        assert hb["status"] == "stopped"
+
+
+def test_fleet_cli_status_and_control(tmp_path, capsys):
+    from tpulsar.cli.main import main as cli_main
+    spool = str(tmp_path / "spool")
+    protocol.write_heartbeat(spool, worker_id="w0", status="running",
+                             max_queue_depth=4)
+    assert cli_main(["fleet", "--status", "--spool", spool]) == 0
+    out = capsys.readouterr().out
+    assert "w0" in out and "fresh" in out
+    assert cli_main(["fleet", "--drain", "--spool", spool]) == 0
+    assert fleet_ctl.read_control(spool) == "drain"
+    assert fleet_ctl.read_control(spool) is None      # consumed

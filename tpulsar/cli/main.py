@@ -266,14 +266,17 @@ def cmd_serve(args):
     cfg = settings()
     server = SearchServer(
         spool=args.spool or _serve_spool(cfg), cfg=cfg,
+        worker_id=args.worker_id,
         max_queue_depth=cfg.jobpooler.serve_queue_depth,
         beam_deadline_s=args.beam_deadline,
+        ticket_max_attempts=cfg.jobpooler.serve_max_attempts,
         warm_boot=not args.no_warmstart,
         warm_boot_scale=args.warmstart_scale,
         prefetch_depth=args.prefetch_depth)
     server.install_signal_handlers()
     print(f"serve: spool {server.spool} "
-          f"(depth {server.max_queue_depth}, "
+          + (f"worker {args.worker_id} " if args.worker_id else "")
+          + f"(depth {server.max_queue_depth}, "
           f"warm boot {'on' if server.warm_boot else 'off'}"
           + (f", beam deadline {args.beam_deadline:g} s"
              if args.beam_deadline else "") + ")")
@@ -281,6 +284,44 @@ def cmd_serve(args):
         rc = server.serve(once=args.once)
     finally:
         _export_metrics("serve")
+    return rc
+
+
+def cmd_fleet(args):
+    """Multi-worker serving fleet (tpulsar/fleet/): a controller
+    spawning/supervising N `serve` workers on one spool — or, with
+    --status/--drain/--rolling-restart, talk to the running fleet
+    through its spool."""
+    from tpulsar.config import settings
+    from tpulsar.fleet import controller as fleet_ctl
+
+    cfg = settings()
+    spool = args.spool or _serve_spool(cfg)
+    if args.status:
+        print(fleet_ctl.render_status(spool))
+        return 0
+    if args.drain:
+        path = fleet_ctl.write_control(spool, "drain")
+        print(f"fleet: drain requested ({path})")
+        return 0
+    if args.rolling_restart:
+        path = fleet_ctl.write_control(spool, "rolling-restart")
+        print(f"fleet: rolling restart requested ({path})")
+        return 0
+    nworkers = (args.workers if args.workers is not None
+                else cfg.jobpooler.fleet_workers)
+    ctrl = fleet_ctl.FleetController(
+        spool=spool, workers=nworkers, once=args.once,
+        max_worker_restarts=args.max_restarts,
+        ticket_max_attempts=cfg.jobpooler.serve_max_attempts,
+        worker_args=tuple(args.worker_arg))
+    print(f"fleet: {nworkers} worker(s) on spool {spool} "
+          f"(restart budget {args.max_restarts}, ticket attempts cap "
+          f"{cfg.jobpooler.serve_max_attempts})")
+    try:
+        rc = ctrl.run()
+    finally:
+        _export_metrics("fleet")
     return rc
 
 
@@ -901,7 +942,50 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--prefetch-depth", type=int, default=1,
                     help="beams the stage-in thread prepares ahead "
                          "of the device")
+    sp.add_argument("--worker-id", default="",
+                    help="fleet worker id: heartbeat goes to "
+                         "server.<id>.json and claims/results are "
+                         "stamped with it (empty = single-server "
+                         "server.json)")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "fleet",
+        help="multi-worker serving fleet: spawn/supervise N `serve` "
+             "workers on one spool (work-stealing claims, crash "
+             "restart with backoff budget, poisoned-beam quarantine, "
+             "rolling restart)")
+    sp.add_argument("--workers", type=int, default=None,
+                    help="worker count (default: "
+                         "jobpooler.fleet_workers; 0 = janitor/"
+                         "aggregator only, for externally-launched "
+                         "workers)")
+    sp.add_argument("--spool", default=None,
+                    help="spool dir (default: jobpooler.serve_spool "
+                         "or <base_working_directory>/.serve_spool)")
+    sp.add_argument("--once", action="store_true",
+                    help="exit 0 once the spool's tickets are all "
+                         "terminal (CI / cron mode; workers run "
+                         "serve --once)")
+    sp.add_argument("--status", action="store_true",
+                    help="print fleet health (heartbeats, spool "
+                         "counts, fleet.json) and exit")
+    sp.add_argument("--drain", action="store_true",
+                    help="ask the running controller to drain the "
+                         "fleet and exit")
+    sp.add_argument("--rolling-restart", action="store_true",
+                    dest="rolling_restart",
+                    help="ask the running controller to cycle "
+                         "workers one at a time (never fully cold)")
+    sp.add_argument("--max-restarts", type=int, default=5,
+                    help="crash-restart budget per worker before the "
+                         "controller leaves it down")
+    sp.add_argument("--worker-arg", action="append", default=[],
+                    metavar="ARG",
+                    help="extra argument passed to every `serve` "
+                         "worker (repeatable), e.g. "
+                         "--worker-arg=--no-warmstart")
+    sp.set_defaults(fn=cmd_fleet)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
 

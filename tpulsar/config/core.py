@@ -94,8 +94,13 @@ class JobPoolerConfig:
     serve_spool: str = ""                  # warm backend spool dir; ""
     #                                        = <base_working_directory>/
     #                                        .serve_spool
-    serve_queue_depth: int = 8             # warm admission-queue bound
-    #                                        (can_submit backpressure)
+    serve_queue_depth: int = 8             # per-worker admission-queue
+    #                                        share (can_submit sums it
+    #                                        over fresh workers)
+    serve_max_attempts: int = 3            # crash-shaped claims before
+    #                                        a beam is quarantined
+    fleet_workers: int = 2                 # default `tpulsar fleet`
+    #                                        worker count
 
 
 @dataclasses.dataclass
@@ -222,6 +227,10 @@ class TpulsarConfig:
                 f"{self.jobpooler.queue_manager!r}")
         if self.jobpooler.serve_queue_depth < 1:
             problems.append("jobpooler.serve_queue_depth must be >= 1")
+        if self.jobpooler.serve_max_attempts < 1:
+            problems.append("jobpooler.serve_max_attempts must be >= 1")
+        if self.jobpooler.fleet_workers < 1:
+            problems.append("jobpooler.fleet_workers must be >= 1")
         if (self.jobpooler.queue_manager == "tpu_slice"
                 and not self.jobpooler.tpu_hosts.strip()):
             problems.append(

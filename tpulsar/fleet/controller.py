@@ -1,0 +1,491 @@
+"""The fleet controller: spawn, supervise, and heal N spool workers.
+
+PR 4's resident server made one warm worker 3.5x faster per beam than
+fork-per-beam; this layer provides the horizontal axis — N worker
+processes pulling beams from ONE spool (the FAST drift-scan pipeline's
+many-PRESTO-workers-one-queue shape), supervised by one controller:
+
+  * spawn/monitor/restart — each worker is ``tpulsar serve
+    --worker-id wK`` on the shared spool; a crashed worker is
+    restarted under a resilience.policy backoff curve with a bounded
+    restart budget (a crash-looping worker eventually stays down
+    instead of thrashing the device);
+  * the janitor — ``requeue_stale_claims`` runs every loop, so a
+    ticket a dead worker held mid-beam returns to ``incoming`` within
+    seconds and any surviving worker steals it (exactly-once: claims
+    are exclusive renames, requeues take the claim file over
+    atomically, and results are durable before claims release);
+    beams that keep killing workers hit the ``attempts`` cap and are
+    quarantined;
+  * rolling drain-and-restart — workers are cycled ONE at a time
+    (SIGTERM -> wait for drain -> respawn -> wait for a fresh
+    heartbeat) so a compile-cache or binary upgrade never takes the
+    whole fleet cold;
+  * aggregation — fleet health (worker states, spool counts,
+    aggregate capacity) is written each loop to ``<spool>/fleet.json``
+    and ``<spool>/fleet.prom`` (the ``tpulsar_fleet_*`` catalog
+    metrics), which is what ``tpulsar fleet --status`` renders.
+
+Operators talk to a running controller through a control file in the
+spool (``fleet.ctl``): ``tpulsar fleet --drain`` / ``--rolling-restart``
+write it, the controller consumes it.  The controller itself drains on
+SIGTERM/SIGINT like its workers.
+
+``workers=0`` runs a pure janitor/aggregator over externally-launched
+workers — useful when the worker processes are managed elsewhere (CI,
+a cluster scheduler) but the spool still needs crash recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from tpulsar.obs import metrics, telemetry
+from tpulsar.obs.log import get_logger
+from tpulsar.resilience import policy
+from tpulsar.serve import protocol
+
+CONTROL_FILE = "fleet.ctl"
+FLEET_JSON = "fleet.json"
+FLEET_PROM = "fleet.prom"
+
+
+def write_control(spool: str, cmd: str) -> str:
+    """Leave a command for the running controller (drain |
+    rolling-restart).  Returns the control-file path."""
+    assert cmd in ("drain", "rolling-restart"), cmd
+    protocol.ensure_spool(spool)
+    path = os.path.join(spool, CONTROL_FILE)
+    protocol._atomic_write_json(path, {"cmd": cmd, "t": time.time(),
+                                       "by": os.getpid()})
+    return path
+
+
+def read_control(spool: str, consume: bool = True) -> str | None:
+    path = os.path.join(spool, CONTROL_FILE)
+    rec = protocol._read_json(path)
+    if rec is None:
+        return None
+    if consume:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return rec.get("cmd")
+
+
+class _Worker:
+    """One supervised worker slot (the process behind it comes and
+    goes across restarts; the slot and its id persist)."""
+
+    def __init__(self, worker_id: str):
+        self.worker_id = worker_id
+        self.proc: subprocess.Popen | None = None
+        self.pid: int | None = None
+        self.incarnation = 0
+        self.crash_restarts = 0
+        self.next_restart_at: float | None = None
+        self.gave_up = False
+        self.done = False            # exited 0 in once mode
+        self.last_rc: int | None = None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class FleetController:
+    def __init__(self, spool: str, workers: int = 2, *,
+                 worker_cmd=None, worker_env=None,
+                 worker_args: tuple[str, ...] = (),
+                 once: bool = False,
+                 max_worker_restarts: int = 5,
+                 restart_backoff_s: float = 1.0,
+                 restart_policy: policy.RetryPolicy | None = None,
+                 ticket_max_attempts: int =
+                 protocol.DEFAULT_MAX_ATTEMPTS,
+                 heartbeat_max_age_s: float =
+                 protocol.HEARTBEAT_MAX_AGE_S,
+                 poll_s: float = 1.0,
+                 drain_timeout_s: float = 120.0,
+                 logger=None, sleeper=time.sleep):
+        self.spool = protocol.ensure_spool(spool)
+        self.once = once
+        #: callable(worker_id) -> argv; the default launches the real
+        #: ``tpulsar serve`` worker (tests inject stubs)
+        self.worker_cmd = worker_cmd or self._default_worker_cmd
+        #: callable(worker_id) -> env-override dict (or None)
+        self.worker_env = worker_env
+        self.worker_args = tuple(worker_args)
+        #: restart-backoff budget: should_retry() bounds how many
+        #: crash restarts a worker slot gets, backoff_s() paces them
+        self.restart_policy = restart_policy or policy.RetryPolicy(
+            max_attempts=max(0, max_worker_restarts),
+            backoff_base_s=restart_backoff_s, backoff_mult=2.0,
+            backoff_max_s=60.0)
+        self.ticket_max_attempts = ticket_max_attempts
+        self.heartbeat_max_age_s = heartbeat_max_age_s
+        self.poll_s = poll_s
+        self.drain_timeout_s = drain_timeout_s
+        self.log = logger or get_logger("fleet")
+        self.sleeper = sleeper
+        self.workers = [_Worker(f"w{i}") for i in range(workers)]
+        self._drain = threading.Event()
+        self._quarantined_seen: set[str] = set()
+        self.started_at = time.time()
+
+    # ------------------------------------------------------------ control
+
+    def install_signal_handlers(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return
+        def _on_term(signum, frame):
+            self.log.info("signal %d: draining the fleet", signum)
+            self.request_drain()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _on_term)
+
+    def request_drain(self) -> None:
+        self._drain.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._drain.is_set()
+
+    # ------------------------------------------------------------ workers
+
+    def _default_worker_cmd(self, worker_id: str) -> list[str]:
+        argv = [sys.executable, "-m", "tpulsar.cli"]
+        cfgpath = os.environ.get("TPULSAR_CONFIG")
+        if cfgpath:
+            argv += ["--config", cfgpath]
+        argv += ["serve", "--spool", self.spool,
+                 "--worker-id", worker_id]
+        if self.once:
+            argv.append("--once")
+        argv += list(self.worker_args)
+        return argv
+
+    def _spawn(self, w: _Worker, kind: str = "start") -> None:
+        argv = self.worker_cmd(w.worker_id)
+        env = dict(os.environ)
+        if self.worker_env is not None:
+            env.update(self.worker_env(w.worker_id) or {})
+        logdir = os.path.join(self.spool, "workers")
+        os.makedirs(logdir, exist_ok=True)
+        logfh = open(os.path.join(logdir, f"{w.worker_id}.log"), "ab")
+        try:
+            w.proc = subprocess.Popen(argv, env=env, stdout=logfh,
+                                      stderr=subprocess.STDOUT)
+        finally:
+            logfh.close()        # the child holds its own fd now
+        w.pid = w.proc.pid
+        w.incarnation += 1
+        w.next_restart_at = None
+        self.log.info("%s worker %s (pid %d, incarnation %d)",
+                      kind, w.worker_id, w.pid, w.incarnation)
+
+    def _mark_worker_down(self, w: _Worker) -> None:
+        """Stamp a dead incarnation's heartbeat 'stopped' so the warm
+        backend's aggregate capacity stops counting it immediately
+        (its file would otherwise read fresh for up to the heartbeat
+        max age)."""
+        hb = protocol.read_heartbeat(self.spool, w.worker_id)
+        if hb is not None and hb.get("pid") == w.pid \
+                and hb.get("status") != "stopped":
+            hb["status"] = "stopped"
+            protocol._atomic_write_json(
+                protocol.heartbeat_path(self.spool, w.worker_id), hb)
+
+    def _reap(self) -> None:
+        for w in self.workers:
+            if w.proc is None or w.proc.poll() is None:
+                continue
+            rc = w.proc.returncode
+            w.proc = None
+            w.last_rc = rc
+            self._mark_worker_down(w)
+            if self.draining:
+                continue
+            if self.once and rc == 0:
+                w.done = True
+                self.log.info("worker %s finished (spool drained)",
+                              w.worker_id)
+                continue
+            if not self.restart_policy.should_retry(w.crash_restarts):
+                if not w.gave_up:
+                    w.gave_up = True
+                    self.log.error(
+                        "worker %s crashed (rc %s) with its restart "
+                        "budget exhausted (%d restarts) — leaving it "
+                        "down", w.worker_id, rc, w.crash_restarts)
+                continue
+            delay = self.restart_policy.backoff_s(w.crash_restarts)
+            w.crash_restarts += 1
+            w.next_restart_at = time.time() + delay
+            telemetry.fleet_restarts_total().inc(
+                worker=w.worker_id, kind="crash")
+            self.log.warning(
+                "worker %s crashed (rc %s); restart %d/%d in %.1f s",
+                w.worker_id, rc, w.crash_restarts,
+                self.restart_policy.max_attempts, delay)
+
+    def _respawn_due(self) -> None:
+        now = time.time()
+        for w in self.workers:
+            if (w.proc is None and not w.done and not w.gave_up
+                    and not self.draining
+                    and w.next_restart_at is not None
+                    and now >= w.next_restart_at):
+                self._spawn(w, kind="restart")
+
+    # ------------------------------------------------------------ janitor
+
+    def _janitor(self) -> None:
+        """Reclaim dead workers' orphaned claims (work stealing) and
+        account newly quarantined beams."""
+        requeued = protocol.requeue_stale_claims(
+            self.spool, self.ticket_max_attempts)
+        if requeued:
+            telemetry.fleet_requeued_total().inc(len(requeued))
+            self.log.warning(
+                "janitor requeued %d orphaned ticket(s): %s",
+                len(requeued), ", ".join(requeued))
+        for tid in protocol.list_tickets(self.spool, "quarantine"):
+            if tid not in self._quarantined_seen:
+                self._quarantined_seen.add(tid)
+                telemetry.fleet_quarantined_total().inc()
+                self.log.error(
+                    "beam %s QUARANTINED: repeatedly killed its "
+                    "worker (attempts cap %d)", tid,
+                    self.ticket_max_attempts)
+
+    # ---------------------------------------------------------- aggregate
+
+    def _worker_state(self, w: _Worker) -> str:
+        if not w.alive:
+            return "dead"
+        hb = protocol.read_heartbeat(self.spool, w.worker_id)
+        if hb is not None and hb.get("pid") == w.pid \
+                and protocol._hb_fresh(hb, self.heartbeat_max_age_s):
+            return "fresh"
+        return "stale"
+
+    def _aggregate(self, status: str = "running") -> dict:
+        heartbeats = protocol.list_heartbeats(self.spool)
+        states = {w.worker_id: self._worker_state(w)
+                  for w in self.workers}
+        for st in ("fresh", "stale", "dead"):
+            telemetry.fleet_workers().set(
+                sum(1 for s in states.values() if s == st), state=st)
+        cap = protocol.fleet_capacity(self.spool,
+                                      self.heartbeat_max_age_s)
+        telemetry.fleet_capacity().set(cap or 0)
+        rec = {
+            "t": time.time(),
+            "controller_pid": os.getpid(),
+            "status": status,
+            "started_at": self.started_at,
+            "workers": [{
+                "id": w.worker_id, "pid": w.pid, "alive": w.alive,
+                "state": states[w.worker_id],
+                "incarnation": w.incarnation,
+                "crash_restarts": w.crash_restarts,
+                "gave_up": w.gave_up, "last_rc": w.last_rc,
+                "heartbeat": heartbeats.get(w.worker_id),
+            } for w in self.workers],
+            "external_workers": sorted(
+                wid for wid in heartbeats
+                if wid not in states and wid != ""),
+            "pending": protocol.pending_count(self.spool),
+            "claimed": protocol.claimed_count(self.spool),
+            "done": len(protocol.list_tickets(self.spool, "done")),
+            "quarantined": len(protocol.list_tickets(self.spool,
+                                                     "quarantine")),
+            "capacity": cap,
+        }
+        try:
+            protocol._atomic_write_json(
+                os.path.join(self.spool, FLEET_JSON), rec)
+            metrics.REGISTRY.write_prom(
+                os.path.join(self.spool, FLEET_PROM))
+        except OSError:
+            pass         # a full disk must not take the fleet down
+        return rec
+
+    # ------------------------------------------------------ rolling restart
+
+    def _wait(self, pred, timeout: float) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if pred():
+                return True
+            self.sleeper(min(0.2, self.poll_s))
+        return pred()
+
+    def _rolling_restart(self) -> None:
+        """Cycle workers ONE at a time so the fleet never goes fully
+        cold: drain worker k, respawn it, wait for its fresh
+        heartbeat, only then move to worker k+1."""
+        self.log.info("rolling restart: %d worker(s)",
+                      len(self.workers))
+        for w in self.workers:
+            if self.draining:
+                return
+            if w.alive:
+                w.proc.send_signal(signal.SIGTERM)
+                if not self._wait(lambda: not w.alive,
+                                  self.drain_timeout_s):
+                    self.log.warning(
+                        "worker %s ignored SIGTERM for %.0f s; "
+                        "killing it", w.worker_id, self.drain_timeout_s)
+                    w.proc.kill()
+                    self._wait(lambda: not w.alive, 10.0)
+                w.last_rc = w.proc.returncode if w.proc else None
+                w.proc = None
+                self._mark_worker_down(w)
+            if w.done or w.gave_up:
+                continue
+            self._spawn(w, kind="rolling-restart")
+            telemetry.fleet_restarts_total().inc(
+                worker=w.worker_id, kind="rolling")
+            self._wait(
+                lambda: self._worker_state(w) == "fresh",
+                self.drain_timeout_s)
+            self._aggregate()
+
+    # ----------------------------------------------------------- the loop
+
+    def run(self) -> int:
+        """Supervise until drained (daemon) or the spool is fully
+        processed (once=True).  Returns 0 when every submitted beam
+        reached a terminal state (done/quarantined), 1 when the fleet
+        gave up with tickets still outstanding."""
+        protocol.ensure_spool(self.spool)
+        self.install_signal_handlers()
+        for w in self.workers:
+            self._spawn(w)
+        rc = 0
+        try:
+            while not self.draining:
+                self._reap()
+                self._respawn_due()
+                self._janitor()
+                cmd = read_control(self.spool)
+                if cmd == "drain":
+                    self.log.info("control file: drain")
+                    self.request_drain()
+                    break
+                if cmd == "rolling-restart":
+                    self._rolling_restart()
+                self._aggregate()
+                outstanding = (
+                    protocol.pending_count(self.spool)
+                    or protocol.claimed_count(self.spool))
+                if self.workers and all(
+                        w.done or w.gave_up for w in self.workers):
+                    if outstanding:
+                        if self.once:
+                            self.log.error(
+                                "every worker is done or gave up "
+                                "with tickets outstanding")
+                            rc = 1
+                            break
+                        # daemon mode: stay up as janitor/aggregator —
+                        # the operator may attach external workers
+                    else:
+                        break
+                if self.once and not self.workers and not outstanding:
+                    break        # pure-janitor once mode: spool drained
+                self.sleeper(self.poll_s)
+        finally:
+            rc = self._shutdown(rc)
+        return rc
+
+    def _shutdown(self, rc: int) -> int:
+        for w in self.workers:
+            if w.alive:
+                w.proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + self.drain_timeout_s
+        for w in self.workers:
+            if w.proc is None:
+                continue
+            try:
+                w.proc.wait(timeout=max(0.1,
+                                        deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                self.log.warning("worker %s ignored SIGTERM; killing",
+                                 w.worker_id)
+                w.proc.kill()
+                try:
+                    w.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            w.last_rc = w.proc.returncode
+            w.proc = None
+            self._mark_worker_down(w)
+        # one last janitor pass: claims the TERM'd workers requeued
+        # themselves are fine, but a worker that died ignoring the
+        # drain leaves orphans this controller should not strand
+        self._janitor()
+        self._aggregate(status="stopped")
+        self.log.info(
+            "fleet stopped after %.0f s: pending=%d claimed=%d "
+            "done=%d quarantined=%d",
+            time.time() - self.started_at,
+            protocol.pending_count(self.spool),
+            len(protocol.list_tickets(self.spool, "claimed")),
+            len(protocol.list_tickets(self.spool, "done")),
+            len(protocol.list_tickets(self.spool, "quarantine")))
+        return rc
+
+
+# ---------------------------------------------------------------- status
+
+def render_status(spool: str,
+                  max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S
+                  ) -> str:
+    """Human-readable fleet status from the spool's shared state (no
+    controller required: heartbeats + fleet.json are on disk)."""
+    lines = [f"fleet spool: {spool}"]
+    rec = protocol._read_json(os.path.join(spool, FLEET_JSON))
+    if rec is not None:
+        age = time.time() - rec.get("t", 0.0)
+        lines.append(
+            f"controller: pid {rec.get('controller_pid')} "
+            f"{rec.get('status', '?')} (fleet.json {age:.0f} s old)")
+    else:
+        lines.append("controller: no fleet.json (not running, or "
+                     "workers launched externally)")
+    heartbeats = protocol.list_heartbeats(spool)
+    if heartbeats:
+        lines.append(f"{len(heartbeats)} worker heartbeat(s):")
+        for wid, hb in heartbeats.items():
+            age = time.time() - hb.get("t", 0.0)
+            fresh = protocol._hb_fresh(hb, max_age_s)
+            beams = hb.get("beams") or {}
+            lines.append(
+                f"  [{'fresh' if fresh else 'STALE'}] "
+                f"{wid or '(single server)'}: pid {hb.get('pid')} "
+                f"{hb.get('status', '?')}, heartbeat {age:.0f} s ago, "
+                f"depth {hb.get('queue_depth', '?')}/"
+                f"{hb.get('max_queue_depth', '?')}, beams "
+                f"done={beams.get('done', 0)} "
+                f"failed={beams.get('failed', 0)} "
+                f"skipped={beams.get('skipped', 0)}")
+    else:
+        lines.append("no worker heartbeats")
+    cap = protocol.fleet_capacity(spool, max_age_s)
+    lines.append(
+        f"spool: pending={protocol.pending_count(spool)} "
+        f"claimed={len(protocol.list_tickets(spool, 'claimed'))} "
+        f"done={len(protocol.list_tickets(spool, 'done'))} "
+        f"quarantined={len(protocol.list_tickets(spool, 'quarantine'))}"
+        f" capacity={'none (0 fresh workers)' if cap is None else cap}")
+    return "\n".join(lines)

@@ -227,6 +227,47 @@ def serve_stagein_seconds() -> metrics.Histogram:
         buckets=STAGE_BUCKETS)
 
 
+def fleet_workers() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_fleet_workers",
+        "fleet workers by state: fresh (heartbeat current, accepting "
+        "work), stale (process alive, heartbeat old — wedged?), dead "
+        "(process gone)",
+        labelnames=("state",))
+
+
+def fleet_restarts_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_fleet_restarts_total",
+        "worker restarts issued by the fleet controller (crash "
+        "restarts count against the backoff budget; rolling-restart "
+        "cycles do not)",
+        labelnames=("worker", "kind"))       # kind: crash | rolling
+
+
+def fleet_requeued_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_fleet_requeued_total",
+        "tickets the fleet janitor reclaimed from dead workers "
+        "(work-stealing requeues; each increments the ticket's "
+        "attempts counter)")
+
+
+def fleet_quarantined_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_fleet_quarantined_total",
+        "poisoned beams isolated in quarantine/ after repeatedly "
+        "killing their worker (attempts reached the cap)")
+
+
+def fleet_capacity() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_fleet_capacity",
+        "aggregate remaining admission capacity: sum of fresh "
+        "workers' advertised queue depths minus tickets waiting "
+        "(what the warm backend's can_submit consults)")
+
+
 # --------------------------------------------------------------------
 # the shared heartbeat/progress event shape
 # --------------------------------------------------------------------

@@ -1,22 +1,26 @@
-"""``warm`` queue backend: submit beams to a resident search server.
+"""``warm`` queue backend: submit beams to resident search workers.
 
 Implements the 7-method PipelineQueueManager contract by writing job
 tickets to a serve spool (tpulsar/serve/protocol.py) instead of
-forking a process per beam — the JobPool daemon drives a warm worker
-with zero scheduling-code changes.
+forking a process per beam — the JobPool daemon drives one warm worker
+or a whole fleet (tpulsar/fleet/) with zero scheduling-code changes.
 
-Liveness is the heartbeat: a submission only becomes a ticket while
-the server's heartbeat is fresh; otherwise every operation falls back
-to an embedded LocalProcessManager, so a deployment configured for
-``warm`` keeps processing beams (at cold per-process cost) when the
-server is down, draining, or not yet started.  Queue ids are
-self-routing — ``warm-*`` ids live in the spool, anything else
-belongs to the fallback — and both stores are on-disk, so a restarted
-daemon keeps polling jobs an earlier process submitted.
+Liveness is the heartbeats: a submission only becomes a ticket while
+at least ONE worker's heartbeat on the spool is fresh; with zero
+fresh workers every operation load-sheds to an embedded
+LocalProcessManager, so a deployment configured for ``warm`` keeps
+processing beams (at cold per-process cost) when the fleet is down,
+draining, or not yet started.  Queue ids are self-routing — ``warm-*``
+ids live in the spool, anything else belongs to the fallback — and
+both stores are on-disk, so a restarted daemon keeps polling jobs an
+earlier process submitted.
 
-Backpressure: ``can_submit()`` is False once the spool's admission
-queue holds ``max_queue_depth`` tickets, which is what keeps the pool
-from burying a single device under an unbounded beam backlog.
+Backpressure vs load-shedding: ``can_submit()`` consults the
+AGGREGATE fleet capacity (the sum of fresh workers' advertised queue
+depths minus tickets already waiting — protocol.fleet_capacity), so
+admission scales with the number of live workers; a full queue with
+live workers is backpressure (wait), while zero fresh workers is the
+only condition that sheds load to process-per-beam submission.
 """
 
 from __future__ import annotations
@@ -104,9 +108,13 @@ class WarmServerManager:
         return qid
 
     def can_submit(self) -> bool:
-        if not self.server_available():
+        cap = protocol.fleet_capacity(
+            self.spool, self.heartbeat_max_age_s,
+            default_depth=self.max_queue_depth)
+        if cap is None:
+            # zero fresh workers: load-shed to process-per-beam
             return self.fallback.can_submit()
-        return protocol.pending_count(self.spool) < self.max_queue_depth
+        return cap > 0
 
     def is_running(self, queue_id: str) -> bool:
         if not self._is_warm_qid(queue_id):
@@ -136,7 +144,7 @@ class WarmServerManager:
 
     def status(self) -> tuple[int, int]:
         queued = protocol.pending_count(self.spool)
-        running = len(protocol.list_tickets(self.spool, "claimed"))
+        running = protocol.claimed_count(self.spool)
         if self._fallback is not None:
             fq, fr = self._fallback.status()
             queued, running = queued + fq, running + fr

@@ -54,6 +54,9 @@ FAULT_POINTS = (
     "upload.write",         # results-DB upload transaction
     "queue.submit",         # queue-manager job submission
     "serve.beam",           # resident-server per-beam device work
+    "fleet.worker",         # fleet worker-crash injection: the server
+    #                         hard-exits (os._exit) mid-beam — claim
+    #                         left in place, no result, no drain
 )
 
 MODES = ("unimplemented", "hang", "poison")

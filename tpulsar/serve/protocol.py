@@ -1,33 +1,51 @@
 """The serve spool: a filesystem job-ticket protocol.
 
-The resident server and its clients (the ``warm`` queue backend,
-``bench.py --serve``, CI smoke scripts) coordinate through a spool
-directory — job tickets in, result records out — so no network stack
-is needed and every state transition is a crash-safe rename:
+The resident servers and their clients (the ``warm`` queue backend,
+the fleet controller, ``bench.py --serve/--fleet``, CI smoke scripts)
+coordinate through a spool directory — job tickets in, result records
+out — so no network stack is needed and every state transition is a
+crash-safe rename:
 
-    <spool>/incoming/<ticket_id>.json    admission queue (bounded)
-    <spool>/claimed/<ticket_id>.json     accepted, being processed
-    <spool>/done/<ticket_id>.json        result/status record
-    <spool>/server.json                  server heartbeat
+    <spool>/incoming/<ticket_id>.json     admission queue (bounded)
+    <spool>/claimed/<ticket_id>.json      accepted, being processed
+    <spool>/done/<ticket_id>.json         result/status record
+    <spool>/quarantine/<ticket_id>.json   poisoned beams (attempts cap)
+    <spool>/server.json                   single-server heartbeat
+    <spool>/server.<worker_id>.json       per-worker fleet heartbeats
 
 A ticket moves ``incoming -> claimed`` by atomic rename (exactly-one
-claimer even with several servers on one spool) and is deleted from
+claimer even with several workers on one spool) and is deleted from
 ``claimed`` only after its result record is durable in ``done/``.  A
-server that dies mid-beam therefore leaves the ticket in ``claimed``;
-``requeue_stale_claims`` (run at server boot) moves such orphans back
-to ``incoming`` so the beam is retried, never lost.
+worker that dies mid-beam therefore leaves the ticket in ``claimed``;
+``requeue_stale_claims`` (run at worker boot and continuously by the
+fleet controller's janitor) moves such orphans back to ``incoming`` —
+but ONLY when the claim's recorded owner is dead, so with N workers on
+one spool the requeue is a safe work-stealing protocol, never a way to
+double-process a beam a live co-worker still holds.
+
+Every crash-shaped requeue increments the ticket's ``attempts``
+counter; a beam that has killed its worker ``max_attempts`` times is
+poisoned — it is moved to ``quarantine/`` and failed into ``done/``
+(status ``failed``, reason ``max_attempts``) so no worker in the fleet
+ever claims it again.  Graceful-drain requeues (``requeue_own_claims``)
+are attempt-neutral: a beam a stopping worker simply hadn't started is
+not a suspect.
 
 All writes are tmp-file + ``os.replace`` so a reader can never observe
-a torn JSON document.
+a torn JSON document.  Requeues first take exclusive ownership of the
+claim file by renaming it aside (``.takeover.<pid>``), so two janitors
+racing over one dead worker's claim cannot resurrect a ticket a third
+process just re-claimed.
 
 Ticket shape (written by clients):
     {"ticket": ..., "datafiles": [...], "outdir": ..., "job_id": ...,
-     "submitted_at": unix_time}
+     "submitted_at": unix_time, "attempts": 0}
 
 Result shape (written by the server):
     {"ticket": ..., "status": "done"|"failed"|"skipped", "rc": int,
      "error": str, "beam_seconds": float, "compile_misses": int,
-     "warm": bool, "outdir": ..., "finished_at": unix_time}
+     "warm": bool, "outdir": ..., "worker": str, "attempts": int,
+     "finished_at": unix_time}
 """
 
 from __future__ import annotations
@@ -36,17 +54,22 @@ import json
 import os
 import time
 
-#: heartbeats older than this are stale: the server is gone (crashed,
-#: drained, or never started) and clients must fall back to
-#: process-per-beam submission
+#: heartbeats older than this are stale: the worker is gone (crashed,
+#: drained, or never started); with zero fresh workers clients must
+#: fall back to process-per-beam submission
 HEARTBEAT_MAX_AGE_S = 120.0
 
-_STATES = ("incoming", "claimed", "done")
+#: crash-shaped claims a ticket may accumulate before it is judged
+#: poisoned and quarantined (overridable per call / via
+#: jobpooler.serve_max_attempts)
+DEFAULT_MAX_ATTEMPTS = 3
+
+_STATES = ("incoming", "claimed", "done", "quarantine")
 
 
 def default_spool_dir(cfg=None) -> str:
     """One spool per deployment, under the working-directory root the
-    server and the job-pool daemon already share."""
+    workers and the job-pool daemon already share."""
     if cfg is None:
         from tpulsar.config import settings
         cfg = settings()
@@ -92,12 +115,12 @@ def write_ticket(spool: str, ticket_id: str, datafiles: list[str],
                  outdir: str, job_id: int | None = None,
                  **extra) -> str:
     """Enqueue a beam: one JSON file in incoming/.  Returns the
-    ticket id.  Callers enforce admission depth via pending_count()
+    ticket id.  Callers enforce admission depth via fleet_capacity()
     BEFORE writing (the queue-backend contract's can_submit)."""
     ensure_spool(spool)
     rec = {"ticket": ticket_id, "datafiles": list(datafiles),
            "outdir": outdir, "job_id": job_id,
-           "submitted_at": time.time(), **extra}
+           "submitted_at": time.time(), "attempts": 0, **extra}
     _atomic_write_json(ticket_path(spool, ticket_id, "incoming"), rec)
     return ticket_id
 
@@ -120,10 +143,29 @@ def pending_count(spool: str) -> int:
     return len(list_tickets(spool, "incoming"))
 
 
-def claim_next_ticket(spool: str) -> dict | None:
+def claimed_count(spool: str) -> int:
+    """Outstanding claims INCLUDING those a janitor has momentarily
+    renamed aside for requeue (``.takeover.<pid>``): a requeue in
+    flight is still outstanding work, and an exit check that reads
+    only plain claims could declare the spool drained in the
+    microseconds between the takeover rename and the incoming/ write
+    — stranding the ticket with no worker left."""
+    d = os.path.join(spool, "claimed")
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    return sum(1 for n in names
+               if n.endswith(".json") or ".json.takeover." in n)
+
+
+def claim_next_ticket(spool: str, worker_id: str = "") -> dict | None:
     """Atomically move the oldest incoming ticket to claimed/ and
     return its record (None when the queue is empty).  Rename is the
-    claim: two servers on one spool cannot claim the same ticket."""
+    claim: two workers on one spool cannot claim the same ticket.
+    The claim records the owner (pid + worker id) so the requeue
+    machinery can tell a dead owner's orphan from a live co-worker's
+    in-flight beam."""
     for tid in list_tickets(spool, "incoming"):
         src = ticket_path(spool, tid, "incoming")
         dst = ticket_path(spool, tid, "claimed")
@@ -135,6 +177,8 @@ def claim_next_ticket(spool: str) -> dict | None:
         if rec is not None:
             rec["claimed_at"] = time.time()
             rec["claimed_by"] = os.getpid()
+            if worker_id:
+                rec["claimed_by_worker"] = worker_id
             _atomic_write_json(dst, rec)
             return rec
         os.unlink(dst)          # torn/garbage ticket: drop it
@@ -143,7 +187,7 @@ def claim_next_ticket(spool: str) -> dict | None:
 
 def cancel_ticket(spool: str, ticket_id: str) -> bool:
     """Remove a ticket still waiting for admission.  A claimed ticket
-    cannot be cancelled from outside (the server owns it — there is
+    cannot be cancelled from outside (the worker owns it — there is
     no cross-process way to abort the in-flight device work)."""
     try:
         os.unlink(ticket_path(spool, ticket_id, "incoming"))
@@ -162,15 +206,93 @@ def _pid_alive(pid) -> bool:
     return True
 
 
-def requeue_stale_claims(spool: str) -> list[str]:
-    """Move claimed-but-unfinished tickets back to incoming (server
-    boot recovery: a predecessor that died mid-beam left them there).
-    Claims whose recorded owner pid is still alive belong to a LIVE
-    co-server on this spool and are left alone — stealing them would
-    double-process the beam.  Tickets that already have a result
-    record are completed work the dead server just failed to unlink —
-    finish the bookkeeping instead of re-running the beam."""
+def _takeover_claim(spool: str, ticket_id: str) -> str | None:
+    """Take exclusive ownership of a claim file before requeueing it:
+    the rename is atomic, so of N janitors racing over one dead
+    worker's claim exactly one proceeds — the others see ENOENT and
+    skip.  Without this, a slow janitor could re-create an incoming
+    ticket another worker already re-claimed (a duplicate beam) or
+    unlink that worker's live claim (a lost one)."""
+    src = ticket_path(spool, ticket_id, "claimed")
+    tmp = f"{src}.takeover.{os.getpid()}"
+    try:
+        os.rename(src, tmp)
+    except OSError:
+        return None
+    return tmp
+
+
+def _recover_abandoned_takeovers(spool: str) -> None:
+    """A janitor that died between taking a claim over and finishing
+    the requeue left ``<tid>.json.takeover.<pid>``.  If the ticket
+    already moved on without it — the dead janitor DID finish the
+    incoming/ write (or quarantine), or another worker has since
+    re-claimed or completed the beam — the takeover file is a stale
+    duplicate and is deleted: restoring it would clobber the live
+    claim (or fork the ticket into two states) and double-process the
+    beam.  Only when the ticket exists NOWHERE else is the takeover
+    restored to a plain claim for the normal stale-claim scan — a
+    ticket must never be lost to a crashed janitor."""
+    d = os.path.join(spool, "claimed")
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        base, sep, pid = name.partition(".takeover.")
+        if not sep or not base.endswith(".json") or _pid_alive(pid):
+            continue
+        tid = base[:-len(".json")]
+        if any(os.path.exists(ticket_path(spool, tid, state))
+               for state in ("incoming", "claimed", "done",
+                             "quarantine")):
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+            continue
+        try:
+            os.rename(os.path.join(d, name), os.path.join(d, base))
+        except OSError:
+            pass
+
+
+def _quarantine(spool: str, rec: dict, max_attempts: int) -> None:
+    """Isolate a poisoned beam: the ticket record (with its crash
+    history) is kept in quarantine/ for the operator, and a failed
+    result is written into done/ so the submitting pool stops waiting
+    — no worker in the fleet will ever claim this beam again."""
+    tid = rec.get("ticket", "?")
+    rec["quarantined_at"] = time.time()
+    _atomic_write_json(ticket_path(spool, tid, "quarantine"), rec)
+    write_result(
+        spool, tid, "failed", rc=1,
+        error=(f"quarantined after {rec.get('attempts', 0)} "
+               f"crash-shaped claim(s) (max_attempts {max_attempts}): "
+               f"this beam repeatedly killed its worker"),
+        reason="max_attempts", attempts=rec.get("attempts", 0),
+        outdir=rec.get("outdir", ""))
+
+
+def requeue_stale_claims(spool: str,
+                         max_attempts: int = DEFAULT_MAX_ATTEMPTS
+                         ) -> list[str]:
+    """Move claimed-but-unfinished tickets whose owning worker is DEAD
+    back to incoming (boot recovery and the fleet janitor: any worker
+    may then claim them — work stealing).  Claims whose recorded owner
+    pid is still alive belong to a LIVE co-worker on this spool and
+    are left alone — stealing them would double-process the beam.
+    Tickets that already have a result record are completed work the
+    dead worker just failed to unlink — finish the bookkeeping instead
+    of re-running the beam.
+
+    Every dead-owner requeue is crash-shaped and increments the
+    ticket's ``attempts``; at ``max_attempts`` the beam is judged
+    poisoned and quarantined (see _quarantine) instead of requeued.
+    Returns the requeued ticket ids (quarantined ones are visible via
+    ``list_tickets(spool, "quarantine")``)."""
     ensure_spool(spool)
+    _recover_abandoned_takeovers(spool)
     me = os.getpid()
     requeued = []
     for tid in list_tickets(spool, "claimed"):
@@ -185,13 +307,65 @@ def requeue_stale_claims(spool: str) -> list[str]:
         if rec is None:
             continue
         owner = rec.get("claimed_by")
-        if owner is not None and owner != me and _pid_alive(owner):
-            continue            # a live co-server owns this beam
+        own = owner == me
+        if owner is not None and not own and _pid_alive(owner):
+            continue            # a live co-worker owns this beam
+        tmp = _takeover_claim(spool, tid)
+        if tmp is None:
+            continue            # another janitor beat us to it
+        rec = _read_json(tmp) or rec
         rec.pop("claimed_at", None)
         rec.pop("claimed_by", None)
+        rec.pop("claimed_by_worker", None)
+        if not own:
+            # the owner died holding this beam: one more strike
+            rec["attempts"] = int(rec.get("attempts", 0)) + 1
+            if rec["attempts"] >= max_attempts:
+                _quarantine(spool, rec, max_attempts)
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                continue
         _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
         try:
-            os.unlink(src)
+            os.unlink(tmp)
+        except OSError:
+            pass
+        requeued.append(tid)
+    return requeued
+
+
+def requeue_own_claims(spool: str) -> list[str]:
+    """Graceful-drain requeue: move claims owned by THIS process back
+    to incoming without touching ``attempts`` — a stopping worker
+    returning beams it never started (the staged prefetch tail) is
+    not a crash, and the beams are not suspects.  Claims with a done
+    record are just reconciled."""
+    ensure_spool(spool)
+    me = os.getpid()
+    requeued = []
+    for tid in list_tickets(spool, "claimed"):
+        src = ticket_path(spool, tid, "claimed")
+        if os.path.exists(ticket_path(spool, tid, "done")):
+            try:
+                os.unlink(src)
+            except OSError:
+                pass
+            continue
+        rec = _read_json(src)
+        if rec is None or rec.get("claimed_by") != me:
+            continue
+        tmp = _takeover_claim(spool, tid)
+        if tmp is None:
+            continue
+        rec = _read_json(tmp) or rec
+        rec.pop("claimed_at", None)
+        rec.pop("claimed_by", None)
+        rec.pop("claimed_by_worker", None)
+        _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
+        try:
+            os.unlink(tmp)
         except OSError:
             pass
         requeued.append(tid)
@@ -221,35 +395,100 @@ def read_result(spool: str, ticket_id: str) -> dict | None:
 
 
 def ticket_state(spool: str, ticket_id: str) -> str:
-    """'incoming' | 'claimed' | 'done' | 'unknown'."""
+    """'incoming' | 'claimed' | 'done' | 'unknown'.  (A quarantined
+    ticket reads 'done' — its failed result record is the terminal
+    truth clients act on.)"""
     for state in ("done", "claimed", "incoming"):
         if os.path.exists(ticket_path(spool, ticket_id, state)):
             return state
+    # a claim mid-takeover by a janitor is still claimed work — don't
+    # let a poller observe a transient 'unknown' and declare it lost
+    d = os.path.join(spool, "claimed")
+    try:
+        for name in os.listdir(d):
+            if name.startswith(f"{ticket_id}.json.takeover."):
+                return "claimed"
+    except OSError:
+        pass
     return "unknown"
 
 
 # ----------------------------------------------------------- heartbeat
 
-def heartbeat_path(spool: str) -> str:
+def heartbeat_path(spool: str, worker_id: str = "") -> str:
+    """The single-server heartbeat (server.json) or, in a fleet, one
+    worker's heartbeat (server.<worker_id>.json)."""
+    if worker_id:
+        return os.path.join(spool, f"server.{worker_id}.json")
     return os.path.join(spool, "server.json")
 
 
-def write_heartbeat(spool: str, **fields) -> None:
+def write_heartbeat(spool: str, worker_id: str = "", **fields) -> None:
     ensure_spool(spool)
-    rec = {"t": time.time(), "pid": os.getpid(), **fields}
-    _atomic_write_json(heartbeat_path(spool), rec)
+    rec = {"t": time.time(), "pid": os.getpid(),
+           "worker": worker_id, **fields}
+    _atomic_write_json(heartbeat_path(spool, worker_id), rec)
 
 
-def read_heartbeat(spool: str) -> dict | None:
-    return _read_json(heartbeat_path(spool))
+def read_heartbeat(spool: str, worker_id: str = "") -> dict | None:
+    return _read_json(heartbeat_path(spool, worker_id))
+
+
+def list_heartbeats(spool: str) -> dict[str, dict]:
+    """Every heartbeat on the spool, keyed by worker id (the legacy
+    single-server server.json appears under '')."""
+    out: dict[str, dict] = {}
+    try:
+        names = os.listdir(spool)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith("server") and name.endswith(".json")):
+            continue
+        wid = name[len("server."):-len(".json")] \
+            if name != "server.json" else ""
+        rec = _read_json(os.path.join(spool, name))
+        if rec is not None:
+            out[wid] = rec
+    return out
+
+
+def _hb_fresh(rec: dict | None,
+              max_age_s: float = HEARTBEAT_MAX_AGE_S) -> bool:
+    """A live worker wrote this heartbeat recently AND is not
+    draining.  A draining worker still finishes its claimed beams but
+    must receive no new work."""
+    if rec is None or rec.get("status") in ("draining", "stopped"):
+        return False
+    return (time.time() - rec.get("t", 0.0)) <= max_age_s
+
+
+def fresh_workers(spool: str,
+                  max_age_s: float = HEARTBEAT_MAX_AGE_S
+                  ) -> dict[str, dict]:
+    """Heartbeats of workers currently accepting work."""
+    return {wid: rec for wid, rec in list_heartbeats(spool).items()
+            if _hb_fresh(rec, max_age_s)}
 
 
 def heartbeat_fresh(spool: str,
                     max_age_s: float = HEARTBEAT_MAX_AGE_S) -> bool:
-    """A live server wrote the heartbeat recently AND is not
-    draining.  A draining server still finishes its claimed beams but
-    must receive no new work."""
-    hb = read_heartbeat(spool)
-    if hb is None or hb.get("status") in ("draining", "stopped"):
-        return False
-    return (time.time() - hb.get("t", 0.0)) <= max_age_s
+    """True while ANY worker on the spool is accepting work — a fleet
+    with one fresh worker of N still serves tickets."""
+    return bool(fresh_workers(spool, max_age_s))
+
+
+def fleet_capacity(spool: str,
+                   max_age_s: float = HEARTBEAT_MAX_AGE_S,
+                   default_depth: int = 8) -> int | None:
+    """Aggregate remaining admission capacity: the sum of fresh
+    workers' advertised queue depths minus the tickets already
+    waiting.  Returns None when ZERO workers are fresh — the signal
+    for clients to load-shed to process-per-beam submission (a full
+    queue, by contrast, is backpressure: wait, don't shed)."""
+    fresh = fresh_workers(spool, max_age_s)
+    if not fresh:
+        return None
+    depth = sum(int(rec.get("max_queue_depth", default_depth))
+                for rec in fresh.values())
+    return max(0, depth - pending_count(spool))

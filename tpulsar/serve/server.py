@@ -20,9 +20,17 @@ Properties the batch path cannot offer:
     a hung dispatch into a failed ticket instead of a wedged server;
   * crash isolation — a poisoned beam (fault point ``serve.beam``)
     marks THAT ticket failed and the loop continues;
-  * graceful drain — SIGTERM finishes the in-flight beam, requeues
-    claimed-but-unstarted tickets, and stamps the heartbeat
-    ``stopped`` so clients fall back to process-per-beam submission.
+  * graceful drain — SIGTERM finishes the in-flight beam, joins the
+    stage-in prefetch thread, requeues every claimed-but-unstarted
+    ticket this worker holds (in the handoff queue or mid-stage) via
+    the attempt-neutral ``requeue_own_claims``, and stamps the
+    heartbeat ``stopped`` so clients fall back or reroute;
+  * fleet membership — with a ``worker_id`` the heartbeat goes to
+    ``server.<worker_id>.json`` and every claim/result is stamped
+    with the worker, so N servers share one spool safely (the fleet
+    controller, tpulsar/fleet/, spawns and supervises them).  Fault
+    point ``fleet.worker`` simulates a worker CRASH (hard process
+    exit mid-beam, no drain) for deterministic fleet-recovery tests.
 
 Per-beam results are produced by the same ``cli.search_job``
 library functions the batch path runs, so the output directory layout
@@ -46,8 +54,10 @@ from tpulsar.serve.stagein import PreparedBeam, StageInPipeline
 
 class SearchServer:
     def __init__(self, spool: str | None = None, cfg=None, *,
+                 worker_id: str = "",
                  max_queue_depth: int = 8,
                  beam_deadline_s: float = 0.0,
+                 ticket_max_attempts: int = protocol.DEFAULT_MAX_ATTEMPTS,
                  warm_boot: bool = True,
                  warm_boot_scale: float = 0.05,
                  prefetch_depth: int = 1,
@@ -59,7 +69,9 @@ class SearchServer:
             cfg = settings()
         self.cfg = cfg
         self.spool = spool or protocol.default_spool_dir(cfg)
+        self.worker_id = worker_id
         self.max_queue_depth = max_queue_depth
+        self.ticket_max_attempts = ticket_max_attempts
         self.beam_deadline_s = beam_deadline_s
         self.warm_boot = warm_boot
         self.warm_boot_scale = warm_boot_scale
@@ -68,9 +80,14 @@ class SearchServer:
         #: injectable for tests: callable(PreparedBeam) ->
         #: SearchOutcome | None (None = clean skip)
         self.beam_fn = beam_fn or self._search_one
-        self.log = logger or get_logger("serve")
+        self.log = logger or get_logger(
+            f"serve.{worker_id}" if worker_id else "serve")
+        #: injectable for tests: the fleet.worker fault's hard process
+        #: exit (a crash leaves claims in place — no drain, no result)
+        self._crash = os._exit
         self.pipeline = StageInPipeline(
-            claim=lambda: protocol.claim_next_ticket(self.spool),
+            claim=lambda: protocol.claim_next_ticket(self.spool,
+                                                     self.worker_id),
             workdir_base=cfg.processing.base_working_directory,
             cfg=cfg, depth=prefetch_depth, poll_s=poll_s,
             logger=self.log)
@@ -104,10 +121,11 @@ class SearchServer:
 
     def boot(self) -> None:
         protocol.ensure_spool(self.spool)
-        requeued = protocol.requeue_stale_claims(self.spool)
+        requeued = protocol.requeue_stale_claims(
+            self.spool, self.ticket_max_attempts)
         if requeued:
             self.log.warning(
-                "requeued %d ticket(s) a dead server left claimed: %s",
+                "requeued %d ticket(s) a dead worker left claimed: %s",
                 len(requeued), ", ".join(requeued))
         # the whole point of residency: one cache activation + one
         # warm-start for EVERY beam this process will ever search
@@ -141,8 +159,8 @@ class SearchServer:
         depth = protocol.pending_count(self.spool)
         telemetry.serve_queue_depth().set(depth)
         protocol.write_heartbeat(
-            self.spool, status=status, queue_depth=depth,
-            max_queue_depth=self.max_queue_depth,
+            self.spool, worker_id=self.worker_id, status=status,
+            queue_depth=depth, max_queue_depth=self.max_queue_depth,
             beams=dict(self.beams), started_at=self.started_at)
         self._hb_last = now
 
@@ -185,8 +203,7 @@ class SearchServer:
                     self._process(prepared)
                     continue
                 if once and protocol.pending_count(self.spool) == 0 \
-                        and not protocol.list_tickets(self.spool,
-                                                      "claimed"):
+                        and protocol.claimed_count(self.spool) == 0:
                     break
         finally:
             self._shutdown()
@@ -197,11 +214,18 @@ class SearchServer:
         self._stopped.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)
-        self.pipeline.stop()
-        requeued = protocol.requeue_stale_claims(self.spool)
+        # join the prefetch thread FIRST: beams it already staged into
+        # the handoff queue (and any it was mid-stage on) hold claims
+        # this worker must give back — then requeue every claim this
+        # pid still owns, attempt-neutral (a drain is not a crash; the
+        # returned beams are not suspects)
+        leftovers = self.pipeline.stop()
+        requeued = protocol.requeue_own_claims(self.spool)
         if requeued:
-            self.log.info("drain requeued %d unstarted ticket(s)",
-                          len(requeued))
+            self.log.info(
+                "drain requeued %d unstarted ticket(s) (%d of them "
+                "already staged): %s", len(requeued), len(leftovers),
+                ", ".join(requeued))
         self._heartbeat("stopped", force=True)
         dt = time.time() - t0
         telemetry.serve_drain_seconds().observe(dt)
@@ -235,12 +259,27 @@ class SearchServer:
         outdir = prepared.ticket.get("outdir", "")
         t0 = time.time()
         telemetry.trace.instant("serve_beam_start", ticket=tid)
+        if faults.targets("fleet.worker"):
+            try:
+                faults.fire("fleet.worker",
+                            detail=f"ticket {tid} worker "
+                                   f"{self.worker_id or '-'}")
+            except BaseException:
+                # a worker CRASH, not a beam failure: hard exit with
+                # the claim still in place and no result record —
+                # exactly what a real mid-beam kill leaves behind for
+                # requeue_stale_claims / the fleet janitor to recover
+                self.log.error("fleet.worker fault: crashing on "
+                               "ticket %s", tid)
+                self._crash(70)
+                return          # unreachable with the real os._exit
+        att = int(prepared.ticket.get("attempts", 0))
         if prepared.error:
             self.log.error("ticket %s stage-in failed: %s", tid,
                            prepared.error.splitlines()[0]
                            if prepared.error else "?")
             self._finish(tid, "failed", t0, outdir,
-                         error=prepared.error)
+                         error=prepared.error, attempts=att)
             return
         misses0 = self._compile_misses_total()
         try:
@@ -257,7 +296,7 @@ class SearchServer:
                 "left to the abandoned runner", tid,
                 self.beam_deadline_s, prepared.workdir)
             self._finish(
-                tid, "failed", t0, outdir, error=str(e),
+                tid, "failed", t0, outdir, error=str(e), attempts=att,
                 compile_misses=self._compile_misses_total() - misses0)
             return
         except Exception as e:
@@ -267,15 +306,15 @@ class SearchServer:
             self.log.exception("ticket %s failed", tid)
             prepared.cleanup()
             self._finish(
-                tid, "failed", t0, outdir,
+                tid, "failed", t0, outdir, attempts=att,
                 error=f"{e}\n{traceback.format_exc()}"[:4000],
                 compile_misses=self._compile_misses_total() - misses0)
             return
         prepared.cleanup()
         if outcome is None:                 # TooShort clean skip
-            self._finish(tid, "skipped", t0, outdir)
+            self._finish(tid, "skipped", t0, outdir, attempts=att)
         else:
-            self._finish(tid, "done", t0, outdir,
+            self._finish(tid, "done", t0, outdir, attempts=att,
                          compile_misses=outcome.compile_misses,
                          compile_hits=outcome.compile_hits,
                          candidates=len(outcome.candidates),
@@ -303,7 +342,7 @@ class SearchServer:
             self.spool, tid, status,
             rc=0 if status in ("done", "skipped") else 1,
             error=error, beam_seconds=dt, warm=warm,
-            outdir=outdir, **extra)
+            outdir=outdir, worker=self.worker_id, **extra)
         self.beams[status] = self.beams.get(status, 0) + 1
         telemetry.serve_beams_total().inc(outcome=status)
         if status != "skipped":

@@ -101,6 +101,7 @@ class StageInPipeline:
             maxsize=max(1, depth))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._dropped: list[PreparedBeam] = []
 
     # ----------------------------------------------------------- thread
 
@@ -134,8 +135,9 @@ class StageInPipeline:
             else:
                 # stopping with an unconsumed beam: drop the scratch
                 # dir; the still-claimed ticket is requeued by the
-                # server's drain (requeue_stale_claims)
+                # server's drain (requeue_own_claims)
                 prepared.cleanup()
+                self._dropped.append(prepared)
 
     # ----------------------------------------------------------- caller
 
@@ -147,12 +149,20 @@ class StageInPipeline:
             return None
 
     def stop(self) -> list[PreparedBeam]:
-        """Stop the thread and return any prepared-but-unconsumed
-        beams (already cleaned up; their tickets are still claimed in
-        the spool for the caller to requeue)."""
+        """Stop and JOIN the thread, then return every prepared-but-
+        unconsumed beam — both those waiting in the handoff queue and
+        any the stopping thread had to drop (all already cleaned up;
+        their tickets are still claimed in the spool for the caller
+        to requeue)."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+            if self._thread.is_alive():
+                # a straggling stage-in (huge copy, slow disk): the
+                # caller's requeue_own_claims still returns whatever
+                # ticket it holds; log so the leak is attributable
+                self.log.warning("stage-in thread still running "
+                                 "after stop(); abandoning it")
         leftovers = []
         while True:
             try:
@@ -161,4 +171,6 @@ class StageInPipeline:
                 break
             b.cleanup()
             leftovers.append(b)
+        leftovers.extend(self._dropped)
+        self._dropped = []
         return leftovers
