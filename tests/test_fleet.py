@@ -185,6 +185,155 @@ def test_stale_takeover_never_clobbers_a_moved_on_ticket(tmp_path):
         live_proc.wait()
 
 
+def test_midclaim_staging_is_invisible_to_janitor(tmp_path):
+    """A LIVE claimer between its two renames holds the ticket as
+    ``<tid>.json.claiming.<pid>``; every janitor pass must leave it
+    alone — even when the ticket WAITED in incoming/ longer than the
+    recovery grace window (os.rename preserves mtime, so the hold
+    must be re-stamped or a backpressured ticket's staging file reads
+    as ancient the instant it is created and gets stolen).  (Pre-fix,
+    the claim was an ownerless plain claim for a moment, and a
+    janitor landing in that window requeued the beam — the ticket
+    then existed in BOTH incoming/ and claimed/ and two workers
+    processed it.)"""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    src = protocol.ticket_path(spool, "t1", "incoming")
+    dst = protocol.ticket_path(spool, "t1", "claimed")
+    # the ticket sat in incoming/ for 3x the grace window
+    old = time.time() - 3 * protocol.ORPHAN_SIDEFILE_GRACE_S
+    os.utime(src, (old, old))
+    staging = f"{dst}.claiming.{os.getpid()}"      # our pid: alive
+    protocol._rename_held(src, staging)       # claim_next_ticket's
+    assert protocol.requeue_stale_claims(spool) == []
+    assert os.path.exists(staging)                 # untouched
+    assert protocol.pending_count(spool) == 0      # NOT duplicated
+    assert protocol.claimed_count(spool) == 1      # still outstanding
+    assert protocol.ticket_state(spool, "t1") == "claimed"
+
+
+def test_takeover_of_a_long_running_claim_reads_freshly_held(tmp_path):
+    """A janitor's takeover of a claim whose beam ran longer than the
+    grace window must not inherit the claim's old mtime — a second
+    janitor would immediately judge the first's in-flight takeover
+    abandoned and race it for the ticket."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    src = protocol.ticket_path(spool, "t1", "claimed")
+    old = time.time() - 3 * protocol.ORPHAN_SIDEFILE_GRACE_S
+    os.utime(src, (old, old))                 # a multi-minute beam
+    tmp = protocol._takeover_claim(spool, "t1")
+    assert tmp is not None
+    # freshly held by a live pid: a concurrent janitor leaves it be
+    assert protocol._sidefile_owner_live(tmp, os.getpid())
+
+
+def test_plain_claims_always_carry_their_owner(tmp_path):
+    """The invariant the fix rests on: a plain claimed/<tid>.json is
+    never observable without its owner stamp."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    rec = protocol.claim_next_ticket(spool, "w0")
+    assert rec["claimed_by"] == os.getpid()
+    on_disk = json.load(open(protocol.ticket_path(spool, "t1",
+                                                  "claimed")))
+    assert on_disk["claimed_by"] == os.getpid()
+    assert on_disk["claimed_by_worker"] == "w0"
+
+
+def test_abandoned_claiming_is_recovered_attempt_neutral(tmp_path):
+    """A claimer that died between its two renames leaves
+    ``.claiming.<dead pid>`` — the ticket exists in neither incoming/
+    nor claimed/.  The janitor must return it to incoming WITHOUT a
+    strike (the beam was never started) so it is not lost."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    src = protocol.ticket_path(spool, "t1", "incoming")
+    dst = protocol.ticket_path(spool, "t1", "claimed")
+    os.rename(src, f"{dst}.claiming.{_dead_pid()}")
+    assert protocol.claimed_count(spool) == 1
+    assert protocol.ticket_state(spool, "t1") == "claimed"
+    protocol.requeue_stale_claims(spool)
+    assert protocol.ticket_state(spool, "t1") == "incoming"
+    rec = json.load(open(protocol.ticket_path(spool, "t1",
+                                              "incoming")))
+    assert rec["attempts"] == 0
+    assert "claimed_by" not in rec
+    # recoverable by the next claimer
+    assert protocol.claim_next_ticket(spool, "w1")["ticket"] == "t1"
+
+
+def test_unstamped_takeover_routes_to_incoming_no_strike(tmp_path):
+    """A janitor that died while recovering a .claiming file leaves a
+    takeover whose record carries NO owner stamp.  Restoring it as a
+    plain claim would create an ownerless claim and charge an
+    attempts strike for a beam that was never started — it must go
+    straight back to incoming, attempt-neutrally."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    src = protocol.ticket_path(spool, "t1", "incoming")
+    dst = protocol.ticket_path(spool, "t1", "claimed")
+    os.rename(src, f"{dst}.takeover.{_dead_pid()}")  # unstamped
+    protocol.requeue_stale_claims(spool, max_attempts=1)
+    assert protocol.ticket_state(spool, "t1") == "incoming"
+    rec = json.load(open(src))
+    assert rec["attempts"] == 0                      # no strike
+    assert "claimed_by" not in rec
+    # with max_attempts=1 a spurious strike would have quarantined it
+    assert protocol.list_tickets(spool, "quarantine") == []
+
+
+def test_claim_promotion_refuses_to_clobber_live_claim(tmp_path):
+    """Healing a forked ticket (same tid in BOTH incoming/ and
+    claimed/ — the aftermath of a stall-theft race): a claimer must
+    treat its copy as the duplicate and discard it, never overwrite
+    the live claim a co-worker is processing."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    live = subprocess.Popen(["sleep", "5"])
+    try:
+        # forge the live co-worker's plain claim alongside incoming
+        rec = json.load(open(protocol.ticket_path(spool, "t1",
+                                                  "incoming")))
+        rec["claimed_by"] = live.pid
+        rec["claimed_by_worker"] = "wX"
+        protocol._atomic_write_json(
+            protocol.ticket_path(spool, "t1", "claimed"), rec)
+        assert protocol.claim_next_ticket(spool, "w9") is None
+        on_disk = json.load(open(protocol.ticket_path(spool, "t1",
+                                                      "claimed")))
+        assert on_disk["claimed_by_worker"] == "wX"   # untouched
+        assert protocol.pending_count(spool) == 0     # dup discarded
+        assert protocol.claimed_count(spool) == 1     # no leftovers
+    finally:
+        live.kill()
+        live.wait()
+
+
+def test_recycled_pid_cannot_strand_a_takeover(tmp_path):
+    """A dead janitor's takeover whose pid was recycled by an
+    unrelated live process must still be recovered once older than
+    the grace window — otherwise the ticket stays invisible to
+    requeue forever while claimed_count keeps counting it (a --once
+    fleet would never report the spool drained)."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    _reclaim(spool, "t1", _dead_pid())
+    src = protocol.ticket_path(spool, "t1", "claimed")
+    stale = f"{src}.takeover.{os.getpid()}"   # "recycled": pid alive
+    os.rename(src, stale)
+    # young + pid-alive: presumed a live janitor's in-flight requeue
+    assert protocol.requeue_stale_claims(spool) == []
+    assert os.path.exists(stale)
+    old = time.time() - 2 * protocol.ORPHAN_SIDEFILE_GRACE_S
+    os.utime(stale, (old, old))
+    # past the grace window the pid must be recycled: recover
+    assert protocol.requeue_stale_claims(spool) == ["t1"]
+    assert protocol.ticket_state(spool, "t1") == "incoming"
+
+
 def _claim_worker(spool, wid, outfile):
     got = []
     while True:
@@ -397,6 +546,23 @@ def _controller(spool, **kw):
     return fleet_ctl.FleetController(spool, **kw)
 
 
+def test_capacity_gauge_distinguishes_down_from_saturated(tmp_path):
+    """tpulsar_fleet_capacity must tell a DOWN fleet (-1: zero fresh
+    workers, clients load-shed) from a BUSY one (0: saturated queue,
+    backpressure) — `cap or 0` conflated the two."""
+    from tpulsar.obs import telemetry
+
+    spool = str(tmp_path / "spool")
+    ctrl = _controller(spool, workers=0)
+    ctrl._aggregate()
+    assert telemetry.fleet_capacity().value() == -1
+    protocol.write_heartbeat(spool, worker_id="w0", status="running",
+                             max_queue_depth=1)
+    protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    ctrl._aggregate()
+    assert telemetry.fleet_capacity().value() == 0
+
+
 def test_controller_drains_spool_with_two_workers(tmp_path):
     spool = str(tmp_path / "spool")
     tickets = [f"t{i}" for i in range(8)]
@@ -416,6 +582,25 @@ def test_controller_drains_spool_with_two_workers(tmp_path):
     assert fleet["done"] == 8 and fleet["pending"] == 0
     assert {w["id"] for w in fleet["workers"]} == {"w0", "w1"}
     assert os.path.exists(os.path.join(spool, "fleet.prom"))
+
+
+def test_spawn_failure_still_shuts_down_spawned_workers(tmp_path):
+    """A spawn failure for worker k must not leak workers 0..k-1
+    running unsupervised — the shutdown path has to run even when
+    startup dies half-way."""
+    spool = str(tmp_path / "spool")
+
+    def cmd(wid):
+        if wid == "w1":
+            raise RuntimeError("no binary for w1")
+        return [sys.executable, STUB, "--spool", spool,
+                "--worker-id", wid, "--beam-s", "0.05"]
+
+    ctrl = _controller(spool, workers=2, worker_cmd=cmd,
+                       drain_timeout_s=10.0)
+    with pytest.raises(RuntimeError):
+        ctrl.run()
+    assert all(not w.alive for w in ctrl.workers)
 
 
 def test_controller_crash_recovery_exactly_once(tmp_path):

@@ -136,6 +136,7 @@ class FleetController:
         self.log = logger or get_logger("fleet")
         self.sleeper = sleeper
         self.workers = [_Worker(f"w{i}") for i in range(workers)]
+        self._cycling: _Worker | None = None
         self._drain = threading.Event()
         self._quarantined_seen: set[str] = set()
         self.started_at = time.time()
@@ -205,6 +206,9 @@ class FleetController:
 
     def _reap(self) -> None:
         for w in self.workers:
+            if w is self._cycling:
+                continue     # mid-rolling-restart: its exit is the
+                             # drain we asked for, not a crash
             if w.proc is None or w.proc.poll() is None:
                 continue
             rc = w.proc.returncode
@@ -286,7 +290,10 @@ class FleetController:
                 sum(1 for s in states.values() if s == st), state=st)
         cap = protocol.fleet_capacity(self.spool,
                                       self.heartbeat_max_age_s)
-        telemetry.fleet_capacity().set(cap or 0)
+        # -1 = ZERO fresh workers (clients load-shed); 0 = fresh
+        # workers but a full queue (backpressure) — a dashboard must
+        # be able to tell a down fleet from a busy one
+        telemetry.fleet_capacity().set(-1 if cap is None else cap)
         rec = {
             "t": time.time(),
             "controller_pid": os.getpid(),
@@ -305,9 +312,9 @@ class FleetController:
                 if wid not in states and wid != ""),
             "pending": protocol.pending_count(self.spool),
             "claimed": protocol.claimed_count(self.spool),
-            "done": len(protocol.list_tickets(self.spool, "done")),
-            "quarantined": len(protocol.list_tickets(self.spool,
-                                                     "quarantine")),
+            "done": protocol.state_count(self.spool, "done"),
+            "quarantined": protocol.state_count(self.spool,
+                                                "quarantine"),
             "capacity": cap,
         }
         try:
@@ -321,43 +328,70 @@ class FleetController:
 
     # ------------------------------------------------------ rolling restart
 
-    def _wait(self, pred, timeout: float) -> bool:
+    def _wait(self, pred, timeout: float, tick=None) -> bool:
         t0 = time.time()
         while time.time() - t0 < timeout:
             if pred():
                 return True
+            if tick is not None:
+                tick()
             self.sleeper(min(0.2, self.poll_s))
         return pred()
+
+    def _supervise_tick(self) -> None:
+        """One supervision beat (reap crashes, respawn due workers,
+        janitor the spool) — run INSIDE long waits so a slow rolling
+        drain of one worker cannot starve a crashed co-worker's
+        restart or leave its orphaned claim unrequeued for the whole
+        cycle."""
+        self._reap()
+        self._respawn_due()
+        self._janitor()
 
     def _rolling_restart(self) -> None:
         """Cycle workers ONE at a time so the fleet never goes fully
         cold: drain worker k, respawn it, wait for its fresh
-        heartbeat, only then move to worker k+1."""
+        heartbeat, only then move to worker k+1.  Supervision of the
+        OTHER workers keeps beating throughout (_supervise_tick); the
+        cycled worker itself is excluded from crash-reaping while it
+        drains (self._cycling)."""
         self.log.info("rolling restart: %d worker(s)",
                       len(self.workers))
         for w in self.workers:
             if self.draining:
                 return
-            if w.alive:
-                w.proc.send_signal(signal.SIGTERM)
-                if not self._wait(lambda: not w.alive,
-                                  self.drain_timeout_s):
-                    self.log.warning(
-                        "worker %s ignored SIGTERM for %.0f s; "
-                        "killing it", w.worker_id, self.drain_timeout_s)
-                    w.proc.kill()
-                    self._wait(lambda: not w.alive, 10.0)
-                w.last_rc = w.proc.returncode if w.proc else None
-                w.proc = None
-                self._mark_worker_down(w)
+            # the reap exclusion covers ONLY the old incarnation's
+            # drain (its exit is the drain we asked for, not a crash)
+            self._cycling = w
+            try:
+                if w.alive:
+                    w.proc.send_signal(signal.SIGTERM)
+                    if not self._wait(lambda: not w.alive,
+                                      self.drain_timeout_s,
+                                      tick=self._supervise_tick):
+                        self.log.warning(
+                            "worker %s ignored SIGTERM for %.0f s; "
+                            "killing it", w.worker_id,
+                            self.drain_timeout_s)
+                        w.proc.kill()
+                        self._wait(lambda: not w.alive, 10.0)
+                    w.last_rc = w.proc.returncode if w.proc else None
+                    w.proc = None
+                    self._mark_worker_down(w)
+            finally:
+                self._cycling = None
             if w.done or w.gave_up:
                 continue
             self._spawn(w, kind="rolling-restart")
             telemetry.fleet_restarts_total().inc(
                 worker=w.worker_id, kind="rolling")
+            # the NEW incarnation is supervised normally while we wait
+            # for its heartbeat: if the rolled-out binary crashes on
+            # boot, the tick's reap counts it and paces a backoff
+            # restart instead of spinning the full timeout unlogged
             self._wait(
                 lambda: self._worker_state(w) == "fresh",
-                self.drain_timeout_s)
+                self.drain_timeout_s, tick=self._supervise_tick)
             self._aggregate()
 
     # ----------------------------------------------------------- the loop
@@ -369,10 +403,13 @@ class FleetController:
         gave up with tickets still outstanding."""
         protocol.ensure_spool(self.spool)
         self.install_signal_handlers()
-        for w in self.workers:
-            self._spawn(w)
         rc = 0
         try:
+            # inside the try: a spawn failure for worker k must still
+            # run _shutdown so workers 0..k-1 are not leaked running
+            # unsupervised (no janitor, no restarts, no drain)
+            for w in self.workers:
+                self._spawn(w)
             while not self.draining:
                 self._reap()
                 self._respawn_due()
@@ -440,9 +477,9 @@ class FleetController:
             "done=%d quarantined=%d",
             time.time() - self.started_at,
             protocol.pending_count(self.spool),
-            len(protocol.list_tickets(self.spool, "claimed")),
-            len(protocol.list_tickets(self.spool, "done")),
-            len(protocol.list_tickets(self.spool, "quarantine")))
+            protocol.state_count(self.spool, "claimed"),
+            protocol.state_count(self.spool, "done"),
+            protocol.state_count(self.spool, "quarantine"))
         return rc
 
 
@@ -484,8 +521,8 @@ def render_status(spool: str,
     cap = protocol.fleet_capacity(spool, max_age_s)
     lines.append(
         f"spool: pending={protocol.pending_count(spool)} "
-        f"claimed={len(protocol.list_tickets(spool, 'claimed'))} "
-        f"done={len(protocol.list_tickets(spool, 'done'))} "
-        f"quarantined={len(protocol.list_tickets(spool, 'quarantine'))}"
+        f"claimed={protocol.state_count(spool, 'claimed')} "
+        f"done={protocol.state_count(spool, 'done')} "
+        f"quarantined={protocol.state_count(spool, 'quarantine')}"
         f" capacity={'none (0 fresh workers)' if cap is None else cap}")
     return "\n".join(lines)

@@ -265,7 +265,9 @@ def fleet_capacity() -> metrics.Gauge:
         "tpulsar_fleet_capacity",
         "aggregate remaining admission capacity: sum of fresh "
         "workers' advertised queue depths minus tickets waiting "
-        "(what the warm backend's can_submit consults)")
+        "(what the warm backend's can_submit consults); 0 = fresh "
+        "workers but a saturated queue (backpressure), -1 = ZERO "
+        "fresh workers (clients load-shed to process-per-beam)")
 
 
 # --------------------------------------------------------------------

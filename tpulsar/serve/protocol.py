@@ -15,7 +15,13 @@ crash-safe rename:
 
 A ticket moves ``incoming -> claimed`` by atomic rename (exactly-one
 claimer even with several workers on one spool) and is deleted from
-``claimed`` only after its result record is durable in ``done/``.  A
+``claimed`` only after its result record is durable in ``done/``.
+The claim itself lands in two renames — ``incoming/<tid>.json`` ->
+``claimed/<tid>.json.claiming.<pid>`` (the exclusive step), stamp the
+owner pid/worker into that private file, then promote it to the plain
+claim — so a plain claim ALWAYS carries its owner and a concurrently
+scanning janitor can never mistake a half-made claim for an ownerless
+orphan.  A
 worker that dies mid-beam therefore leaves the ticket in ``claimed``;
 ``requeue_stale_claims`` (run at worker boot and continuously by the
 fleet controller's janitor) moves such orphans back to ``incoming`` —
@@ -140,23 +146,43 @@ def list_tickets(spool: str, state: str) -> list[str]:
 
 
 def pending_count(spool: str) -> int:
-    return len(list_tickets(spool, "incoming"))
+    """Waiting tickets, counted from the directory listing alone —
+    the controller loop, fleet_capacity, and every can_submit call
+    come through here, and only list_tickets (which must SORT by
+    submission time) needs to open and parse the ticket files."""
+    return state_count(spool, "incoming")
+
+
+def state_count(spool: str, state: str) -> int:
+    """Ticket count in a spool state from the directory listing alone
+    (the controller's poll loop and status rendering need counts, not
+    parsed-and-sorted records — a fleet that has completed 50k beams
+    must not re-parse 50k result files every second)."""
+    assert state in _STATES, state
+    d = os.path.join(spool, state)
+    try:
+        return sum(1 for n in os.listdir(d) if n.endswith(".json"))
+    except OSError:
+        return 0
 
 
 def claimed_count(spool: str) -> int:
-    """Outstanding claims INCLUDING those a janitor has momentarily
-    renamed aside for requeue (``.takeover.<pid>``): a requeue in
-    flight is still outstanding work, and an exit check that reads
-    only plain claims could declare the spool drained in the
-    microseconds between the takeover rename and the incoming/ write
-    — stranding the ticket with no worker left."""
+    """Outstanding claims INCLUDING those momentarily renamed aside —
+    by a janitor for requeue (``.takeover.<pid>``) or by a claimer
+    mid-stamp (``.claiming.<pid>``): a requeue or claim in flight is
+    still outstanding work, and an exit check that reads only plain
+    claims could declare the spool drained in the microseconds
+    between the rename and the next write — stranding the ticket with
+    no worker left."""
     d = os.path.join(spool, "claimed")
     try:
         names = os.listdir(d)
     except OSError:
         return 0
     return sum(1 for n in names
-               if n.endswith(".json") or ".json.takeover." in n)
+               if not n.endswith(".tmp")      # _atomic_write_json's
+               and (n.endswith(".json") or ".json.takeover." in n
+                    or ".json.claiming." in n))
 
 
 def claim_next_ticket(spool: str, worker_id: str = "") -> dict | None:
@@ -165,23 +191,102 @@ def claim_next_ticket(spool: str, worker_id: str = "") -> dict | None:
     claim: two workers on one spool cannot claim the same ticket.
     The claim records the owner (pid + worker id) so the requeue
     machinery can tell a dead owner's orphan from a live co-worker's
-    in-flight beam."""
+    in-flight beam.
+
+    The claim lands in two renames: ``incoming/<tid>.json`` ->
+    ``claimed/<tid>.json.claiming.<pid>`` (exclusive), stamp the owner
+    into that private file, then rename it to the plain claim.  An
+    OWNERLESS plain claim therefore never exists, so a janitor
+    scanning ``claimed/`` mid-claim cannot mistake a live worker's
+    half-stamped claim for a dead worker's orphan and requeue a beam
+    that is about to be processed (the ticket would then exist in both
+    incoming/ and claimed/ — two workers, one beam).  A claimer that
+    dies between the renames leaves ``.claiming.<pid>``, which
+    _recover_abandoned_claimings returns to incoming/.
+
+    A claimer that STALLS (SIGSTOP, VM pause) long enough for the
+    janitor's grace window to expire may find its staging file stolen
+    when it resumes.  Every step after the exclusive rename is
+    theft-safe: the stamp write is bracketed by in-process hold-age
+    checks (a claimer past half the grace window withdraws — renames
+    the ticket back to incoming, or discards its re-created staging
+    copy when the ticket demonstrably moved on without it — instead
+    of racing the janitor), and promotion is ``os.link`` + unlink of
+    the staging, which refuses (EEXIST) to clobber a plain claim a
+    co-claimer promoted in the meantime and raises ENOENT when the
+    staging was stolen — a lost claim is abandoned, never
+    fabricated."""
+    grace = ORPHAN_SIDEFILE_GRACE_S
     for tid in list_tickets(spool, "incoming"):
         src = ticket_path(spool, tid, "incoming")
         dst = ticket_path(spool, tid, "claimed")
+        staging = f"{dst}.claiming.{os.getpid()}"
+        held_at = time.time()
         try:
-            os.rename(src, dst)
+            _rename_held(src, staging)
         except OSError:
             continue            # lost the race; try the next ticket
-        rec = _read_json(dst)
-        if rec is not None:
-            rec["claimed_at"] = time.time()
-            rec["claimed_by"] = os.getpid()
-            if worker_id:
-                rec["claimed_by_worker"] = worker_id
-            _atomic_write_json(dst, rec)
+        rec = _read_json(staging)
+        if rec is None:
+            try:
+                os.unlink(staging)   # torn/garbage ticket: drop it
+            except OSError:
+                pass
+            continue
+        if time.time() - held_at > grace / 2:
+            # we stalled mid-claim: a janitor may be about to judge
+            # (or has judged) our staging file abandoned — withdraw
+            # instead of racing it
+            try:
+                os.rename(staging, src)
+            except OSError:
+                pass            # already stolen: the ticket is safe
+            continue
+        rec["claimed_at"] = time.time()
+        rec["claimed_by"] = os.getpid()
+        if worker_id:
+            rec["claimed_by_worker"] = worker_id
+        _atomic_write_json(staging, rec)
+        # the replace above refreshed the staging mtime, so from here
+        # we hold a fresh full grace window — but if we stalled BEFORE
+        # it, the write may have re-created a path a janitor already
+        # recovered; the ticket existing anywhere else proves the
+        # theft, and our staging copy is the duplicate to discard
+        if time.time() - held_at > grace / 2 \
+                and _ticket_exists_elsewhere(spool, tid):
+            try:
+                os.unlink(staging)
+            except OSError:
+                pass
+            continue
+        try:
+            os.link(staging, dst)
+        except FileExistsError:
+            # a co-claimer (fed by a janitor's requeue of this very
+            # ticket) promoted first: theirs is the claim, ours is
+            # the duplicate
+            try:
+                os.unlink(staging)
+            except OSError:
+                pass
+            continue
+        except FileNotFoundError:
+            continue            # stolen while we stalled post-stamp
+        except OSError:
+            # hard links unsupported here (some network/FUSE mounts:
+            # EPERM/ENOTSUP): promote by plain rename — losing only
+            # the refuse-to-clobber hardening, never stranding the
+            # ticket in its .claiming side-file for the grace window
+            try:
+                os.rename(staging, dst)
+            except OSError:
+                continue
             return rec
-        os.unlink(dst)          # torn/garbage ticket: drop it
+        try:
+            os.unlink(staging)
+        except OSError:
+            pass
+        return rec
     return None
 
 
@@ -206,17 +311,81 @@ def _pid_alive(pid) -> bool:
     return True
 
 
+#: a ``.takeover.<pid>`` / ``.claiming.<pid>`` file is held for
+#: milliseconds by a live process; one this old is abandoned even if
+#: its pid reads alive (pid recycled by an unrelated process) — the
+#: age fallback keeps a recycled pid from stranding a ticket forever
+ORPHAN_SIDEFILE_GRACE_S = HEARTBEAT_MAX_AGE_S
+
+
+def _sidefile_owner_live(path: str, pid,
+                         grace_s: float = ORPHAN_SIDEFILE_GRACE_S
+                         ) -> bool:
+    """Does a transient claim side-file still belong to a live owner?
+    Liveness is pid-alive AND recently renamed: past the grace window
+    the pid must be a recycled one, because no healthy claim or
+    takeover holds its side-file for minutes.  The age read here is
+    HOLD time, not content age — every exclusive rename that creates
+    a side-file re-touches it (_rename_held), since os.rename
+    preserves mtime and a ticket that waited minutes in incoming/
+    (or a claim held through a long beam) would otherwise make a
+    fresh side-file look ancient and steal-able."""
+    if not _pid_alive(pid):
+        return False
+    try:
+        age = time.time() - os.stat(path).st_mtime
+    except OSError:
+        return False             # gone already: nothing to recover
+    return age <= grace_s
+
+
+def _rename_held(src: str, dst: str) -> None:
+    """Exclusive-rename a ticket into a transient side-file with its
+    mtime stamped to NOW: the grace-window scans must measure how
+    long the side-file has been held, and a plain rename carries the
+    source's (possibly minutes-old) mtime along.  The touch happens
+    BEFORE the rename so the side-file is never observable with an
+    ancient mtime — a touch-after ordering would leave a syscall-wide
+    window in which a janitor could stat a freshly renamed side-file,
+    read the backpressure-aged mtime, and steal a live claim.  A
+    failed touch aborts the claim attempt (OSError propagates and the
+    ticket stays put): proceeding with a stale mtime would re-open
+    exactly that theft window.  Source mtimes carry no meaning of
+    their own (FIFO order is the ticket's submitted_at field), so a
+    touch whose rename then loses the race is harmless."""
+    os.utime(src)
+    os.rename(src, dst)
+
+
+def _strip_claim_stamps(rec: dict) -> dict:
+    rec.pop("claimed_at", None)
+    rec.pop("claimed_by", None)
+    rec.pop("claimed_by_worker", None)
+    return rec
+
+
+def _ticket_exists_elsewhere(spool: str, ticket_id: str) -> bool:
+    """Does the ticket exist in ANY spool state (a side-file holder
+    checking whether the ticket has already moved on without it)?"""
+    return any(os.path.exists(ticket_path(spool, ticket_id, state))
+               for state in _STATES)
+
+
 def _takeover_claim(spool: str, ticket_id: str) -> str | None:
     """Take exclusive ownership of a claim file before requeueing it:
     the rename is atomic, so of N janitors racing over one dead
     worker's claim exactly one proceeds — the others see ENOENT and
     skip.  Without this, a slow janitor could re-create an incoming
     ticket another worker already re-claimed (a duplicate beam) or
-    unlink that worker's live claim (a lost one)."""
+    unlink that worker's live claim (a lost one).  The takeover is
+    re-touched (_rename_held): it must read as freshly held, not
+    inherit the claim's possibly-minutes-old stamp time, or a
+    concurrent janitor's grace-window scan would judge it abandoned
+    while this one is live mid-requeue."""
     src = ticket_path(spool, ticket_id, "claimed")
     tmp = f"{src}.takeover.{os.getpid()}"
     try:
-        os.rename(src, tmp)
+        _rename_held(src, tmp)
     except OSError:
         return None
     return tmp
@@ -232,7 +401,13 @@ def _recover_abandoned_takeovers(spool: str) -> None:
     claim (or fork the ticket into two states) and double-process the
     beam.  Only when the ticket exists NOWHERE else is the takeover
     restored to a plain claim for the normal stale-claim scan — a
-    ticket must never be lost to a crashed janitor."""
+    ticket must never be lost to a crashed janitor.
+
+    Abandonment is judged by _sidefile_owner_live — owner pid dead,
+    OR the file older than the grace window (a recycled pid must not
+    hide a dead janitor's takeover from recovery: the ticket would be
+    stuck invisible to requeue yet counted by claimed_count, so a
+    --once fleet could never report the spool drained)."""
     d = os.path.join(spool, "claimed")
     try:
         names = os.listdir(d)
@@ -240,19 +415,100 @@ def _recover_abandoned_takeovers(spool: str) -> None:
         return
     for name in names:
         base, sep, pid = name.partition(".takeover.")
-        if not sep or not base.endswith(".json") or _pid_alive(pid):
+        if not sep or not base.endswith(".json"):
+            continue
+        path = os.path.join(d, name)
+        if _sidefile_owner_live(path, pid):
             continue
         tid = base[:-len(".json")]
-        if any(os.path.exists(ticket_path(spool, tid, state))
-               for state in ("incoming", "claimed", "done",
-                             "quarantine")):
+        if _ticket_exists_elsewhere(spool, tid):
             try:
-                os.unlink(os.path.join(d, name))
+                os.unlink(path)
+            except OSError:
+                pass
+            continue
+        rec = _read_json(path)
+        if rec is not None and "claimed_by" not in rec:
+            # an UNSTAMPED takeover: the dead janitor was recovering a
+            # .claiming file (or had already stripped the stamps for
+            # requeue).  Restoring it as a plain claim would create an
+            # ownerless claim and the main scan would charge an
+            # attempts strike for a beam that was never started —
+            # route it straight back to incoming, attempt-neutrally,
+            # after re-owning it so a racing janitor can't duplicate
+            # the incoming write around a fresh re-claim.
+            tmp = os.path.join(d, f"{base}.takeover.{os.getpid()}")
+            try:
+                _rename_held(path, tmp)
+            except OSError:
+                continue         # another janitor beat us to it
+            _atomic_write_json(ticket_path(spool, tid, "incoming"),
+                               _strip_claim_stamps(rec))
+            try:
+                os.unlink(tmp)
             except OSError:
                 pass
             continue
         try:
-            os.rename(os.path.join(d, name), os.path.join(d, base))
+            os.rename(path, os.path.join(d, base))
+        except OSError:
+            pass
+
+
+def _recover_abandoned_claimings(spool: str) -> None:
+    """A claimer that died between renaming a ticket to
+    ``<tid>.json.claiming.<pid>`` and promoting the stamped file to a
+    plain claim left the ticket in neither incoming/ nor claimed/ —
+    invisible to workers and to the stale-claim scan.  The beam was
+    never started (the promotion rename precedes any processing), so
+    the recovery is attempt-neutral: strip any claim stamp and return
+    the ticket to incoming/ for the next claimer.  Abandonment is
+    judged by _sidefile_owner_live (dead pid, or older than the grace
+    window so a recycled pid cannot strand the ticket).
+
+    The recovery first renames the claiming file to a takeover of its
+    OWN (``.takeover.<mypid>``): of N janitors racing over one dead
+    claimer's file exactly one proceeds, so a slow second janitor can
+    never re-create an incoming ticket a worker has since re-claimed
+    — and a janitor that dies mid-recovery leaves an ordinary
+    abandoned takeover, which the next scan reconciles."""
+    d = os.path.join(spool, "claimed")
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        if name.endswith(".tmp"):       # a stamp write's tmp file,
+            continue                    # not the staging file itself
+        base, sep, pid = name.partition(".claiming.")
+        if not sep or not base.endswith(".json"):
+            continue
+        path = os.path.join(d, name)
+        if _sidefile_owner_live(path, pid):
+            continue
+        tid = base[:-len(".json")]
+        tmp = os.path.join(d, f"{base}.takeover.{os.getpid()}")
+        try:
+            _rename_held(path, tmp)
+        except OSError:
+            continue             # another janitor beat us to it
+        if _ticket_exists_elsewhere(spool, tid):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            continue
+        rec = _read_json(tmp)
+        if rec is None:
+            try:
+                os.unlink(tmp)       # torn/garbage ticket: drop it
+            except OSError:
+                pass
+            continue
+        _strip_claim_stamps(rec)
+        _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
+        try:
+            os.unlink(tmp)
         except OSError:
             pass
 
@@ -274,6 +530,55 @@ def _quarantine(spool: str, rec: dict, max_attempts: int) -> None:
         outdir=rec.get("outdir", ""))
 
 
+def _requeue_claims(spool: str, verdict_fn,
+                    max_attempts: int = DEFAULT_MAX_ATTEMPTS
+                    ) -> list[str]:
+    """The one crash-safe requeue skeleton both public requeues run:
+    reconcile claims that already have a done record, judge the rest
+    via ``verdict_fn(rec)`` (None = leave the claim alone, 'neutral'
+    = requeue without a strike, 'strike' = crash-shaped requeue that
+    counts attempts and quarantines at the cap), take the claim file
+    over exclusively, and make the incoming/ record durable BEFORE
+    unlinking the takeover — the ordering a crashed requeuer depends
+    on to never lose a ticket."""
+    requeued = []
+    for tid in list_tickets(spool, "claimed"):
+        src = ticket_path(spool, tid, "claimed")
+        if os.path.exists(ticket_path(spool, tid, "done")):
+            try:
+                os.unlink(src)
+            except OSError:
+                pass
+            continue
+        rec = _read_json(src)
+        if rec is None:
+            continue
+        verdict = verdict_fn(rec)
+        if verdict is None:
+            continue
+        tmp = _takeover_claim(spool, tid)
+        if tmp is None:
+            continue            # another janitor beat us to it
+        rec = _strip_claim_stamps(_read_json(tmp) or rec)
+        if verdict == "strike":
+            # the owner died holding this beam: one more strike
+            rec["attempts"] = int(rec.get("attempts", 0)) + 1
+            if rec["attempts"] >= max_attempts:
+                _quarantine(spool, rec, max_attempts)
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                continue
+        _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        requeued.append(tid)
+    return requeued
+
+
 def requeue_stale_claims(spool: str,
                          max_attempts: int = DEFAULT_MAX_ATTEMPTS
                          ) -> list[str]:
@@ -293,47 +598,17 @@ def requeue_stale_claims(spool: str,
     ``list_tickets(spool, "quarantine")``)."""
     ensure_spool(spool)
     _recover_abandoned_takeovers(spool)
+    _recover_abandoned_claimings(spool)
     me = os.getpid()
-    requeued = []
-    for tid in list_tickets(spool, "claimed"):
-        src = ticket_path(spool, tid, "claimed")
-        if os.path.exists(ticket_path(spool, tid, "done")):
-            try:
-                os.unlink(src)
-            except OSError:
-                pass
-            continue
-        rec = _read_json(src)
-        if rec is None:
-            continue
+
+    def verdict(rec):
         owner = rec.get("claimed_by")
-        own = owner == me
-        if owner is not None and not own and _pid_alive(owner):
-            continue            # a live co-worker owns this beam
-        tmp = _takeover_claim(spool, tid)
-        if tmp is None:
-            continue            # another janitor beat us to it
-        rec = _read_json(tmp) or rec
-        rec.pop("claimed_at", None)
-        rec.pop("claimed_by", None)
-        rec.pop("claimed_by_worker", None)
-        if not own:
-            # the owner died holding this beam: one more strike
-            rec["attempts"] = int(rec.get("attempts", 0)) + 1
-            if rec["attempts"] >= max_attempts:
-                _quarantine(spool, rec, max_attempts)
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                continue
-        _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        requeued.append(tid)
-    return requeued
+        if owner == me:
+            return "neutral"    # our own claim (boot recovery)
+        if owner is not None and _pid_alive(owner):
+            return None         # a live co-worker owns this beam
+        return "strike"
+    return _requeue_claims(spool, verdict, max_attempts)
 
 
 def requeue_own_claims(spool: str) -> list[str]:
@@ -344,32 +619,9 @@ def requeue_own_claims(spool: str) -> list[str]:
     record are just reconciled."""
     ensure_spool(spool)
     me = os.getpid()
-    requeued = []
-    for tid in list_tickets(spool, "claimed"):
-        src = ticket_path(spool, tid, "claimed")
-        if os.path.exists(ticket_path(spool, tid, "done")):
-            try:
-                os.unlink(src)
-            except OSError:
-                pass
-            continue
-        rec = _read_json(src)
-        if rec is None or rec.get("claimed_by") != me:
-            continue
-        tmp = _takeover_claim(spool, tid)
-        if tmp is None:
-            continue
-        rec = _read_json(tmp) or rec
-        rec.pop("claimed_at", None)
-        rec.pop("claimed_by", None)
-        rec.pop("claimed_by_worker", None)
-        _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        requeued.append(tid)
-    return requeued
+    return _requeue_claims(
+        spool,
+        lambda rec: "neutral" if rec.get("claimed_by") == me else None)
 
 
 # ------------------------------------------------------------- results
@@ -401,12 +653,14 @@ def ticket_state(spool: str, ticket_id: str) -> str:
     for state in ("done", "claimed", "incoming"):
         if os.path.exists(ticket_path(spool, ticket_id, state)):
             return state
-    # a claim mid-takeover by a janitor is still claimed work — don't
-    # let a poller observe a transient 'unknown' and declare it lost
+    # a claim mid-takeover by a janitor, or mid-stamp by a claimer
+    # (.claiming.<pid>), is still claimed work — don't let a poller
+    # observe a transient 'unknown' and declare it lost
     d = os.path.join(spool, "claimed")
     try:
         for name in os.listdir(d):
-            if name.startswith(f"{ticket_id}.json.takeover."):
+            if name.startswith((f"{ticket_id}.json.takeover.",
+                                f"{ticket_id}.json.claiming.")):
                 return "claimed"
     except OSError:
         pass

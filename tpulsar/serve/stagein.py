@@ -101,7 +101,10 @@ class StageInPipeline:
             maxsize=max(1, depth))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # guarded by _dropped_lock: appended by the stage-in thread,
+        # drained by stop() — which can overlap when the join times out
         self._dropped: list[PreparedBeam] = []
+        self._dropped_lock = threading.Lock()
 
     # ----------------------------------------------------------- thread
 
@@ -137,7 +140,8 @@ class StageInPipeline:
                 # dir; the still-claimed ticket is requeued by the
                 # server's drain (requeue_own_claims)
                 prepared.cleanup()
-                self._dropped.append(prepared)
+                with self._dropped_lock:
+                    self._dropped.append(prepared)
 
     # ----------------------------------------------------------- caller
 
@@ -153,7 +157,10 @@ class StageInPipeline:
         unconsumed beam — both those waiting in the handoff queue and
         any the stopping thread had to drop (all already cleaned up;
         their tickets are still claimed in the spool for the caller
-        to requeue)."""
+        to requeue).  When the join times out the list is best-effort
+        — the abandoned thread may drop one more beam after we return
+        — which is safe because the caller's requeue_own_claims
+        rescans the spool rather than trusting this list."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
@@ -171,6 +178,7 @@ class StageInPipeline:
                 break
             b.cleanup()
             leftovers.append(b)
-        leftovers.extend(self._dropped)
-        self._dropped = []
+        with self._dropped_lock:
+            leftovers.extend(self._dropped)
+            self._dropped = []
         return leftovers
