@@ -16,6 +16,7 @@ import time
 import pytest
 
 from tpulsar.fleet import controller as fleet_ctl
+from tpulsar.obs import journal
 from tpulsar.orchestrate.queue_managers.warm import WarmServerManager
 from tpulsar.resilience import faults
 from tpulsar.serve import protocol
@@ -634,6 +635,42 @@ def test_controller_crash_recovery_exactly_once(tmp_path):
     w0 = next(w for w in fleet["workers"] if w["id"] == "w0")
     assert w0["gave_up"] and w0["last_rc"] == 70
 
+    # --- journal completeness under crash recovery (the tentpole's
+    # acceptance property): the victim beam's lifecycle reconstructs
+    # from the journal ALONE — claim by w0, takeover (the crash
+    # evidence the dead worker could not write), re-claim by w1, one
+    # terminal done with matching attempt numbers
+    victim = crashed[0]["ticket"]
+    evs = journal.read_events(spool, ticket=victim)
+    assert journal.validate_chain(evs) == [], evs
+    claims = [e for e in evs if e["event"] == "claimed"]
+    assert claims[0]["worker"] == "w0" and claims[0]["attempt"] == 0
+    assert claims[-1]["worker"] == "w1" and claims[-1]["attempt"] == 1
+    steals = [e for e in evs if e["event"] == "takeover"]
+    assert len(steals) == 1
+    assert steals[0]["from_worker"] == "w0"
+    assert steals[0]["attempt"] == 1          # the strike
+    terminal = [e for e in evs if e["event"] == journal.TERMINAL_EVENT]
+    assert len(terminal) == 1                 # exactly-once, as events
+    assert terminal[0]["status"] == "done"
+    assert terminal[0]["worker"] == "w1"
+    assert terminal[0]["attempt"] == 1
+    # ONE trace id spans the whole cross-worker chain
+    trace_ids = {e["trace_id"] for e in evs if e.get("trace_id")}
+    assert len(trace_ids) == 1
+    # property-style: EVERY terminal ticket has a well-formed chain
+    # with exactly one terminal event
+    per = journal.iter_tickets(journal.read_events(spool))
+    for tid in tickets:
+        assert journal.validate_chain(per[tid]) == [], tid
+    # the controller's merged fleet.prom carries the journal SLOs,
+    # with the e2e series sourced from BOTH workers' data
+    prom = open(os.path.join(spool, "fleet.prom")).read()
+    assert 'tpulsar_fleet_slo_seconds{series="beam_e2e",' \
+           'quantile="p95"}' in prom
+    assert 'tpulsar_fleet_slo_source_workers{series="beam_e2e"} 2' \
+        in prom
+
 
 def test_controller_restart_budget_backoff(tmp_path):
     """A worker that cannot stay up is restarted under the backoff
@@ -667,6 +704,19 @@ def test_controller_quarantines_crash_looping_beam(tmp_path):
     assert rec["reason"] == "max_attempts" and rec["attempts"] == 2
     assert protocol.pending_count(spool) == 0
     assert protocol.list_tickets(spool, "claimed") == []
+    # the journal tells the quarantine story end to end: each crash
+    # left a takeover strike, then the quarantined marker, then the
+    # ONE terminal failed result — a well-formed chain even for a
+    # beam that never finished a search
+    evs = journal.read_events(spool, ticket="poison")
+    assert journal.validate_chain(evs) == [], evs
+    names = [e["event"] for e in evs]
+    assert names.count("takeover") == 1       # crash 1 (crash 2 hits
+    assert "quarantined" in names             # the cap instead)
+    terminal = [e for e in evs if e["event"] == journal.TERMINAL_EVENT]
+    assert len(terminal) == 1
+    assert terminal[0]["status"] == "failed"
+    assert terminal[0]["attempt"] == 2
 
 
 def test_controller_rolling_restart_and_drain_control(tmp_path):

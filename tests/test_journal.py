@@ -1,0 +1,487 @@
+"""Fleet observability tests: the ticket lifecycle journal
+(obs/journal.py), the fleet metrics aggregator (obs/fleetview.py),
+trace-id propagation through the spool, the `tpulsar obs` console,
+`tools/trace_summarize.py --spool` mode, and the bench/v2 regression
+gate (tools/bench_gate.py)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+from tpulsar.obs import fleetview, journal, metrics, trace
+from tpulsar.serve import protocol
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _dead_pid() -> int:
+    p = subprocess.Popen(["true"])
+    p.wait()
+    return p.pid
+
+
+def _forge_owner(spool, tid, owner, worker=""):
+    path = protocol.ticket_path(spool, tid, "claimed")
+    rec = json.load(open(path))
+    rec["claimed_by"] = owner
+    if worker:
+        rec["claimed_by_worker"] = worker
+    protocol._atomic_write_json(path, rec)
+
+
+# ------------------------------------------------------------ journal
+
+def test_protocol_transitions_land_in_the_journal(tmp_path):
+    """Every spool transition appends exactly one stamped event —
+    submitted (which mints the trace id), claimed, and the terminal
+    result — all carrying the SAME trace id."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    ticket = json.load(open(protocol.ticket_path(spool, "t1",
+                                                 "incoming")))
+    assert ticket["trace_id"]                  # minted at submission
+    protocol.claim_next_ticket(spool, "w0")
+    protocol.write_result(spool, "t1", "done", rc=0, worker="w0",
+                          attempts=0, outdir="/o")
+    evs = journal.read_events(spool, ticket="t1")
+    assert [e["event"] for e in evs] == ["submitted", "claimed",
+                                         "result"]
+    assert all(e["trace_id"] == ticket["trace_id"] for e in evs)
+    assert evs[1]["worker"] == "w0"
+    assert evs[1]["queue_wait_s"] >= 0.0
+    assert evs[2]["status"] == "done"
+    # the done record carries the trace id too (read back from the
+    # claim, since the stub-shaped caller didn't thread it through)
+    assert protocol.read_result(spool, "t1")["trace_id"] \
+        == ticket["trace_id"]
+    assert journal.validate_chain(evs) == []
+
+
+def test_journal_appends_are_observational(tmp_path, monkeypatch):
+    """A journal write failure must never fail the transition it
+    records (read-only events dir: the claim still succeeds)."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    monkeypatch.setattr(journal, "journal_path",
+                        lambda s: "/proc/denied/journal.jsonl")
+    assert journal.record(spool, "claimed", ticket="t1") is None
+    assert protocol.claim_next_ticket(spool, "w0")["ticket"] == "t1"
+
+
+def test_journal_skips_torn_lines_and_rotates(tmp_path, monkeypatch):
+    spool = str(tmp_path / "spool")
+    journal.record(spool, "submitted", ticket="a")
+    with open(journal.journal_path(spool), "a") as fh:
+        fh.write('{"event": "claimed", "ticket": "a", "t":')  # torn
+    evs = journal.read_events(spool)
+    assert [e["event"] for e in evs] == ["submitted"]
+    # rotation: the old generation stays readable
+    monkeypatch.setattr(journal, "MAX_BYTES", 1)
+    journal.record(spool, "claimed", ticket="a", attempt=0)
+    assert os.path.exists(journal.journal_path(spool) + ".1")
+    assert [e["event"] for e in journal.read_events(spool, "a")] \
+        == ["submitted", "claimed"]
+
+
+def test_takeover_and_quarantine_chain(tmp_path):
+    """A steal writes the crash evidence (takeover names the dead
+    owner, attempt = the strike); the cap writes quarantined + ONE
+    terminal failed result — and the chain validates."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "bad", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    _forge_owner(spool, "bad", _dead_pid(), "w0")
+    assert protocol.requeue_stale_claims(spool, max_attempts=2) \
+        == ["bad"]
+    protocol.claim_next_ticket(spool, "w1")
+    _forge_owner(spool, "bad", _dead_pid(), "w1")
+    assert protocol.requeue_stale_claims(spool, max_attempts=2) == []
+    evs = journal.read_events(spool, ticket="bad")
+    names = [e["event"] for e in evs]
+    assert names == ["submitted", "claimed", "takeover", "claimed",
+                     "quarantined", "result"]
+    steal = evs[2]
+    assert steal["from_worker"] == "w0" and steal["attempt"] == 1
+    assert evs[4]["attempt"] == 2
+    assert evs[5]["status"] == "failed"
+    assert journal.validate_chain(evs) == []
+    assert len({e["trace_id"] for e in evs if e.get("trace_id")}) == 1
+
+
+def test_drain_requeue_event_is_attempt_neutral(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    assert protocol.requeue_own_claims(spool) == ["t1"]
+    evs = journal.read_events(spool, ticket="t1")
+    assert evs[-1]["event"] == "drain_requeue"
+    assert evs[-1]["reason"] == "drain"
+    assert evs[-1]["attempt"] == 0
+
+
+def test_validate_chain_flags_malformed_histories():
+    t = time.time()
+
+    def ev(i, event, **kw):
+        return {"t": t + i, "event": event, "ticket": "x", **kw}
+
+    assert journal.validate_chain([]) == ["no events"]
+    # double terminal
+    probs = journal.validate_chain(
+        [ev(0, "submitted", attempt=0), ev(1, "claimed", attempt=0),
+         ev(2, "result", attempt=0, status="done"),
+         ev(3, "result", attempt=0, status="done")])
+    assert any("terminal" in p for p in probs)
+    # missing submitted
+    assert journal.validate_chain(
+        [ev(0, "claimed", attempt=0),
+         ev(1, "result", attempt=0)])[0].startswith("first event")
+    # attempts going backwards
+    probs = journal.validate_chain(
+        [ev(0, "submitted", attempt=0), ev(1, "claimed", attempt=2),
+         ev(2, "claimed", attempt=1),
+         ev(3, "result", attempt=1, status="done")])
+    assert any("backwards" in p for p in probs)
+
+
+def test_timeline_renders_cross_worker_story(tmp_path, capsys):
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    _forge_owner(spool, "t1", _dead_pid(), "w0")
+    protocol.requeue_stale_claims(spool)
+    protocol.claim_next_ticket(spool, "w1")
+    protocol.write_result(spool, "t1", "done", rc=0, worker="w1",
+                          attempts=1, outdir="/o")
+    text = journal.render_timeline(spool, "t1")
+    assert "takeover" in text and "from_worker=w0" in text
+    assert "workers: w0, w1" in text
+    assert "status: done" in text
+    # the CLI spelling
+    from tpulsar.cli.main import main as cli
+    assert cli(["obs", "timeline", "t1", "--spool", spool]) == 0
+    assert "takeover" in capsys.readouterr().out
+    assert cli(["obs", "timeline", "ghost", "--spool", spool]) == 1
+    capsys.readouterr()
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    from tpulsar.config import TpulsarConfig, set_settings
+
+    cfg = TpulsarConfig()
+    cfg.basic.log_dir = str(tmp_path / "logs")
+    cfg.background.jobtracker_db = str(tmp_path / "jt.db")
+    cfg.download.datadir = str(tmp_path / "raw")
+    cfg.processing.base_working_directory = str(tmp_path / "work")
+    cfg.processing.base_results_directory = str(tmp_path / "res")
+    cfg.resultsdb.url = str(tmp_path / "results.db")
+    cfg.check_sanity(create_dirs=True)
+    set_settings(cfg)
+    yield cfg
+    set_settings(TpulsarConfig())
+
+
+def test_server_beam_journals_full_chain(tmp_path, cfg):
+    """A served beam's chain includes the server-side events —
+    stage-in and search start — between claim and terminal, and the
+    worker exports its registry snapshot for the aggregator."""
+    import types
+
+    from tpulsar.io import synth
+    from tpulsar.serve.server import SearchServer
+
+    spool = str(tmp_path / "spool")
+    spec = synth.BeamSpec(nchan=16, nsamp=512, nsblk=64, scan=100)
+    fns = synth.synth_beam(str(tmp_path / "data"), spec, merged=True)
+    protocol.write_ticket(spool, "t0", fns, str(tmp_path / "out"),
+                          job_id=0)
+    outcome = types.SimpleNamespace(compile_misses=0, compile_hits=1,
+                                    candidates=[], num_dm_trials=4)
+    srv = SearchServer(spool=spool, cfg=cfg, worker_id="w5",
+                       warm_boot=False, poll_s=0.05,
+                       beam_fn=lambda p: outcome)
+    assert srv.serve(once=True) == 0
+    evs = journal.read_events(spool, ticket="t0")
+    assert [e["event"] for e in evs] == [
+        "submitted", "claimed", "stagein_done", "search_start",
+        "result"]
+    assert journal.validate_chain(evs) == []
+    assert evs[2]["worker"] == "w5" and evs[2]["seconds"] >= 0.0
+    assert evs[3]["worker"] == "w5"
+    assert len({e["trace_id"] for e in evs if e.get("trace_id")}) == 1
+    # the heartbeat dropped this worker's registry snapshot
+    snaps = fleetview.worker_snapshots(spool)
+    assert "w5" in snaps
+    assert "tpulsar_serve_beams_total" in snaps["w5"]["metrics"]
+
+
+# ----------------------------------------------------------- fleetview
+
+def test_merge_snapshots_sums_counters_histograms_max_gauges():
+    def snap(n):
+        r = metrics.Registry()
+        r.counter("c_total", "c", ("k",)).inc(n, k="v")
+        r.gauge("g", "g").set(n)
+        h = r.histogram("h_seconds", "h", buckets=(1.0, 5.0))
+        h.observe(0.5 * n)
+        return r.snapshot()
+
+    merged = fleetview.merge_snapshots([snap(1), snap(2), snap(10)])
+    assert merged["c_total"]["series"]["v"] == 13
+    assert merged["g"]["series"][""] == 10
+    hs = merged["h_seconds"]["series"][""]
+    assert hs["count"] == 3 and hs["counts"] == [2, 1, 0]
+    # quantiles re-derived over the MERGED counts
+    assert hs["quantiles"]["p95"] == pytest.approx(
+        metrics.bucket_quantile((1.0, 5.0), [2, 1, 0], 0.95))
+    # version skew: a conflicting definition is skipped, not merged
+    r = metrics.Registry()
+    r.gauge("c_total", "now a gauge").set(5)
+    merged2 = fleetview.merge_snapshots([snap(1), r.snapshot()])
+    assert merged2["c_total"]["type"] == "counter"
+    assert merged2["c_total"]["series"]["v"] == 1
+
+
+def test_fleet_snapshot_drops_stale_workers_gauges(tmp_path):
+    """A dead worker's exported snapshot keeps contributing its
+    counters (history survives the process) but its gauges must not
+    haunt fleet.prom via the gauge-max merge."""
+    spool = str(tmp_path / "spool")
+    protocol.ensure_spool(spool)
+    os.makedirs(os.path.join(spool, "metrics"), exist_ok=True)
+    for wid, age, depth, beams in (("w0", 9999.0, 9, 3),
+                                   ("w1", 0.0, 2, 5)):
+        r = metrics.Registry()
+        r.gauge("tpulsar_serve_queue_depth", "depth").set(depth)
+        r.counter("tpulsar_serve_beams_total", "b",
+                  ("outcome",)).inc(beams, outcome="done")
+        protocol._atomic_write_json(
+            fleetview.snapshot_path(spool, wid),
+            {"t": time.time() - age, "worker": wid,
+             "metrics": r.snapshot()})
+    snap = fleetview.fleet_snapshot(spool)
+    # the dead w0's gauge (9) is gone; the fresh w1's survives
+    assert snap["tpulsar_serve_queue_depth"]["series"][""] == 2
+    # but its beam history still counts
+    assert snap["tpulsar_serve_beams_total"]["series"]["done"] == 8
+
+
+def test_fleet_prom_merges_workers_and_journal_slos(tmp_path):
+    """The acceptance shape: worker registry snapshots + journal
+    SLO quantiles sourced from >= 2 workers' data, one fleet.prom."""
+    spool = str(tmp_path / "spool")
+    # two workers' exported snapshots
+    for wid, beams in (("w0", 3), ("w1", 5)):
+        r = metrics.Registry()
+        r.counter("tpulsar_serve_beams_total", "beams",
+                  ("outcome",)).inc(beams, outcome="done")
+        protocol.ensure_spool(spool)
+        os.makedirs(os.path.join(spool, "metrics"), exist_ok=True)
+        protocol._atomic_write_json(
+            fleetview.snapshot_path(spool, wid),
+            {"t": time.time(), "worker": wid,
+             "metrics": r.snapshot()})
+    # journal: two beams finished by different workers
+    for i, wid in ((0, "w0"), (1, "w1")):
+        tid = f"t{i}"
+        protocol.write_ticket(spool, tid, ["/x"], "/o", job_id=i)
+        protocol.claim_next_ticket(spool, wid)
+        protocol.write_result(spool, tid, "done", rc=0, worker=wid,
+                              attempts=0, outdir="/o")
+    path = fleetview.write_fleet_prom(spool)
+    text = open(path).read()
+    assert 'tpulsar_serve_beams_total{outcome="done"} 8' in text
+    for q in ("p50", "p95", "p99"):
+        assert (f'tpulsar_fleet_slo_seconds{{series="beam_e2e",'
+                f'quantile="{q}"}}') in text
+    assert ('tpulsar_fleet_slo_source_workers{series="beam_e2e"} 2'
+            in text)
+    assert 'tpulsar_fleet_tickets{status="done"} 2' in text
+    assert 'tpulsar_fleet_event_rate{event="takeover"} 0' in text
+    # obs top renders from the same state
+    top = fleetview.render_top(spool)
+    assert "beam_e2e" in top and "tickets:" in top
+
+
+def test_stitch_merges_journal_and_cross_worker_spans(tmp_path):
+    """A stolen beam's spans from two 'workers' (two trace files
+    with different epochs) + the journal instants land on ONE
+    rebased time axis, filtered by the ticket's trace id."""
+    spool = str(tmp_path / "spool")
+    outdir = str(tmp_path / "out")
+    os.makedirs(outdir)
+    protocol.write_ticket(spool, "t1", ["/x"], outdir, job_id=1)
+    ticket = json.load(open(protocol.ticket_path(spool, "t1",
+                                                 "incoming")))
+    tid = ticket["trace_id"]
+    protocol.claim_next_ticket(spool, "w0")
+    protocol.write_result(spool, "t1", "done", rc=0, worker="w1",
+                          attempts=1, outdir=outdir)
+    t_now = time.time()
+    for i, (pid, name) in enumerate(((100, "stagein"),
+                                     (200, "search_block"))):
+        obj = {"traceEvents": [
+            {"name": name, "cat": "tpulsar", "ph": "X", "ts": 0.0,
+             "dur": 1000.0, "pid": pid, "tid": 1,
+             "args": {"trace_id": tid}},
+            {"name": "other_beam", "cat": "tpulsar", "ph": "X",
+             "ts": 0.0, "dur": 5.0, "pid": pid, "tid": 1,
+             "args": {"trace_id": "someone-else"}},
+        ], "otherData": {"trace_epoch_unix_s": t_now + i}}
+        with open(os.path.join(outdir, f"w{i}_trace.json"),
+                  "w") as fh:
+            json.dump(obj, fh)
+    stitched = fleetview.stitch(spool, "t1")
+    names = [e["name"] for e in stitched["traceEvents"]]
+    assert "journal:submitted" in names and "journal:result" in names
+    assert "stagein" in names and "search_block" in names
+    assert "other_beam" not in names          # foreign trace id
+    spans = {e["name"]: e for e in stitched["traceEvents"]
+             if e.get("ph") == "X"}
+    # the two workers' epochs differ by 1 s -> rebased ts differ too
+    assert spans["search_block"]["ts"] - spans["stagein"]["ts"] \
+        == pytest.approx(1e6, rel=0.01)
+    with pytest.raises(FileNotFoundError):
+        fleetview.stitch(spool, "ghost")
+
+
+# ------------------------------------------- trace_summarize --spool
+
+def test_trace_summarize_spool_mode(tmp_path, capsys):
+    ts = _load_tool("trace_summarize")
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "beam-a", ["/x"], "/o", job_id=1)
+    protocol.claim_next_ticket(spool, "w0")
+    protocol.write_result(spool, "beam-a", "done", rc=0, worker="w0",
+                          attempts=0, outdir="/o")
+    protocol.write_ticket(spool, "beam-b", ["/y"], "/o2", job_id=2)
+    assert ts.main([spool]) == 0
+    out = capsys.readouterr().out
+    assert "beam-a" in out and "in-flight" in out
+    # the --json contract: one parseable document
+    assert ts.main([spool, "--json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["tickets"]["beam-a"]["status"] == "done"
+    assert obj["tickets"]["beam-a"]["e2e_s"] >= 0.0
+    assert obj["statuses"] == {"done": 1, "in-flight": 1}
+    # --ticket narrows the table
+    assert ts.main([spool, "--json", "--ticket", "beam-a"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert list(obj["tickets"]) == ["beam-a"]
+
+
+# ------------------------------------------------------- bench gate
+
+@pytest.fixture()
+def bench_records(tmp_path):
+    base = {"metric": "serve_steady_state_beam_wallclock",
+            "value": 10.0, "unit": "s", "schema": "bench/v2",
+            "stage_rollup": {"FFT": {"seconds": 4.0, "count": 8},
+                             "folding": {"seconds": 1.0, "count": 1}},
+            "serve": {"warm_steady_state_s": 10.0,
+                      "cold_first_beam_s": 30.0}}
+    cand = json.loads(json.dumps(base))
+    bpath, cpath = (str(tmp_path / "base.json"),
+                    str(tmp_path / "cand.json"))
+    json.dump(base, open(bpath, "w"))
+
+    def write(c):
+        json.dump(c, open(cpath, "w"))
+        return bpath, cpath
+    return base, cand, write
+
+
+def test_bench_gate_passes_within_tolerance(bench_records, capsys):
+    bg = _load_tool("bench_gate")
+    base, cand, write = bench_records
+    cand["value"] = cand["serve"]["warm_steady_state_s"] = 12.0
+    cand["stage_rollup"]["FFT"]["seconds"] = 5.0
+    assert bg.main([*write(cand), "--default-tol", "0.5"]) == 0
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_bench_gate_fails_on_regression(bench_records, capsys):
+    bg = _load_tool("bench_gate")
+    base, cand, write = bench_records
+    cand["value"] = cand["serve"]["warm_steady_state_s"] = 40.0
+    assert bg.main([*write(cand), "--default-tol", "0.5",
+                    "--json"]) == 1
+    obj = json.loads(capsys.readouterr().out)
+    assert not obj["ok"]
+    assert {e["key"] for e in obj["regressions"]} == {
+        "value", "serve.warm_steady_state_s"}
+
+
+def test_bench_gate_per_key_tolerance_and_direction(bench_records,
+                                                    capsys):
+    bg = _load_tool("bench_gate")
+    base, cand, write = bench_records
+    # a stage 2.2x slower: default tol 0.5 fails it, a per-key 2.0
+    # tolerance admits it
+    cand["stage_rollup"]["FFT"]["seconds"] = 8.8
+    b, c = write(cand)
+    assert bg.main([b, c, "--default-tol", "0.5"]) == 1
+    capsys.readouterr()
+    assert bg.main([b, c, "--default-tol", "0.5", "--key",
+                    "stage_rollup.FFT.seconds:lower:2.0"]) == 0
+    capsys.readouterr()
+    # higher-is-better direction: a DROP is the regression
+    cand["stage_rollup"]["FFT"]["seconds"] = 4.0
+    cand["serve"]["speedup"] = 1.2
+    base2 = json.loads(json.dumps(base))
+    base2["serve"]["speedup"] = 3.0
+    b2 = str(os.path.dirname(b)) + "/base2.json"
+    json.dump(base2, open(b2, "w"))
+    write(cand)
+    assert bg.main([b2, c, "--key", "serve.speedup:higher:0.5"]) == 1
+    capsys.readouterr()
+
+
+def test_bench_gate_tol_only_override_keeps_direction(tmp_path,
+                                                      capsys):
+    """`--key <higher-is-better-key>:0.2` (tolerance only) must keep
+    the key's higher-is-better direction — resetting it to 'lower'
+    would report a speedup collapse as an improvement."""
+    bg = _load_tool("bench_gate")
+    base = {"metric": "m", "value": 1.0, "unit": "beams/s",
+            "schema": "bench/v2",
+            "fleet": {"speedup_vs_one_worker_warm": 3.0}}
+    cand = json.loads(json.dumps(base))
+    cand["fleet"]["speedup_vs_one_worker_warm"] = 0.5   # collapse
+    b = str(tmp_path / "b.json")
+    c = str(tmp_path / "c.json")
+    json.dump(base, open(b, "w"))
+    json.dump(cand, open(c, "w"))
+    assert bg.main([b, c, "--key",
+                    "fleet.speedup_vs_one_worker_warm:2.0"]) == 1
+    obj_out = capsys.readouterr().out
+    assert "REGRESSION" in obj_out
+    assert "higher is better" in obj_out
+
+
+def test_bench_gate_rejects_non_v2_and_metric_mismatch(tmp_path,
+                                                       capsys):
+    bg = _load_tool("bench_gate")
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    json.dump({"metric": "m", "value": 1.0}, open(a, "w"))
+    json.dump({"metric": "m", "value": 1.0, "schema": "bench/v2"},
+              open(b, "w"))
+    assert bg.main([a, b]) == 2
+    json.dump({"metric": "other", "value": 1.0,
+               "schema": "bench/v2"}, open(a, "w"))
+    assert bg.main([a, b]) == 2
+    capsys.readouterr()

@@ -127,6 +127,26 @@ def test_rollup_totals_and_counts():
     assert roll["a"]["seconds"] >= 0.0
 
 
+def test_trace_id_context_stamps_events():
+    """The cross-process trace context: while a worker holds a
+    beam's trace id (set_trace_id), every event it records carries
+    it — and clearing the context stops the stamping (thread-local,
+    so the stage-in thread stamps its OWN beam)."""
+    trace.start()
+    trace.set_trace_id("beam-abc123")
+    with trace.span("stage"):
+        trace.instant("tick")
+    trace.complete("retro", 0.001)
+    trace.set_trace_id("")
+    with trace.span("after"):
+        pass
+    by_name = {e["name"]: e for e in trace.events()}
+    for name in ("stage", "tick", "retro"):
+        assert by_name[name]["args"]["trace_id"] == "beam-abc123"
+    assert "trace_id" not in by_name["after"]["args"]
+    assert trace.get_trace_id() == ""
+
+
 # ---------------------------------------------------------- metrics
 
 def test_histogram_bucket_edges():
@@ -189,7 +209,8 @@ def test_snapshot_json_round_trip(tmp_path):
     assert snap["c_total"]["series"]["v"] == 3
     assert snap["g"]["series"][""] == -1.5
     assert snap["h_seconds"]["series"]["FFT"] == {
-        "counts": [1, 0, 1], "sum": 7.5, "count": 2}
+        "counts": [1, 0, 1], "sum": 7.5, "count": 2,
+        "quantiles": {"p50": 1.0, "p95": 5.0, "p99": 5.0}}
     assert snap["h_seconds"]["buckets"] == [1.0, 5.0]
     # jsonl export appends parseable timestamped lines
     p = str(tmp_path / "m.jsonl")
@@ -218,8 +239,12 @@ def test_diff_snapshots_is_per_interval():
     delta = metrics.diff_snapshots(r.snapshot(), base)
     assert delta["c_total"]["series"] == {"new": 2}   # old dropped
     assert delta["g"]["series"][""] == 3.0            # current value
+    # quantiles describe the SUBTRACTED interval, re-derived from
+    # the delta counts (beam B's only observation was 2.0 s -> +Inf
+    # bucket, clamped to the highest finite bound)
     assert delta["h_seconds"]["series"][""] == {
-        "counts": [0, 1], "sum": 2.0, "count": 1}
+        "counts": [0, 1], "sum": 2.0, "count": 1,
+        "quantiles": {"p50": 1.0, "p95": 1.0, "p99": 1.0}}
     # nothing-happened interval -> empty delta (gauges excepted)
     assert "c_total" not in metrics.diff_snapshots(r.snapshot(),
                                                    r.snapshot())
@@ -238,9 +263,36 @@ def test_prometheus_text_format(tmp_path):
     assert 'lat_seconds_bucket{le="+Inf"} 2' in text    # cumulative
     assert 'lat_seconds_sum 2.5' in text
     assert 'lat_seconds_count 2' in text
+    # the quantile surface: advertised in HELP, estimated per series
+    # in a trailing COMMENT row (never a scrapeable series)
+    assert "bucket-interpolated" in text
+    assert "# lat_seconds p50=" in text
+    for line in text.splitlines():
+        if "p50=" in line:
+            assert line.startswith("#")
     p = str(tmp_path / "m.prom")
     r.write_prom(p)
     assert open(p).read() == text
+
+
+def test_histogram_bucket_quantiles():
+    """Bucket-interpolated p50/p95/p99 (the satellite every consumer
+    previously re-derived by hand): exact interior interpolation,
+    +Inf observations clamped to the highest finite bound."""
+    r = metrics.Registry()
+    h = r.histogram("q_seconds", "q", buckets=(1.0, 2.0, 4.0))
+    assert h.quantiles() == {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    for v in (0.5, 1.5, 1.6, 3.0):
+        h.observe(v)
+    q = h.quantiles()
+    # rank p50 = 2.0 of 4 -> second bucket (1,2], cum hits 3 there:
+    # lb 1.0 + (2-1) * (2-1)/2
+    assert q["p50"] == pytest.approx(1.5)
+    assert q["p95"] <= 4.0 and q["p95"] > q["p50"]
+    h.observe(100.0)               # +Inf bucket
+    assert h.quantiles()["p99"] == 4.0     # clamped, not invented
+    # the registry-level helper agrees with prometheus semantics
+    assert metrics.bucket_quantile((1.0,), [0, 1], 0.5) == 1.0
 
 
 # ------------------------------------------------- shared event shape

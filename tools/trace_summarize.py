@@ -127,6 +127,63 @@ def compile_rollup(trace: "str | list") -> dict[str, dict]:
     return roll
 
 
+def summarize_spool(spool: str, ticket: str | None = None) -> dict:
+    """Spool mode: the journal's per-ticket transition durations
+    ALONGSIDE each beam's trace-span rollup (found via the outdir the
+    ticket was submitted with) — one artifact answering both "what
+    happened to this beam across the fleet" and "where did its
+    device time go"."""
+    from tpulsar.obs import journal as journal_lib
+
+    data = journal_lib.summarize(spool)
+    if ticket is not None:
+        data["tickets"] = {tid: rec
+                           for tid, rec in data["tickets"].items()
+                           if tid == ticket}
+    for tid, rec in data["tickets"].items():
+        outdir = rec.get("outdir")
+        if not outdir or not os.path.isdir(outdir):
+            continue
+        try:
+            tf = trace.find_trace_file(outdir)
+        except FileNotFoundError:
+            continue
+        rec["trace_file"] = tf
+        rec["trace_rollup"] = trace.summarize_file(tf)["rollup"]
+    return data
+
+
+def render_spool_summary(data: dict) -> str:
+    lines = [f"spool journal: {data['spool']} "
+             f"({data['n_events']} events, statuses "
+             f"{data['statuses']}, takeovers {data['takeovers']}, "
+             f"quarantined {data['quarantined']})",
+             f"{'ticket':16s} {'status':10s} {'workers':12s} "
+             f"{'att':>3s} {'steal':>5s} {'q-wait':>8s} "
+             f"{'to-start':>8s} {'e2e':>8s}"]
+
+    def num(rec, key):
+        v = rec.get(key)
+        return f"{v:8.3f}" if v is not None else f"{'-':>8s}"
+
+    for tid in sorted(data["tickets"]):
+        rec = data["tickets"][tid]
+        lines.append(
+            f"{tid:16.16s} {rec['status'] or 'in-flight':10s} "
+            f"{','.join(rec['workers']):12.12s} "
+            f"{rec['attempts']:>3d} {rec['takeovers']:>5d} "
+            f"{num(rec, 'queue_wait_s')} "
+            f"{num(rec, 'claim_to_start_s')} {num(rec, 'e2e_s')}")
+        roll = rec.get("trace_rollup")
+        if roll:
+            top = sorted(roll, key=lambda n: -roll[n]["seconds"])[:3]
+            lines.append(
+                "    trace: " + "  ".join(
+                    f"{n}={roll[n]['seconds']:.2f}s" for n in top)
+                + f"  ({rec['trace_file']})")
+    return "\n".join(lines)
+
+
 def render_compile_rollup(roll: dict[str, dict]) -> str:
     lines = ["compile rollup (per program):",
              f"  {'program':40s} {'seconds':>9s} {'count':>6s}"]
@@ -139,14 +196,29 @@ def render_compile_rollup(roll: dict[str, dict]) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="trace JSON file or results dir")
+    ap.add_argument("path", help="trace JSON file, results dir, or a "
+                                 "serve SPOOL dir (detected by its "
+                                 "events/ journal): spool mode "
+                                 "renders the per-ticket transition "
+                                 "durations table alongside each "
+                                 "beam's trace rollup")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
     ap.add_argument("--compare-report", default=None, metavar="REPORT",
                     help="check the rollup against this .report's "
                          "stage totals (5%% tolerance); nonzero exit "
                          "on mismatch")
+    ap.add_argument("--ticket", default=None,
+                    help="spool mode: restrict to one ticket")
     args = ap.parse_args(argv)
+    if os.path.isdir(args.path) and \
+            os.path.isdir(os.path.join(args.path, "events")):
+        data = summarize_spool(args.path, ticket=args.ticket)
+        if args.json:
+            print(json.dumps(data, indent=1, sort_keys=True))
+        else:
+            print(render_spool_summary(data))
+        return 0
     trace_file = find_trace_file(args.path)
     with open(trace_file) as fh:
         trace_events = json.load(fh).get("traceEvents", [])

@@ -299,7 +299,9 @@ def cmd_fleet(args):
     spool = args.spool or _serve_spool(cfg)
     if args.status:
         print(fleet_ctl.render_status(spool))
-        return 0
+        # scriptable health: nonzero when a running controller's
+        # fleet.json went stale past the heartbeat grace
+        return fleet_ctl.status_rc(spool)
     if args.drain:
         path = fleet_ctl.write_control(spool, "drain")
         print(f"fleet: drain requested ({path})")
@@ -645,6 +647,91 @@ def cmd_trace(args):
     print(trace_lib.render_summary(trace_lib.summarize_file(
         trace_file)))
     return 0
+
+
+def cmd_obs(args):
+    """The fleet ops console (tpulsar/obs/journal.py + fleetview.py):
+
+      timeline <ticket> — one beam's full lifecycle from the spool's
+                          ticket journal, across every worker that
+                          touched it (claims, steals, quarantine),
+                          with durations between transitions
+      top               — live per-worker state, queue depths, and
+                          journal-derived SLO quantiles (refresh
+                          loop; --once for scripts/CI)
+      tail              — follow the ticket journal as events land
+
+    All three read spool state only — no connection to any worker or
+    controller process is needed."""
+    from tpulsar.config import settings
+    from tpulsar.obs import fleetview, journal
+
+    spool = args.spool or _serve_spool(settings())
+    if args.obs_cmd == "timeline":
+        text = journal.render_timeline(spool, args.ticket)
+        print(text)
+        if args.stitch:
+            import json as _json
+            try:
+                obj = fleetview.stitch(spool, args.ticket)
+            except FileNotFoundError as e:
+                print(str(e), file=sys.stderr)
+                return 1
+            with open(args.stitch, "w") as fh:
+                _json.dump(obj, fh)
+            print(f"stitched Perfetto timeline -> {args.stitch} "
+                  f"({len(obj['traceEvents'])} events)")
+        return 0 if not text.startswith("no journal events") else 1
+    if args.obs_cmd == "top":
+        try:
+            while True:
+                text = fleetview.render_top(spool)
+                if not args.once:
+                    os.system("clear" if os.name != "nt" else "cls")
+                print(text, flush=True)
+                if args.once:
+                    return 0
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+    if args.obs_cmd == "tail":
+        from tpulsar.obs.journal import journal_path
+        path = journal_path(spool)
+        try:
+            with open(path) as fh:
+                lines = fh.readlines()
+                offset = fh.tell()
+        except OSError:
+            lines, offset = [], 0
+        for ln in lines[-args.lines:]:
+            print(ln.rstrip())
+        if not args.follow:
+            return 0 if lines else 1
+        # follow by byte offset — re-reading a journal nearing its
+        # 64 MB rotation cap every half second would be O(file) per
+        # tick; a seek is O(new data).  A shrink (rotation) resets
+        # the offset to the start of the fresh generation.
+        buf = ""
+        try:
+            while True:
+                time.sleep(args.interval)
+                try:
+                    size = os.path.getsize(path)
+                    if size < offset:
+                        offset, buf = 0, ""
+                    with open(path) as fh:
+                        fh.seek(offset)
+                        buf += fh.read()
+                        offset = fh.tell()
+                except OSError:
+                    continue
+                *done, buf = buf.split("\n")
+                for ln in done:
+                    if ln:
+                        print(ln, flush=True)
+        except KeyboardInterrupt:
+            return 0
+    return 2
 
 
 def cmd_search(args):
@@ -1042,6 +1129,36 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--outdir", required=True)
     sp.add_argument("--no-accel", action="store_true")
     sp.set_defaults(fn=cmd_search)
+
+    sp = sub.add_parser(
+        "obs",
+        help="fleet observability console: per-ticket lifecycle "
+             "timeline from the spool journal, live fleet top, and "
+             "journal tail — all from spool state alone")
+    osub = sp.add_subparsers(dest="obs_cmd", required=True)
+    op = osub.add_parser(
+        "timeline", help="one beam's lifecycle across the fleet "
+                         "(journal events + durations)")
+    op.add_argument("ticket")
+    op.add_argument("--spool", default=None)
+    op.add_argument("--stitch", default=None, metavar="OUT.json",
+                    help="also write the stitched Perfetto timeline "
+                         "(journal events + this beam's trace spans "
+                         "from every worker, one time axis)")
+    op.set_defaults(fn=cmd_obs)
+    op = osub.add_parser(
+        "top", help="live per-worker state, queue depths, and "
+                    "journal SLO quantiles")
+    op.add_argument("--spool", default=None)
+    op.add_argument("--interval", type=float, default=2.0)
+    op.add_argument("--once", action="store_true")
+    op.set_defaults(fn=cmd_obs)
+    op = osub.add_parser("tail", help="follow the ticket journal")
+    op.add_argument("--spool", default=None)
+    op.add_argument("-n", "--lines", type=int, default=20)
+    op.add_argument("-f", "--follow", action="store_true")
+    op.add_argument("--interval", type=float, default=0.5)
+    op.set_defaults(fn=cmd_obs)
 
     sp = sub.add_parser(
         "trace",

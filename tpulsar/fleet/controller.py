@@ -46,7 +46,7 @@ import sys
 import threading
 import time
 
-from tpulsar.obs import metrics, telemetry
+from tpulsar.obs import fleetview, journal, metrics, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.resilience import policy
 from tpulsar.serve import protocol
@@ -139,6 +139,11 @@ class FleetController:
         self._cycling: _Worker | None = None
         self._drain = threading.Event()
         self._quarantined_seen: set[str] = set()
+        #: merged-fleet.prom cadence: aggregation re-reads the ticket
+        #: journal, so it must not run at poll_s frequency (the PR 5
+        #: lesson about per-poll-second O(spool) work, applied here)
+        self.prom_interval_s = 10.0
+        self._prom_last = 0.0
         self.started_at = time.time()
 
     # ------------------------------------------------------------ control
@@ -189,6 +194,9 @@ class FleetController:
         w.pid = w.proc.pid
         w.incarnation += 1
         w.next_restart_at = None
+        journal.record(self.spool, "worker_spawn",
+                       worker=w.worker_id, kind=kind, pid=w.pid,
+                       incarnation=w.incarnation)
         self.log.info("%s worker %s (pid %d, incarnation %d)",
                       kind, w.worker_id, w.pid, w.incarnation)
 
@@ -215,6 +223,9 @@ class FleetController:
             w.proc = None
             w.last_rc = rc
             self._mark_worker_down(w)
+            journal.record(self.spool, "worker_exit",
+                           worker=w.worker_id, rc=rc, pid=w.pid,
+                           incarnation=w.incarnation)
             if self.draining:
                 continue
             if self.once and rc == 0:
@@ -320,8 +331,20 @@ class FleetController:
         try:
             protocol._atomic_write_json(
                 os.path.join(self.spool, FLEET_JSON), rec)
-            metrics.REGISTRY.write_prom(
-                os.path.join(self.spool, FLEET_PROM))
+            # the MERGED fleet export: every worker's snapshot + the
+            # journal SLO series + this controller's own registry —
+            # not just the controller's view (obs/fleetview.py).
+            # Throttled to prom_interval_s: it re-reads the journal,
+            # which must not happen every poll second.  A stopping
+            # fleet always writes its final state.
+            now = time.time()
+            if status == "stopped" or \
+                    now - self._prom_last >= self.prom_interval_s:
+                self._prom_last = now
+                fleetview.write_fleet_prom(
+                    self.spool,
+                    extra_snapshots=(metrics.REGISTRY.snapshot(),),
+                    path=os.path.join(self.spool, FLEET_PROM))
         except OSError:
             pass         # a full disk must not take the fleet down
         return rec
@@ -485,6 +508,20 @@ class FleetController:
 
 # ---------------------------------------------------------------- status
 
+def status_rc(spool: str,
+              max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S) -> int:
+    """Health exit code for ``tpulsar fleet --status`` (cron/CI
+    scripting): 1 when a RUNNING controller's fleet.json has gone
+    stale past the heartbeat grace — the controller died without
+    stamping the fleet stopped.  0 otherwise: a fresh file, a
+    deliberately stopped fleet, or no fleet.json at all (nothing to
+    judge — workers may be launched externally)."""
+    rec = protocol._read_json(os.path.join(spool, FLEET_JSON))
+    if rec is None or rec.get("status") == "stopped":
+        return 0
+    return 1 if time.time() - rec.get("t", 0.0) > max_age_s else 0
+
+
 def render_status(spool: str,
                   max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S
                   ) -> str:
@@ -494,9 +531,13 @@ def render_status(spool: str,
     rec = protocol._read_json(os.path.join(spool, FLEET_JSON))
     if rec is not None:
         age = time.time() - rec.get("t", 0.0)
+        stale = (" — STALE past the heartbeat grace "
+                 f"({max_age_s:.0f} s): controller presumed dead"
+                 if status_rc(spool, max_age_s) else "")
         lines.append(
             f"controller: pid {rec.get('controller_pid')} "
-            f"{rec.get('status', '?')} (fleet.json {age:.0f} s old)")
+            f"{rec.get('status', '?')} (fleet.json {age:.0f} s old"
+            f"{stale})")
     else:
         lines.append("controller: no fleet.json (not running, or "
                      "workers launched externally)")

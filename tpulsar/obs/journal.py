@@ -1,0 +1,315 @@
+"""The ticket lifecycle journal: an append-only event log in the spool.
+
+PRs 4-5 made tpulsar a multi-process system — N serve workers, a
+controller, janitors, work-stealing takeovers, quarantine — and no
+single artifact could answer "what happened to beam X, end to end,
+across the workers that touched it".  This module is that artifact:
+every actor that moves a ticket through the spool state machine
+appends ONE stamped event per transition to
+``<spool>/events/journal.jsonl``:
+
+    submitted        client wrote the ticket (trace id minted here)
+    claimed          a worker won the claim rename (worker, pid,
+                     attempt, queue_wait_s)
+    stagein_done /   the prefetch thread staged the beam's inputs
+    stagein_failed   (seconds / first error line)
+    search_start     device work began (worker, attempt)
+    result           TERMINAL: the durable done/ record landed
+                     (status done|failed|skipped, rc, worker, attempt)
+    takeover         a janitor stole the claim from a DEAD owner
+                     (from_worker/from_pid; attempt = after the
+                     strike) — the crash evidence, written by the
+                     survivor because the crashed worker cannot
+    drain_requeue    attempt-neutral requeue (reason: drain |
+                     boot_recovery | abandoned_claiming |
+                     abandoned_takeover)
+    quarantined      the beam hit the attempts cap (followed by its
+                     terminal failed ``result``)
+    worker_spawn /   controller lifecycle (no ticket key): spawns,
+    worker_exit      restarts, crash exits
+
+Records use the ``telemetry.event_record`` shape (``{"t": <unix>,
+"event": ...}`` plus free-form keys), keyed by ``ticket`` + ``worker``
++ ``attempt`` and carrying the ticket's ``trace_id`` so journal events
+and trace spans from different processes stitch into one timeline.
+
+Crash safety: each event is one ``os.write`` to an ``O_APPEND`` fd —
+atomic line appends even with N processes writing concurrently, no
+locks, and a reader can at worst observe (and skip) the final torn
+line of a writer that died mid-append.  The journal is OBSERVATIONAL:
+events are appended AFTER the spool rename/write they describe
+succeeds, and a journal write failure (full disk, read-only spool)
+never fails the transition it records.
+
+stdlib only — imported by serve/protocol.py, which runs in processes
+that never import jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpulsar.obs import telemetry
+
+EVENTS_DIR = "events"
+JOURNAL_FILE = "journal.jsonl"
+
+#: one rotation generation (journal.jsonl.1) is kept, like the
+#: daemons' metrics JSONL: a fleet appending for months must not fill
+#: the spool volume, and readers merge both generations
+MAX_BYTES = 64 << 20
+
+#: the one terminal event name: a ticket is finished exactly when its
+#: durable done/ record lands, so exactly-once across the fleet reads
+#: as "exactly one ``result`` event per ticket" in the journal
+TERMINAL_EVENT = "result"
+
+
+def journal_path(spool: str) -> str:
+    return os.path.join(spool, EVENTS_DIR, JOURNAL_FILE)
+
+
+def record(spool: str, event: str, ticket: str = "",
+           worker: str = "", attempt: int | None = None,
+           trace_id: str = "", **extra) -> dict | None:
+    """Append one lifecycle event; returns the record, or None when
+    the append failed (journal writes never break the transition
+    they describe)."""
+    fields: dict = dict(extra)
+    if ticket:
+        fields["ticket"] = ticket
+    if worker:
+        fields["worker"] = worker
+    if attempt is not None:
+        fields["attempt"] = int(attempt)
+    if trace_id:
+        fields["trace_id"] = trace_id
+    rec = telemetry.event_record(event, **fields)
+    path = journal_path(spool)
+    line = (json.dumps(rec, separators=(",", ":"), sort_keys=True)
+            + "\n").encode()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            if os.path.getsize(path) >= MAX_BYTES:
+                # race-safe rotation: the exclusive rename picks ONE
+                # rotator among N concurrent writers — a plain
+                # replace(path, path+'.1') would let the loser clobber
+                # the generation the winner just rotated, destroying
+                # 64 MB of history.  A rotator that dies between the
+                # renames strands '.rotating.<pid>', which
+                # read_events still merges.
+                rot = f"{path}.rotating.{os.getpid()}"
+                try:
+                    os.rename(path, rot)
+                    os.replace(rot, path + ".1")
+                except OSError:
+                    pass          # another writer is rotating
+        except OSError:
+            pass
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                     0o644)
+        try:
+            os.write(fd, line)
+        finally:
+            os.close(fd)
+    except OSError:
+        return None
+    return rec
+
+
+def read_events(spool: str, ticket: str | None = None) -> list[dict]:
+    """Every journal event (rotated generation first), oldest first;
+    torn trailing lines are skipped.  ``ticket`` filters to one
+    beam's lifecycle."""
+    import glob as _glob
+    out: list[dict] = []
+    path = journal_path(spool)
+    paths = [path + ".1",
+             *sorted(_glob.glob(path + ".rotating.*")),  # dead rotator
+             path]
+    for p in paths:
+        try:
+            with open(p) as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue          # a writer died mid-append
+            if ticket is not None and rec.get("ticket") != ticket:
+                continue
+            out.append(rec)
+    out.sort(key=lambda r: r.get("t", 0.0))
+    return out
+
+
+def iter_tickets(events: list[dict]) -> dict[str, list[dict]]:
+    """Events grouped per ticket (worker-lifecycle events, which have
+    no ticket key, are dropped)."""
+    per: dict[str, list[dict]] = {}
+    for ev in events:
+        tid = ev.get("ticket")
+        if tid:
+            per.setdefault(tid, []).append(ev)
+    return per
+
+
+def validate_chain(events: list[dict]) -> list[str]:
+    """Well-formedness problems in ONE ticket's event chain — the
+    property every done/quarantined beam must satisfy:
+
+      * it starts with ``submitted``;
+      * exactly one terminal ``result`` event, and nothing after it;
+      * ``attempt`` never decreases, and every ``takeover`` strike
+        raises it by exactly 1 over the claim it stole;
+      * the terminal attempt matches the last claim's.
+
+    Returns [] for a well-formed chain."""
+    problems: list[str] = []
+    if not events:
+        return ["no events"]
+    if events[0].get("event") != "submitted":
+        problems.append(
+            f"first event is {events[0].get('event')!r}, "
+            f"not 'submitted'")
+    terminals = [i for i, ev in enumerate(events)
+                 if ev.get("event") == TERMINAL_EVENT]
+    if len(terminals) != 1:
+        problems.append(f"{len(terminals)} terminal '{TERMINAL_EVENT}'"
+                        f" events (want exactly 1)")
+    elif terminals[0] != len(events) - 1:
+        tail = [e.get("event") for e in events[terminals[0] + 1:]]
+        problems.append(f"events after the terminal: {tail}")
+    last_attempt = 0
+    last_claim_attempt = None
+    quarantine_attempt = None
+    for ev in events:
+        att = ev.get("attempt")
+        if att is None:
+            continue
+        if att < last_attempt:
+            problems.append(
+                f"attempt went backwards at {ev.get('event')!r} "
+                f"({last_attempt} -> {att})")
+        if ev.get("event") == "takeover" and \
+                last_claim_attempt is not None and \
+                att != last_claim_attempt + 1:
+            problems.append(
+                f"takeover attempt {att} != stolen claim's "
+                f"{last_claim_attempt} + 1")
+        if ev.get("event") == "claimed":
+            last_claim_attempt = att
+        if ev.get("event") == "quarantined":
+            quarantine_attempt = att
+        if ev.get("event") == TERMINAL_EVENT:
+            # a quarantined beam terminates at the attempt of its
+            # FINAL strike (no claim follows it); a finished beam
+            # terminates at its last claim's attempt
+            expect = (quarantine_attempt
+                      if quarantine_attempt is not None
+                      else last_claim_attempt)
+            if expect is not None and att != expect:
+                problems.append(
+                    f"terminal attempt {att} != expected {expect}")
+        last_attempt = max(last_attempt, att)
+    return problems
+
+
+def chain_summary(events: list[dict]) -> dict:
+    """One ticket's lifecycle digest: status, the workers that
+    touched it, attempts, and the SLO durations the fleet aggregator
+    exports (queue_wait_s: submitted -> first claim; claim_to_start_s:
+    last claim -> search start; e2e_s: submitted -> terminal)."""
+    first = {ev.get("event"): ev for ev in reversed(events)}
+    last = {ev.get("event"): ev for ev in events}
+    terminal = last.get(TERMINAL_EVENT)
+    out: dict = {
+        "events": [ev.get("event") for ev in events],
+        "workers": sorted({ev["worker"] for ev in events
+                           if ev.get("worker")}),
+        "attempts": max((ev.get("attempt", 0) for ev in events),
+                        default=0),
+        "takeovers": sum(1 for ev in events
+                         if ev.get("event") == "takeover"),
+        "status": terminal.get("status") if terminal else None,
+        "trace_id": next((ev["trace_id"] for ev in events
+                          if ev.get("trace_id")), ""),
+        "outdir": next((ev["outdir"] for ev in events
+                        if ev.get("outdir")), ""),
+    }
+    sub, claim, start = (first.get("submitted"), first.get("claimed"),
+                         last.get("search_start"))
+    if sub and claim:
+        out["queue_wait_s"] = round(claim["t"] - sub["t"], 3)
+    if start and last.get("claimed"):
+        out["claim_to_start_s"] = round(
+            start["t"] - last["claimed"]["t"], 3)
+    if sub and terminal:
+        out["e2e_s"] = round(terminal["t"] - sub["t"], 3)
+    return out
+
+
+def summarize(spool: str) -> dict:
+    """Spool-wide journal digest: per-ticket chains + fleet counts —
+    the input both the fleet metrics aggregator (obs/fleetview.py)
+    and ``tools/trace_summarize.py --spool`` read."""
+    events = read_events(spool)
+    per = iter_tickets(events)
+    tickets = {tid: chain_summary(evs) for tid, evs in per.items()}
+    statuses: dict[str, int] = {}
+    for rec in tickets.values():
+        key = rec["status"] or "in-flight"
+        statuses[key] = statuses.get(key, 0) + 1
+    return {
+        "spool": spool,
+        "n_events": len(events),
+        "tickets": tickets,
+        "statuses": statuses,
+        "takeovers": sum(r["takeovers"] for r in tickets.values()),
+        "quarantined": sum(
+            1 for evs in per.values()
+            if any(e.get("event") == "quarantined" for e in evs)),
+    }
+
+
+def render_timeline(spool: str, ticket: str) -> str:
+    """The ops-console timeline: one beam's full lifecycle across
+    every worker that touched it, with the duration between
+    transitions — `tpulsar obs timeline <ticket>`."""
+    events = read_events(spool, ticket=ticket)
+    if not events:
+        return f"no journal events for ticket {ticket!r} in {spool}"
+    digest = chain_summary(events)
+    lines = [f"ticket {ticket}  trace_id={digest['trace_id'] or '-'}",
+             f"workers: {', '.join(digest['workers']) or '-'}  "
+             f"attempts: {digest['attempts']}  "
+             f"status: {digest['status'] or 'in-flight'}",
+             f"{'t+':>10s}  {'+dt':>9s}  {'event':16s} "
+             f"{'worker':8s} {'att':>3s}  detail"]
+    t0 = events[0]["t"]
+    prev = t0
+    for ev in events:
+        detail = []
+        for key in ("status", "rc", "reason", "queue_wait_s",
+                    "seconds", "from_worker", "from_pid", "kind",
+                    "pid", "error"):
+            if key in ev:
+                val = str(ev[key])
+                detail.append(f"{key}={val[:40]}")
+        lines.append(
+            f"{ev['t'] - t0:10.3f}  {ev['t'] - prev:9.3f}  "
+            f"{ev.get('event', '?'):16s} "
+            f"{ev.get('worker', '') or '-':8s} "
+            f"{ev.get('attempt', ''):>3}  {' '.join(detail)}")
+        prev = ev["t"]
+    problems = validate_chain(events)
+    if problems:
+        lines.append("chain problems: " + "; ".join(problems))
+    return "\n".join(lines)

@@ -45,6 +45,39 @@ class MetricError(ValueError):
     instrument would silently split its data."""
 
 
+#: the percentiles every histogram series reports (as ``quantiles``
+#: in series()/snapshot()): the SLO set the fleet aggregator and the
+#: ops console read, so each consumer stops re-deriving them by hand
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def bucket_quantile(buckets: tuple, counts: list, q: float) -> float:
+    """Bucket-interpolated quantile estimate (Prometheus
+    ``histogram_quantile`` semantics): linear interpolation inside
+    the bucket holding the rank; a rank landing in the +Inf bucket
+    clamps to the highest finite bound (the estimate cannot exceed
+    what the instrument can resolve)."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, ub in enumerate(buckets):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank:
+            lb = buckets[i - 1] if i else 0.0
+            frac = (rank - prev) / counts[i] if counts[i] else 1.0
+            return lb + (ub - lb) * frac
+    return float(buckets[-1])
+
+
+def _hist_quantiles(buckets: tuple, counts: list) -> dict:
+    return {f"p{int(q * 100)}": round(bucket_quantile(buckets,
+                                                      counts, q), 6)
+            for q in QUANTILES}
+
+
 def _labelkey(labelnames: tuple[str, ...], labels: dict) -> tuple:
     if set(labels) != set(labelnames):
         raise MetricError(
@@ -145,9 +178,15 @@ class Histogram(_Instrument):
         key = _labelkey(self.labelnames, labels)
         with self._lock:
             s = self._series.get(key)
-            return (dict(s, counts=list(s["counts"])) if s else
-                    {"counts": [0] * (len(self.buckets) + 1),
-                     "sum": 0.0, "count": 0})
+            s = (dict(s, counts=list(s["counts"])) if s else
+                 {"counts": [0] * (len(self.buckets) + 1),
+                  "sum": 0.0, "count": 0})
+        s["quantiles"] = _hist_quantiles(self.buckets, s["counts"])
+        return s
+
+    def quantiles(self, **labels) -> dict:
+        """Bucket-interpolated {p50, p95, p99} for one series."""
+        return self.series(**labels)["quantiles"]
 
 
 class Registry:
@@ -217,6 +256,9 @@ class Registry:
                          "series": series}
             if isinstance(inst, Histogram):
                 rec["buckets"] = list(inst.buckets)
+                for v in series.values():
+                    v["quantiles"] = _hist_quantiles(inst.buckets,
+                                                     v["counts"])
             out[inst.name] = rec
         return out
 
@@ -241,41 +283,7 @@ class Registry:
 
     def prometheus_text(self) -> str:
         """Prometheus text exposition format (0.0.4)."""
-        lines: list[str] = []
-        snap = self.snapshot()
-        for name in sorted(snap):
-            rec = snap[name]
-            if rec["help"]:
-                lines.append(f"# HELP {name} {rec['help']}")
-            lines.append(f"# TYPE {name} {rec['type']}")
-            labelnames = rec["labelnames"]
-
-            def fmt(extra_label: str = "", key: str = "",
-                    suffix: str = "") -> str:
-                pairs = ([f'{n}="{v}"' for n, v in
-                          zip(labelnames, key.split("|"))]
-                         if key else [])
-                if extra_label:
-                    pairs.append(extra_label)
-                body = "{" + ",".join(pairs) + "}" if pairs else ""
-                return f"{name}{suffix}{body}"
-
-            for key, val in sorted(rec["series"].items()):
-                if rec["type"] == "histogram":
-                    edges = [*rec["buckets"], "+Inf"]
-                    cum = 0
-                    for ub, n in zip(edges, val["counts"]):
-                        cum += n
-                        le = 'le="%s"' % ub
-                        lines.append(
-                            f"{fmt(le, key, '_bucket')} {cum}")
-                    lines.append(f"{fmt('', key, '_sum')} "
-                                 f"{val['sum']:.9g}")
-                    lines.append(f"{fmt('', key, '_count')} "
-                                 f"{val['count']}")
-                else:
-                    lines.append(f"{fmt('', key)} {val:.9g}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        return prometheus_text_from_snapshot(self.snapshot())
 
     def write_prom(self, path: str) -> None:
         """Atomic-replace write of the Prometheus text dump (the
@@ -286,6 +294,61 @@ class Registry:
         with open(tmp, "w") as fh:
             fh.write(self.prometheus_text())
         os.replace(tmp, path)
+
+
+def prometheus_text_from_snapshot(snap: dict) -> str:
+    """Render ANY ``Registry.snapshot()``-shaped dict as Prometheus
+    text — a module function (not a Registry method) so the fleet
+    aggregator can render a MERGED multi-process snapshot it built
+    itself.  Histogram help lines advertise the bucket-interpolated
+    p50/p95/p99, and each histogram series emits them as a trailing
+    comment row (a plain ``#`` comment: ignored by scrapers, read by
+    operators and the ops console — raw buckets stay the only real
+    series)."""
+    lines: list[str] = []
+    for name in sorted(snap):
+        rec = snap[name]
+        if rec["help"]:
+            help_txt = rec["help"]
+            if rec["type"] == "histogram":
+                help_txt += (" [p50/p95/p99 bucket-interpolated in "
+                             "the trailing comment rows]")
+            lines.append(f"# HELP {name} {help_txt}")
+        lines.append(f"# TYPE {name} {rec['type']}")
+        labelnames = rec["labelnames"]
+
+        def fmt(extra_label: str = "", key: str = "",
+                suffix: str = "") -> str:
+            pairs = ([f'{n}="{v}"' for n, v in
+                      zip(labelnames, key.split("|"))]
+                     if key else [])
+            if extra_label:
+                pairs.append(extra_label)
+            body = "{" + ",".join(pairs) + "}" if pairs else ""
+            return f"{name}{suffix}{body}"
+
+        for key, val in sorted(rec["series"].items()):
+            if rec["type"] == "histogram":
+                edges = [*rec["buckets"], "+Inf"]
+                cum = 0
+                for ub, n in zip(edges, val["counts"]):
+                    cum += n
+                    le = 'le="%s"' % ub
+                    lines.append(
+                        f"{fmt(le, key, '_bucket')} {cum}")
+                lines.append(f"{fmt('', key, '_sum')} "
+                             f"{val['sum']:.9g}")
+                lines.append(f"{fmt('', key, '_count')} "
+                             f"{val['count']}")
+                quant = val.get("quantiles") or _hist_quantiles(
+                    tuple(rec["buckets"]), val["counts"])
+                lines.append(
+                    f"# {fmt('', key)} " + " ".join(
+                        f"{k}={v:.9g}"
+                        for k, v in sorted(quant.items())))
+            else:
+                lines.append(f"{fmt('', key)} {val:.9g}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def diff_snapshots(now: dict, base: dict) -> dict:
@@ -314,6 +377,10 @@ def diff_snapshots(now: dict, base: dict) -> dict:
                            "sum": val["sum"] - bval["sum"],
                            "count": val["count"] - bval["count"]}
                 if val["count"]:
+                    # quantiles describe the subtracted interval, not
+                    # the cumulative series they were computed from
+                    val = dict(val, quantiles=_hist_quantiles(
+                        tuple(rec["buckets"]), val["counts"]))
                     series[key] = val
             else:
                 delta = val - (bval or 0.0)

@@ -77,13 +77,15 @@ def stop() -> None:
 
 
 def reset() -> None:
-    """Back to env-controlled, events dropped (tests)."""
+    """Back to env-controlled, events dropped (tests).  Clears the
+    calling thread's trace-id context too."""
     global _ENABLED, _T0, _DROPPED
     with _LOCK:
         _ENABLED = None
         _EVENTS.clear()
         _DROPPED = 0
         _T0 = time.time()
+    _TLS.trace_id = ""
 
 
 def _stack() -> list[str]:
@@ -91,6 +93,32 @@ def _stack() -> list[str]:
     if st is None:
         st = _TLS.stack = []
     return st
+
+
+def set_trace_id(trace_id: str) -> None:
+    """Adopt a cross-process trace context on THIS thread: every
+    span/instant/complete event recorded while it is set carries
+    ``trace_id`` in its args.  The id is minted once at ticket
+    submission (serve/protocol.write_ticket) and travels in the
+    ticket JSON, so the spans a beam leaves behind in DIFFERENT
+    worker processes — a claim, a crash, a steal, a finish — all
+    carry the same id and can be stitched into one Perfetto timeline
+    (tools/trace_summarize.py --stitch).  Thread-local on purpose:
+    the serve worker's main thread processes beam N while its
+    stage-in thread prepares beam N+1, and each must stamp its own
+    beam's id.  Pass '' to clear."""
+    _TLS.trace_id = trace_id
+
+
+def get_trace_id() -> str:
+    return getattr(_TLS, "trace_id", "") or ""
+
+
+def _ctx_args(args: dict) -> dict:
+    tid = get_trace_id()
+    if tid:
+        args.setdefault("trace_id", tid)
+    return args
 
 
 def current_span() -> str:
@@ -144,7 +172,7 @@ def span(name: str, **attrs):
             "ts": round((t_begin - _T0) * 1e6, 1),
             "dur": round((t_end - t_begin) * 1e6, 1),
             "pid": os.getpid(), "tid": threading.get_ident(),
-            "args": args,
+            "args": _ctx_args(args),
         })
 
 
@@ -169,7 +197,7 @@ def complete(name: str, dur_s: float, **attrs) -> None:
         "ts": round((t_end - dur_s - _T0) * 1e6, 1),
         "dur": round(dur_s * 1e6, 1),
         "pid": os.getpid(), "tid": threading.get_ident(),
-        "args": args,
+        "args": _ctx_args(args),
     })
 
 
@@ -186,7 +214,7 @@ def instant(name: str, **attrs) -> None:
         "name": name, "cat": "tpulsar", "ph": "i",
         "ts": round((time.time() - _T0) * 1e6, 1),
         "pid": os.getpid(), "tid": threading.get_ident(),
-        "s": "t", "args": args,
+        "s": "t", "args": _ctx_args(args),
     })
 
 

@@ -59,6 +59,9 @@ from __future__ import annotations
 import json
 import os
 import time
+import uuid
+
+from tpulsar.obs import journal
 
 #: heartbeats older than this are stale: the worker is gone (crashed,
 #: drained, or never started); with zero fresh workers clients must
@@ -122,11 +125,28 @@ def write_ticket(spool: str, ticket_id: str, datafiles: list[str],
                  **extra) -> str:
     """Enqueue a beam: one JSON file in incoming/.  Returns the
     ticket id.  Callers enforce admission depth via fleet_capacity()
-    BEFORE writing (the queue-backend contract's can_submit)."""
+    BEFORE writing (the queue-backend contract's can_submit).
+
+    Submission mints the beam's ``trace_id`` (unless the caller
+    supplied one): it rides in the ticket JSON through every claim,
+    steal, and requeue, is adopted by obs/trace.py spans in whichever
+    worker holds the beam, and keys the journal events — the one
+    correlation id a beam keeps across the whole fleet."""
     ensure_spool(spool)
     rec = {"ticket": ticket_id, "datafiles": list(datafiles),
            "outdir": outdir, "job_id": job_id,
            "submitted_at": time.time(), "attempts": 0, **extra}
+    rec.setdefault("trace_id", uuid.uuid4().hex[:16])
+    # the ONE journal event recorded before its transition: the
+    # instant the incoming/ write lands the ticket is claimable, and
+    # a fast worker's 'claimed' event must never carry an earlier
+    # timestamp than 'submitted' (validate_chain would flag a
+    # healthy beam).  A crash between the two leaves a spurious
+    # in-flight journal entry for a ticket that never existed —
+    # honest, and harmless to every consumer.
+    journal.record(spool, "submitted", ticket=ticket_id,
+                   attempt=0, trace_id=rec["trace_id"],
+                   outdir=outdir)
     _atomic_write_json(ticket_path(spool, ticket_id, "incoming"), rec)
     return ticket_id
 
@@ -217,6 +237,17 @@ def claim_next_ticket(spool: str, worker_id: str = "") -> dict | None:
     staging was stolen — a lost claim is abandoned, never
     fabricated."""
     grace = ORPHAN_SIDEFILE_GRACE_S
+
+    def _journal_claim(rec: dict) -> None:
+        journal.record(
+            spool, "claimed", ticket=rec.get("ticket", "?"),
+            worker=worker_id, pid=os.getpid(),
+            attempt=int(rec.get("attempts", 0)),
+            trace_id=rec.get("trace_id", ""),
+            queue_wait_s=round(
+                rec["claimed_at"] - rec.get("submitted_at",
+                                            rec["claimed_at"]), 3))
+
     for tid in list_tickets(spool, "incoming"):
         src = ticket_path(spool, tid, "incoming")
         dst = ticket_path(spool, tid, "claimed")
@@ -281,11 +312,13 @@ def claim_next_ticket(spool: str, worker_id: str = "") -> dict | None:
                 os.rename(staging, dst)
             except OSError:
                 continue
+            _journal_claim(rec)
             return rec
         try:
             os.unlink(staging)
         except OSError:
             pass
+        _journal_claim(rec)
         return rec
     return None
 
@@ -444,6 +477,10 @@ def _recover_abandoned_takeovers(spool: str) -> None:
                 continue         # another janitor beat us to it
             _atomic_write_json(ticket_path(spool, tid, "incoming"),
                                _strip_claim_stamps(rec))
+            journal.record(spool, "drain_requeue", ticket=tid,
+                           attempt=int(rec.get("attempts", 0)),
+                           trace_id=rec.get("trace_id", ""),
+                           reason="abandoned_takeover")
             try:
                 os.unlink(tmp)
             except OSError:
@@ -507,6 +544,10 @@ def _recover_abandoned_claimings(spool: str) -> None:
             continue
         _strip_claim_stamps(rec)
         _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
+        journal.record(spool, "drain_requeue", ticket=tid,
+                       attempt=int(rec.get("attempts", 0)),
+                       trace_id=rec.get("trace_id", ""),
+                       reason="abandoned_claiming")
         try:
             os.unlink(tmp)
         except OSError:
@@ -521,18 +562,23 @@ def _quarantine(spool: str, rec: dict, max_attempts: int) -> None:
     tid = rec.get("ticket", "?")
     rec["quarantined_at"] = time.time()
     _atomic_write_json(ticket_path(spool, tid, "quarantine"), rec)
+    journal.record(spool, "quarantined", ticket=tid,
+                   attempt=int(rec.get("attempts", 0)),
+                   trace_id=rec.get("trace_id", ""),
+                   max_attempts=max_attempts)
     write_result(
         spool, tid, "failed", rc=1,
         error=(f"quarantined after {rec.get('attempts', 0)} "
                f"crash-shaped claim(s) (max_attempts {max_attempts}): "
                f"this beam repeatedly killed its worker"),
         reason="max_attempts", attempts=rec.get("attempts", 0),
-        outdir=rec.get("outdir", ""))
+        outdir=rec.get("outdir", ""),
+        trace_id=rec.get("trace_id", ""))
 
 
 def _requeue_claims(spool: str, verdict_fn,
-                    max_attempts: int = DEFAULT_MAX_ATTEMPTS
-                    ) -> list[str]:
+                    max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                    neutral_reason: str = "drain") -> list[str]:
     """The one crash-safe requeue skeleton both public requeues run:
     reconcile claims that already have a done record, judge the rest
     via ``verdict_fn(rec)`` (None = leave the claim alone, 'neutral'
@@ -540,7 +586,10 @@ def _requeue_claims(spool: str, verdict_fn,
     counts attempts and quarantines at the cap), take the claim file
     over exclusively, and make the incoming/ record durable BEFORE
     unlinking the takeover — the ordering a crashed requeuer depends
-    on to never lose a ticket."""
+    on to never lose a ticket.  Every requeue lands in the journal:
+    a strike as ``takeover`` (naming the dead owner — the crash
+    evidence the crashed worker could not write itself), a neutral
+    one as ``drain_requeue`` with ``neutral_reason``."""
     requeued = []
     for tid in list_tickets(spool, "claimed"):
         src = ticket_path(spool, tid, "claimed")
@@ -559,7 +608,10 @@ def _requeue_claims(spool: str, verdict_fn,
         tmp = _takeover_claim(spool, tid)
         if tmp is None:
             continue            # another janitor beat us to it
-        rec = _strip_claim_stamps(_read_json(tmp) or rec)
+        raw = _read_json(tmp) or rec
+        owner_pid = raw.get("claimed_by")
+        owner_worker = raw.get("claimed_by_worker", "")
+        rec = _strip_claim_stamps(raw)
         if verdict == "strike":
             # the owner died holding this beam: one more strike
             rec["attempts"] = int(rec.get("attempts", 0)) + 1
@@ -575,6 +627,20 @@ def _requeue_claims(spool: str, verdict_fn,
             os.unlink(tmp)
         except OSError:
             pass
+        if verdict == "strike":
+            journal.record(
+                spool, "takeover", ticket=tid,
+                attempt=int(rec.get("attempts", 0)),
+                trace_id=rec.get("trace_id", ""),
+                from_worker=owner_worker, from_pid=owner_pid,
+                by_pid=os.getpid())
+        else:
+            journal.record(
+                spool, "drain_requeue", ticket=tid,
+                worker=owner_worker,
+                attempt=int(rec.get("attempts", 0)),
+                trace_id=rec.get("trace_id", ""),
+                reason=neutral_reason)
         requeued.append(tid)
     return requeued
 
@@ -608,7 +674,8 @@ def requeue_stale_claims(spool: str,
         if owner is not None and _pid_alive(owner):
             return None         # a live co-worker owns this beam
         return "strike"
-    return _requeue_claims(spool, verdict, max_attempts)
+    return _requeue_claims(spool, verdict, max_attempts,
+                           neutral_reason="boot_recovery")
 
 
 def requeue_own_claims(spool: str) -> list[str]:
@@ -621,7 +688,8 @@ def requeue_own_claims(spool: str) -> list[str]:
     me = os.getpid()
     return _requeue_claims(
         spool,
-        lambda rec: "neutral" if rec.get("claimed_by") == me else None)
+        lambda rec: "neutral" if rec.get("claimed_by") == me else None,
+        neutral_reason="drain")
 
 
 # ------------------------------------------------------------- results
@@ -631,15 +699,29 @@ def write_result(spool: str, ticket_id: str, status: str,
     """Record a beam's outcome in done/ and release its claim.  The
     result is durable BEFORE the claim is unlinked, so a crash
     between the two leaves a finished ticket (requeue_stale_claims
-    reconciles it), never a lost one."""
+    reconciles it), never a lost one.  This is the ticket's ONE
+    terminal journal event (``result``): exactly-once across the
+    fleet reads as exactly one such event per ticket."""
     ensure_spool(spool)
+    trace_id = extra.get("trace_id", "")
+    if not trace_id:
+        # quarantine and the stub workers don't thread the id through
+        # their extras; the claim they are finishing still carries it
+        claim = _read_json(ticket_path(spool, ticket_id, "claimed"))
+        trace_id = (claim or {}).get("trace_id", "")
     rec = {"ticket": ticket_id, "status": status, "rc": rc,
            "error": error, "finished_at": time.time(), **extra}
+    if trace_id:
+        rec["trace_id"] = trace_id
     _atomic_write_json(ticket_path(spool, ticket_id, "done"), rec)
     try:
         os.unlink(ticket_path(spool, ticket_id, "claimed"))
     except OSError:
         pass
+    journal.record(spool, "result", ticket=ticket_id,
+                   worker=str(extra.get("worker", "") or ""),
+                   attempt=int(extra.get("attempts", 0) or 0),
+                   trace_id=trace_id, status=status, rc=rc)
 
 
 def read_result(spool: str, ticket_id: str) -> dict | None:

@@ -45,7 +45,7 @@ import signal
 import threading
 import time
 
-from tpulsar.obs import telemetry
+from tpulsar.obs import journal, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.resilience import faults, policy
 from tpulsar.serve import protocol
@@ -90,7 +90,7 @@ class SearchServer:
                                                      self.worker_id),
             workdir_base=cfg.processing.base_working_directory,
             cfg=cfg, depth=prefetch_depth, poll_s=poll_s,
-            logger=self.log)
+            logger=self.log, journal=self._journal)
         self._drain = threading.Event()
         self._stopped = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -116,6 +116,16 @@ class SearchServer:
     @property
     def draining(self) -> bool:
         return self._drain.is_set()
+
+    def _journal(self, event: str, ticket: dict, **extra) -> None:
+        """This worker's journal hook (the stage-in pipeline calls it
+        too): stamps worker id, attempt, and the ticket's trace id
+        onto every event."""
+        journal.record(
+            self.spool, event, ticket=ticket.get("ticket", "?"),
+            worker=self.worker_id,
+            attempt=int(ticket.get("attempts", 0)),
+            trace_id=ticket.get("trace_id", ""), **extra)
 
     # ------------------------------------------------------------ boot
 
@@ -162,6 +172,12 @@ class SearchServer:
             self.spool, worker_id=self.worker_id, status=status,
             queue_depth=depth, max_queue_depth=self.max_queue_depth,
             beams=dict(self.beams), started_at=self.started_at)
+        # every heartbeat also drops this worker's registry snapshot
+        # into the spool, so the fleet aggregator can merge ALL
+        # workers' metrics without attaching to any process
+        # (lazy import: fleetview imports the serve package)
+        from tpulsar.obs import fleetview
+        fleetview.export_worker_snapshot(self.spool, self.worker_id)
         self._hb_last = now
 
     def _heartbeat_loop(self) -> None:
@@ -258,6 +274,12 @@ class SearchServer:
         tid = prepared.ticket_id
         outdir = prepared.ticket.get("outdir", "")
         t0 = time.time()
+        # adopt the ticket's trace context: every span this thread
+        # records while searching the beam carries the trace id
+        # minted at submission, so a stolen beam's spans from two
+        # workers stitch into one timeline
+        telemetry.trace.set_trace_id(
+            prepared.ticket.get("trace_id", ""))
         telemetry.trace.instant("serve_beam_start", ticket=tid)
         if faults.targets("fleet.worker"):
             try:
@@ -281,6 +303,7 @@ class SearchServer:
             self._finish(tid, "failed", t0, outdir,
                          error=prepared.error, attempts=att)
             return
+        self._journal("search_start", prepared.ticket)
         misses0 = self._compile_misses_total()
         try:
             outcome = policy.run_with_deadline(
@@ -348,6 +371,7 @@ class SearchServer:
         if status != "skipped":
             telemetry.serve_beam_seconds().observe(
                 dt, mode="warm" if warm else "cold")
+        telemetry.trace.set_trace_id("")     # the beam's context ends
         self._heartbeat("running", force=True)
         self.log.info("ticket %s -> %s in %.2f s (%s)", tid, status,
                       dt, "warm" if warm else "cold")

@@ -91,12 +91,17 @@ class StageInPipeline:
 
     def __init__(self, claim: Callable[[], dict | None],
                  workdir_base: str | None = None, cfg=None,
-                 depth: int = 1, poll_s: float = 0.5, logger=None):
+                 depth: int = 1, poll_s: float = 0.5, logger=None,
+                 journal: Callable | None = None):
         self.claim = claim
         self.workdir_base = workdir_base
         self.cfg = cfg
         self.poll_s = poll_s
         self.log = logger or get_logger("serve.stagein")
+        #: optional lifecycle hook ``journal(event, ticket_rec,
+        #: **extra)`` — the server passes its journal writer so
+        #: stage-in outcomes land in the spool's ticket journal
+        self.journal = journal
         self._out: queue.Queue[PreparedBeam] = queue.Queue(
             maxsize=max(1, depth))
         self._stop = threading.Event()
@@ -128,7 +133,24 @@ class StageInPipeline:
                                               time.time())
             telemetry.serve_admission_wait_seconds().observe(
                 max(0.0, waited))
-            prepared = prepare_beam(ticket, self.workdir_base, self.cfg)
+            # the prefetch thread stages beam N+1 while the main
+            # thread searches beam N: each thread stamps its OWN
+            # beam's trace id on the spans it records
+            telemetry.trace.set_trace_id(ticket.get("trace_id", ""))
+            try:
+                prepared = prepare_beam(ticket, self.workdir_base,
+                                        self.cfg)
+            finally:
+                telemetry.trace.set_trace_id("")
+            if self.journal is not None:
+                if prepared.error:
+                    self.journal(
+                        "stagein_failed", ticket,
+                        error=prepared.error.splitlines()[0][:200])
+                else:
+                    self.journal(
+                        "stagein_done", ticket,
+                        seconds=round(prepared.stagein_seconds, 3))
             while not self._stop.is_set():
                 try:
                     self._out.put(prepared, timeout=0.25)
