@@ -152,6 +152,42 @@ def test_window_scan_matches_subband_scan():
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def test_pad_bucket_zero_shift_pads_nothing():
+    """maxshift == 0 must yield a ZERO pad bucket (regression: the
+    bucket floor of 256 padded 256 samples per row on zero-shift
+    passes, widening the whole block for gathers that always start
+    at 0), while any positive shift keeps the >=256 bucket ladder."""
+    assert dd._pad_bucket(0) == 0
+    assert dd._pad_bucket(-3) == 0
+    assert dd._pad_bucket(1) == 256
+    assert dd._pad_bucket(256) == 256
+    assert dd._pad_bucket(257) == 512
+
+    # _edge_pad with pad=0 is the identity (no zero-width concat)
+    x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    assert dd._edge_pad(x, 0) is x
+
+    # a zero-shift pass end-to-end: stage 1 + stage 2 at pad 0 equal
+    # the plain channel-group sums (and compile with pad=0 statics)
+    spec, _, data = _beam(dm=0.0, snr=0.0)
+    nchan, T = data.shape
+    zero = np.zeros(nchan, np.int32)
+    subb = dd.form_subbands(jnp.asarray(data), zero, nsub=8,
+                            downsamp=1)
+    np.testing.assert_allclose(np.asarray(subb),
+                               data.reshape(8, nchan // 8, T).sum(1),
+                               rtol=1e-4, atol=1e-4)
+    out = dd.dedisperse_subbands(subb, np.zeros((3, 8), np.int32))
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.broadcast_to(np.asarray(subb).sum(0), (3, T)),
+        rtol=1e-5, atol=1e-3)
+
+    # zero shifts through the host gather entry point too
+    same = dd._shift_gather(jnp.asarray(data), zero)
+    np.testing.assert_array_equal(np.asarray(same), data)
+
+
 def test_shift_rows_clamps_and_matches_reference():
     """_shift_rows (edge-pad + dynamic slice) == the index formula
     out[i,t] = data[i, min(t+s, T-1)], including shifts at/above pad."""

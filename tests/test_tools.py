@@ -57,6 +57,44 @@ def test_compare_exact_preferred_over_harmonic():
 
 
 @pytest.mark.slow
+def test_trace_compare_folds_fused_detrend(tmp_path):
+    """--compare-report's stage check folds the tree family's fused
+    "detrend" span into the dedispersing stage (the .report credits
+    the fence there via StageTimers.credit, the trace keeps the span
+    name for per-family attribution) — and must NOT fold when the
+    report carries its own detrend row (that would double-count)."""
+    ts = _load("trace_summarize")
+    report = tmp_path / "x.report"
+    report.write_text(
+        "Timing report for x\n"
+        "   Total time: 10.00 s\n\n"
+        "      dedispersing:      6.00 s  ( 60.0%)\n"
+        "      single-pulse:      2.00 s  ( 20.0%)\n")
+    # trace: dedispersing span 4 s + fused detrend span 2 s => the
+    # folded total matches the report's 6 s within 5%
+    summary = {"rollup": {
+        "dedispersing": {"seconds": 4.0, "count": 3},
+        "detrend": {"seconds": 2.0, "count": 3},
+        "single-pulse": {"seconds": 2.0, "count": 3},
+    }}
+    assert ts.compare(summary, str(report)) == []
+    # without the detrend span the gap is a REAL mismatch
+    summary2 = {"rollup": {
+        "dedispersing": {"seconds": 4.0, "count": 3},
+        "single-pulse": {"seconds": 2.0, "count": 3},
+    }}
+    assert any("dedispersing" in p
+               for p in ts.compare(summary2, str(report)))
+    # a report that rows detrend itself is compared row-for-row
+    report2 = tmp_path / "y.report"
+    report2.write_text(
+        "Timing report for y\n"
+        "   Total time: 10.00 s\n\n"
+        "      dedispersing:      4.00 s  ( 40.0%)\n"
+        "           detrend:      2.00 s  ( 20.0%)\n")
+    assert ts.compare(summary, str(report2)) == []
+
+
 def test_aot_check_cli_smoke():
     """The AOT memory checker compiles a tiny-scale program set and
     exits 0 (CPU; the tool's purpose is pre-validating full-scale

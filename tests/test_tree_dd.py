@@ -1,0 +1,303 @@
+"""Parity suite for the log-depth shift-tree dedispersion family.
+
+The tree (kernels/tree_dd.py) must sum EXACTLY the same clamped-gather
+terms as the direct kernel — out[d, t] = sum_s subb[s, min(t +
+shift[d, s], T-1)] — so parity against the direct XLA scan (and, at
+the sub-DM, the exact single-stage NumPy oracle) holds to float
+summation-order tolerance on every plan geometry, never
+approximately.  A fast subset of the survey plan's geometries runs in
+tier-1; the full 57-pass sweep rides behind @pytest.mark.slow.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpulsar.kernels import dedisperse as dd
+from tpulsar.kernels import singlepulse as sp_k
+from tpulsar.kernels import tree_dd
+from tpulsar.plan import ddplan
+
+# the bench/gate beam geometry (registry.py re-exports these; kept
+# inline so this suite has no aot dependency)
+NCHAN = 960
+FCTR, BW = 1375.5, 322.617
+TSAMP = 65.476e-6
+
+_FREQS = (FCTR - BW / 2) + (np.arange(NCHAN) + 0.5) * (BW / NCHAN)
+
+# summation-order tolerance: the tree is an exact index
+# restructuring, so only float accumulation order differs
+RTOL, ATOL = 2e-6, 2e-5
+
+
+def _pass_shifts(step: ddplan.DedispStep, pass_idx: int) -> np.ndarray:
+    ppass = step.passes()[pass_idx]
+    _ch, sub_sh = dd.plan_pass_shifts(
+        _FREQS, step.numsub, ppass.subdm, np.asarray(ppass.dms),
+        TSAMP, step.downsamp)
+    return sub_sh
+
+
+def _subb(nsub: int, T: int, seed: int = 3) -> jnp.ndarray:
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((nsub, T))
+                       .astype(np.float32))
+
+
+def _assert_tree_matches_direct(sub_sh, T: int, seed: int = 3,
+                                **plan_kw):
+    subb = _subb(sub_sh.shape[1], T, seed)
+    plan = tree_dd.build_tree_plan(sub_sh, T=T, **plan_kw)
+    got = np.asarray(tree_dd.dedisperse_tree_pass(subb, sub_sh, plan))
+    want = np.asarray(dd._dedisperse_subbands_xla(subb, sub_sh))
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+    return plan
+
+
+# fast tier-1 subset: one early + one late (largest shifts) pass of
+# the ds=1 step, and one pass of each higher-downsamp geometry class
+FAST_GEOMS = [(0, 0), (0, 27), (1, 3), (3, 4), (5, 0)]
+
+
+@pytest.mark.parametrize("step_idx,pass_idx", FAST_GEOMS)
+def test_tree_matches_direct_survey_geometry(step_idx, pass_idx):
+    step = ddplan.survey_plan("pdev")[step_idx]
+    sub_sh = _pass_shifts(step, min(pass_idx, step.numpasses - 1))
+    plan = _assert_tree_matches_direct(sub_sh, T=4096)
+    assert plan.depth >= 1           # a real tree, not the fallback
+    # log depth: never more merge levels than log2(nsub) rounds
+    assert plan.depth <= int(np.ceil(np.log2(step.numsub)))
+    # and a real row-op win on survey passes
+    assert plan.cost_rows * 2 <= ddplan.dedisp_cost_direct(
+        sub_sh.shape[0], step.numsub)
+
+
+@pytest.mark.slow
+def test_tree_matches_direct_full_survey_sweep():
+    """Every pass of the full 57-pass Mock survey plan."""
+    for step in ddplan.survey_plan("pdev"):
+        for pass_idx in range(step.numpasses):
+            sub_sh = _pass_shifts(step, pass_idx)
+            _assert_tree_matches_direct(sub_sh, T=2048,
+                                        seed=pass_idx)
+
+
+def test_tree_matches_exact_oracle_at_subdm():
+    """Through the full two-stage chain at DM == subdm, the tree's
+    stage 2 tracks dedisperse_exact as closely as the direct kernel
+    does (same terms => same correlation with the oracle)."""
+    rng = np.random.default_rng(11)
+    nchan, T, dt = 64, 8192, 5e-4
+    freqs = np.linspace(1214.0, 1536.0, nchan)
+    data = rng.standard_normal((nchan, T)).astype(np.float32)
+    dms = np.arange(40.0, 60.0, 0.5)
+    ch_sh, sub_sh = dd.plan_pass_shifts(freqs, 16, 50.0, dms, dt, 1)
+    subb = dd.form_subbands(jnp.asarray(data), jnp.asarray(ch_sh),
+                            16, 1)
+    tree = np.asarray(tree_dd.dedisperse_tree_pass(subb, sub_sh))
+    direct = np.asarray(dd.dedisperse_subbands(
+        subb, jnp.asarray(sub_sh)))
+    oracle = dd.dedisperse_exact(data, freqs, dms, dt)
+    valid = T - dd.max_shift_samples(freqs, dms.max(), dt) - 1
+    i50 = int(np.argmin(np.abs(dms - 50.0)))
+    c_tree = np.corrcoef(tree[i50, :valid], oracle[i50, :valid])[0, 1]
+    c_direct = np.corrcoef(direct[i50, :valid],
+                           oracle[i50, :valid])[0, 1]
+    # the subband approximation owns whatever gap exists; the tree
+    # adds only summation-order noise on top of the direct kernel.
+    # (The absolute correlation floor is loose: on pure noise the
+    # two-stage double rounding decorrelates per-sample values — the
+    # equivalence assertion above is the load-bearing one.)
+    assert c_tree == pytest.approx(c_direct, abs=1e-6)
+    assert c_tree > 0.7
+
+
+def test_carry_geometry_odd_group_counts():
+    """nsub values whose halving passes through odd group counts
+    exercise the carry (pass-through) rows at several levels."""
+    rng = np.random.default_rng(21)
+    for nsub in (12, 24, 96):
+        ramp = np.linspace(0.0, 300.0, nsub)[::-1]
+        sh = np.round(np.arange(1, 41)[:, None] * ramp[None, :] / 40.0
+                      ).astype(np.int32)
+        _assert_tree_matches_direct(sh, T=1024, seed=nsub)
+
+
+def test_zero_shift_pass_and_pad_zero():
+    """An all-zero shift table (zero-DM pass) builds a pad-0 plan and
+    reproduces the plain subband sum."""
+    sh = np.zeros((8, 16), np.int32)
+    plan = tree_dd.build_tree_plan(sh, T=512)
+    assert plan.pad == 0
+    subb = _subb(16, 512)
+    got = np.asarray(tree_dd.dedisperse_tree_pass(subb, sh, plan))
+    want = np.broadcast_to(np.asarray(subb).sum(0), (8, 512))
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_residual_chunks_equal_full_pass():
+    """The executor's per-dm_chunk residual dispatch must reproduce
+    the whole-pass evaluation exactly (the levels are shared; chunks
+    only slice the gather tables)."""
+    step = ddplan.survey_plan("pdev")[0]
+    sub_sh = _pass_shifts(step, 5)
+    T = 2048
+    subb = _subb(step.numsub, T)
+    plan = tree_dd.build_tree_plan(sub_sh, T=T)
+    parts = tree_dd.tree_levels(subb, plan)
+    full = np.asarray(tree_dd.residual_series(parts, plan, 0,
+                                              plan.ndms, T))
+    chunks = [np.asarray(tree_dd.residual_series(parts, plan, lo,
+                                                 min(30, plan.ndms - lo),
+                                                 T))
+              for lo in range(0, plan.ndms, 30)]
+    np.testing.assert_array_equal(np.concatenate(chunks), full)
+
+
+def test_fused_detrend_matches_standalone():
+    """The fused residual program's detrend output equals
+    normalize_series over the same series for every estimator (one
+    shared implementation, two jitted programs)."""
+    step = ddplan.survey_plan("pdev")[1]
+    sub_sh = _pass_shifts(step, 0)
+    T = 4096
+    subb = _subb(step.numsub, T, seed=9)
+    plan = tree_dd.build_tree_plan(sub_sh, T=T)
+    parts = tree_dd.tree_levels(subb, plan)
+    for est in ("median", "median_sub4", "clipped_mean"):
+        series, norm = tree_dd.residual_series(
+            parts, plan, 0, plan.ndms, T, fuse=True, estimator=est)
+        ref = sp_k.normalize_series(series, estimator=est)
+        np.testing.assert_allclose(np.asarray(norm), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=est)
+
+
+# ------------------------------------------------------------ cost model
+
+def test_cost_model_picks_tree_for_survey_direct_for_small():
+    """Survey passes (large regular grids) go tree; the golden-scale
+    passes (< TREE_MIN_NDMS trials) and irregular grids stay direct —
+    the direct kernel remains the oracle and the fallback."""
+    step = ddplan.survey_plan("pdev")[0]
+    sub_sh = _pass_shifts(step, 14)
+    assert tree_dd.plan_for_pass(sub_sh, T=4096) is not None
+
+    # golden-scenario scale: 12 trials — always direct
+    small = sub_sh[:12, :16]
+    assert tree_dd.plan_for_pass(small, T=4096) is None
+
+    # irregular grid: ~ndms distinct patterns per group at every
+    # level, ratio collapses, direct wins
+    rng = np.random.default_rng(33)
+    wild = rng.integers(0, 2000, size=(64, 32)).astype(np.int32)
+    plan = tree_dd.build_tree_plan(wild, T=4096)
+    assert ddplan.choose_dedisp_family(
+        64, 32, tree_cost_rows=plan.cost_rows) == "direct"
+    assert tree_dd.plan_for_pass(wild, T=4096) is None
+
+
+def test_family_env_override(monkeypatch):
+    step = ddplan.survey_plan("pdev")[0]
+    sub_sh = _pass_shifts(step, 14)
+    monkeypatch.setenv("TPULSAR_DD_FAMILY", "direct")
+    assert tree_dd.plan_for_pass(sub_sh, T=4096) is None
+    monkeypatch.setenv("TPULSAR_DD_FAMILY", "tree")
+    small = sub_sh[:8]
+    assert tree_dd.plan_for_pass(small, T=4096) is not None
+    monkeypatch.setenv("TPULSAR_DD_FAMILY", "bogus")
+    with pytest.raises(ValueError):
+        ddplan.dedisp_family_override()
+
+
+def test_budget_cuts_tree_shallower():
+    """A tight level budget forces an earlier cut (smaller level
+    tensors, more residual groups) — and the result stays exact."""
+    step = ddplan.survey_plan("pdev")[0]
+    sub_sh = _pass_shifts(step, 5)
+    T = 2048
+    deep = tree_dd.build_tree_plan(sub_sh, T=T)
+    # sized to admit the first couple of level pairs but not the
+    # deeper (wider) ones
+    tight_budget = 550 * (T + 2048) * 4
+    tight = tree_dd.build_tree_plan(sub_sh, T=T, budget=tight_budget)
+    assert 1 <= tight.depth < deep.depth
+    assert tight.groups > deep.groups
+    _assert_tree_matches_direct(sub_sh, T=T, budget=tight_budget)
+    # cut 0 (budget below even one level) degenerates to the direct
+    # formulation: nsub groups, no merge levels, still exact
+    floor = tree_dd.build_tree_plan(sub_sh, T=T, budget=1)
+    assert floor.depth == 0 and floor.groups == sub_sh.shape[1]
+    _assert_tree_matches_direct(sub_sh, T=T, budget=1)
+
+
+# ------------------------------------------------------- executor wiring
+
+def test_executor_tree_and_direct_agree_end_to_end(monkeypatch):
+    """search_block under TPULSAR_DD_FAMILY=tree vs =direct: same
+    trial count, same single-pulse events, candidate lists agreeing
+    to summation-order tolerance — and the per-family telemetry
+    counters attribute the pass to the right kernel."""
+    from tpulsar.constants import dispersion_delay_s
+    from tpulsar.obs import telemetry
+    from tpulsar.search import executor
+
+    rng = np.random.default_rng(5)
+    nchan, T, dt = 64, 1 << 13, 5e-4
+    freqs = np.linspace(1214.0, 1536.0, nchan)
+    data = rng.standard_normal((nchan, T)).astype(np.float32)
+    t = np.arange(T) * dt
+    delays = dispersion_delay_s(50.0, freqs, freqs[-1])
+    for c in range(nchan):
+        data[c] += ((((t - delays[c]) / 0.2) % 1.0) < 0.1) * 1.0
+    plan = [ddplan.DedispStep(lodm=10.0, dmstep=2.0, dms_per_pass=40,
+                              numpasses=1, numsub=32, downsamp=1)]
+    params = executor.SearchParams(
+        nsub=32, run_hi_accel=False, hi_accel_zmax=0,
+        topk_per_stage=16, max_cands_to_fold=2, refine_cands=False,
+        make_plots=False)
+
+    def run(family):
+        monkeypatch.setenv("TPULSAR_DD_FAMILY", family)
+        base = telemetry.metrics.REGISTRY.snapshot()
+        final, _folded, sp, nt = executor.search_block(
+            jnp.asarray(data), freqs, dt, plan, params)
+        delta = telemetry.metrics.diff_snapshots(
+            telemetry.metrics.REGISTRY.snapshot(), base)
+        fams = (delta.get("tpulsar_dedisp_trials_total") or {}
+                ).get("series", {})
+        return final, sp, nt, fams
+
+    ft, spt, ntt, fam_t = run("tree")
+    fd, spd, ntd, fam_d = run("direct")
+    assert ntt == ntd == 40
+    assert fam_t == {"tree": 40.0}, fam_t
+    assert fam_d == {"direct": 40.0}, fam_d
+    # SP events from the fused detrend == the standalone traversal
+    # (same impl, different program: sigma may move in the last ulp)
+    assert len(spt) == len(spd)
+    st = np.sort(spt, order=["dm", "sample"])
+    sd = np.sort(spd, order=["dm", "sample"])
+    for f in ("dm", "sample", "downfact"):
+        np.testing.assert_array_equal(st[f], sd[f])
+    np.testing.assert_allclose(st["sigma"], sd["sigma"], rtol=1e-4)
+    # candidate lists agree (summation order may move sigma in the
+    # last decimals, never the detections)
+    assert len(ft) == len(fd)
+    for a, b in zip(ft, fd):
+        assert a.dm == b.dm and a.numharm == b.numharm
+        assert a.freq_hz == pytest.approx(b.freq_hz, rel=1e-6)
+        assert a.sigma == pytest.approx(b.sigma, rel=1e-3)
+
+
+def test_auto_family_keeps_golden_scale_direct():
+    """The auto cost model must leave a golden-scenario-sized pass on
+    the direct family (frozen candidate lists depend on its float
+    summation order)."""
+    assert "TPULSAR_DD_FAMILY" not in os.environ
+    sh = _pass_shifts(ddplan.survey_plan("pdev")[0], 0)[:12, :16]
+    assert tree_dd.plan_for_pass(np.ascontiguousarray(sh),
+                                 T=1 << 15) is None

@@ -38,7 +38,12 @@ def shift_samples(dm, freqs_mhz, ref_mhz, dt) -> np.ndarray:
 def _pad_bucket(maxshift: int) -> int:
     """Round a maximum shift up to a power-of-two bucket (>=256) so the
     static pad width takes few distinct values across a survey plan's
-    passes and compile signatures stay bounded."""
+    passes and compile signatures stay bounded.  A zero maximum shift
+    needs NO pad at all: every gather start is 0 and the slice is the
+    row itself — padding 256 samples per row there bought nothing but
+    a widened copy of the whole block on zero-shift passes."""
+    if maxshift <= 0:
+        return 0
     p = 256
     while p < maxshift:
         p *= 2
@@ -49,7 +54,10 @@ def _edge_pad(data: jnp.ndarray, pad: int) -> jnp.ndarray:
     """Extend each row of (nrows, T) with `pad` copies of its last
     sample — THE edge-clamp realization every shift formulation here
     composes on (indices past T-1 read the replicated tail, exactly
-    out[t] = data[min(t, T-1)])."""
+    out[t] = data[min(t, T-1)]).  pad=0 returns the input unchanged
+    (zero-shift passes; see _pad_bucket)."""
+    if pad <= 0:
+        return data
     nrows = data.shape[0]
     tail = jnp.broadcast_to(data[:, -1:],
                             (nrows, pad)).astype(data.dtype)

@@ -35,6 +35,41 @@ SP_EVENT_DTYPE = np.dtype([("dm", "f8"), ("sigma", "f8"),
                            ("downfact", "i4")])
 
 
+def detrend_normalize(series: jnp.ndarray, detrend_block: int = 1000,
+                      estimator: str = "median"):
+    """The detrend/normalize BODY (traceable, not itself jitted).
+
+    One implementation shared by two jitted programs:
+    ``normalize_series`` below (the standalone SP detrend pass) and
+    the tree dedispersion family's fused residual program
+    (kernels/tree_dd.py), which inlines the detrend into the same
+    device program as the final shift layer so the (ndms, T) series
+    never makes an extra HBM round-trip just to be baselined."""
+    ndms, T = series.shape
+    detrend_block = min(detrend_block, T)
+    nblk = max(1, T // detrend_block)
+    usable = nblk * detrend_block
+    blocks = series[:, :usable].reshape(ndms, nblk, detrend_block)
+    if estimator == "median":
+        med = jnp.median(blocks, axis=-1)
+    elif estimator == "median_sub4":
+        med = jnp.median(blocks[..., ::4], axis=-1)
+    elif estimator == "clipped_mean":
+        mu = blocks.mean(axis=-1, keepdims=True)
+        sd = jnp.maximum(blocks.std(axis=-1, keepdims=True), 1e-9)
+        w = (jnp.abs(blocks - mu) <= 3.0 * sd).astype(blocks.dtype)
+        med = (blocks * w).sum(-1) / jnp.maximum(w.sum(-1), 1.0)
+    else:
+        raise ValueError(f"unknown SP detrend estimator {estimator!r}")
+    # Broadcast block baselines back out (tail reuses the last
+    # block's).
+    baseline = jnp.repeat(med, detrend_block, axis=-1)
+    baseline = jnp.pad(baseline, ((0, 0), (0, T - usable)), mode="edge")
+    detrended = series - baseline
+    std = jnp.maximum(jnp.std(detrended, axis=-1, keepdims=True), 1e-9)
+    return detrended / std
+
+
 @partial(jax.jit, static_argnames=("detrend_block", "estimator"))
 def normalize_series(series: jnp.ndarray, detrend_block: int = 1000,
                      estimator: str = "median"):
@@ -59,29 +94,7 @@ def normalize_series(series: jnp.ndarray, detrend_block: int = 1000,
     for the on-chip A/B; the default stays exact-median until a TPU
     measurement justifies switching.
     """
-    ndms, T = series.shape
-    detrend_block = min(detrend_block, T)
-    nblk = max(1, T // detrend_block)
-    usable = nblk * detrend_block
-    blocks = series[:, :usable].reshape(ndms, nblk, detrend_block)
-    if estimator == "median":
-        med = jnp.median(blocks, axis=-1)
-    elif estimator == "median_sub4":
-        med = jnp.median(blocks[..., ::4], axis=-1)
-    elif estimator == "clipped_mean":
-        mu = blocks.mean(axis=-1, keepdims=True)
-        sd = jnp.maximum(blocks.std(axis=-1, keepdims=True), 1e-9)
-        w = (jnp.abs(blocks - mu) <= 3.0 * sd).astype(blocks.dtype)
-        med = (blocks * w).sum(-1) / jnp.maximum(w.sum(-1), 1.0)
-    else:
-        raise ValueError(f"unknown SP detrend estimator {estimator!r}")
-    # Broadcast block baselines back out (tail reuses the last
-    # block's).
-    baseline = jnp.repeat(med, detrend_block, axis=-1)
-    baseline = jnp.pad(baseline, ((0, 0), (0, T - usable)), mode="edge")
-    detrended = series - baseline
-    std = jnp.maximum(jnp.std(detrended, axis=-1, keepdims=True), 1e-9)
-    return detrended / std
+    return detrend_normalize(series, detrend_block, estimator)
 
 
 _ESTIMATORS = ("median", "median_sub4", "clipped_mean")

@@ -57,6 +57,44 @@ def dm_trials_total() -> metrics.Counter:
         "DM trials searched")
 
 
+def dedisp_trials_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_dedisp_trials_total",
+        "DM trials dedispersed, by stage-2 kernel family (direct "
+        "shift-and-sum vs log-depth shift tree) — with "
+        "tpulsar_dedisp_stage_seconds this yields trials/sec per "
+        "family",
+        labelnames=("family",))
+
+
+def dedisp_stage_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_dedisp_stage_seconds",
+        "wall seconds of stage-2 dedispersion per pass, by kernel "
+        "family (tree observations include the shared level "
+        "evaluation, the per-chunk residual layers, and the fused "
+        "detrend)",
+        labelnames=("family",), buckets=STAGE_BUCKETS)
+
+
+def dedisp_tree_depth() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_dedisp_tree_depth",
+        "merge-level depth of the most recent pass's tree plan (0 = "
+        "the plan cut at the leaves, i.e. direct-equivalent; the "
+        "budget governor cuts shallower when level tensors would "
+        "exceed TPULSAR_TREE_BUDGET)")
+
+
+def dedisp_residual_fraction() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_dedisp_residual_fraction",
+        "fraction of the most recent tree pass's row-ops spent in "
+        "the per-trial residual layer (the rest is the shared "
+        "merge levels every trial reuses); near 1.0 means the grid "
+        "shares almost nothing and direct would do as well")
+
+
 def retry_attempts_total() -> metrics.Counter:
     return metrics.counter(
         "tpulsar_retry_attempts_total",

@@ -43,6 +43,7 @@ from tpulsar.obs import telemetry
 from tpulsar.obs import trace as trace_mod
 from tpulsar.kernels import dedisperse as dd
 from tpulsar.kernels import fold as fold_k
+from tpulsar.kernels import tree_dd
 from tpulsar.kernels import fourier as fr
 from tpulsar.kernels import rfi as rfi_k
 from tpulsar.kernels import singlepulse as sp_k
@@ -514,6 +515,29 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
             else:
                 chunk_sz = pass_chunk_size(
                     len(dms), ddplan.choose_n(subb.shape[1]), params)
+                # Stage-2 kernel family for THIS pass: the ddplan
+                # cost model picks the log-depth shift tree
+                # (kernels/tree_dd.py) when the pass's DM grid lets
+                # the shared merge levels amortize across its trials
+                # (survey passes: ~4x fewer row-ops), and keeps the
+                # direct shift-and-sum — the oracle — for small or
+                # irregular grids, under TPULSAR_DD_FAMILY override.
+                # Tree passes run the levels ONCE here; each dm_chunk
+                # below only pays its residual layer, with the SP
+                # detrend fused into the same program.
+                t_dd0 = timers.times.get("dedispersing", 0.0)
+                tree_plan = tree_dd.plan_for_pass(
+                    sub_shifts, T=int(subb.shape[1]))
+                tree_parts = None
+                sp_est = sp_k.detrend_estimator(params.sp_detrend)
+                if tree_plan is not None:
+                    with timers.timing("dedispersing"):
+                        tree_parts = tree_dd.tree_levels(subb,
+                                                         tree_plan)
+                        trace_mod.fence(tree_parts)
+                    telemetry.dedisp_tree_depth().set(tree_plan.depth)
+                    telemetry.dedisp_residual_fraction().set(
+                        round(tree_plan.residual_fraction, 4))
                 # SP and lo-stage device outputs are DEFERRED to one
                 # device_get per pass (below): the per-chunk blocking
                 # np.asarray cost one host<->device round-trip per
@@ -543,17 +567,39 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                     # pass/chunk structure, not just stage totals
                     with trace_mod.span("dm_chunk",
                                         pass_idx=pass_idx, lo=int(lo),
-                                        n=int(len(dm_chunk))):
+                                        n=int(len(dm_chunk)),
+                                        family=("tree" if tree_parts
+                                                is not None
+                                                else "direct")):
+                        norm = None
                         with timers.timing("dedispersing"):
-                            series = dd.dedisperse_subbands(
-                                subb,
-                                jnp.asarray(
-                                    sub_shifts[lo: lo + len(dm_chunk)]))
+                            if tree_parts is not None:
+                                series, norm = tree_dd.residual_series(
+                                    tree_parts, tree_plan, lo,
+                                    len(dm_chunk),
+                                    T=int(subb.shape[1]),
+                                    fuse=True, estimator=sp_est)
+                            else:
+                                series = dd.dedisperse_subbands(
+                                    subb,
+                                    jnp.asarray(
+                                        sub_shifts[lo: lo
+                                                   + len(dm_chunk)]))
                             # opt-in device attribution
                             # (TPULSAR_TRACE_SYNC=1): fence so the
                             # scope's exit clock includes the device
-                            # compute this enqueue started
-                            trace_mod.fence(series)
+                            # compute this enqueue started.  On the
+                            # tree path series and norm are outputs
+                            # of ONE fused executable, so fencing
+                            # either blocks on both: the fused
+                            # detrend's wall time lands inside
+                            # 'dedispersing' in the report AND the
+                            # trace (a per-chunk detrend/dedisp
+                            # split is unmeasurable for a fused
+                            # program — the bench --dedisp A/B
+                            # carries its marginal cost instead)
+                            trace_mod.fence(series if norm is None
+                                            else (series, norm))
                         num_trials += len(dm_chunk)
                         # FFT-friendly padded length (reference: PRESTO
                         # choose_N via prepsubband -numout,
@@ -563,12 +609,20 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                         T_s = nfft * dt_ds
 
                         with timers.timing("single-pulse"):
-                            # the device half of single_pulse_search
-                            # (same two jitted programs); the host half
-                            # (events_from_topk) runs at pass end
-                            sp_pair = sp_k.device_search(
-                                series, tuple(params.sp_widths),
-                                estimator=params.sp_detrend)
+                            # the device half of single_pulse_search;
+                            # on the tree path the detrend already
+                            # ran fused into the residual program, so
+                            # only the boxcar ladder remains here.
+                            # The host half (events_from_topk) runs
+                            # at pass end either way.
+                            if norm is not None:
+                                sp_pair = sp_k.boxcar_search(
+                                    norm, tuple(params.sp_widths),
+                                    sp_k.DEFAULT_TOPK)
+                            else:
+                                sp_pair = sp_k.device_search(
+                                    series, tuple(params.sp_widths),
+                                    estimator=params.sp_detrend)
                             trace_mod.fence(sp_pair)
 
                         with timers.timing("FFT"):
@@ -640,6 +694,17 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                             bin_scale=0.5))
                     all_cands.extend(hi_cands)
                 del pending
+                # per-family throughput instruments: with the trials
+                # counter, the stage-seconds histogram yields
+                # trials/sec per kernel family (the bench A/B's
+                # headline, continuously exported)
+                fam = "tree" if tree_parts is not None else "direct"
+                del tree_parts
+                telemetry.dedisp_trials_total().inc(len(dms),
+                                                    family=fam)
+                telemetry.dedisp_stage_seconds().observe(
+                    timers.times.get("dedispersing", 0.0) - t_dd0,
+                    family=fam)
             del subb
             if checkpoint_dir:
                 _save_pass_checkpoint(
