@@ -1255,6 +1255,152 @@ def run_serve() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_gateway() -> None:
+    """``bench.py --gateway``: push N synthetic beams through the
+    HTTP front door (tpulsar/frontdoor/) backed by one resident warm
+    worker on a filesystem spool, and report submit→result latency —
+    measured from the journal's gateway-edge ``received`` event (HTTP
+    arrival) to the terminal ``result`` — plus the status-query
+    overhead the HTTP hop adds over reading the spool directly.  The
+    first beam pays the compiles (cold); the steady-state warm median
+    is the number the front door must not regress.  Emits one
+    bench/v2 record with an additive ``gateway`` key.
+
+    Knobs: TPULSAR_GW_NBEAMS/NCHAN/NSAMP/DM_MAX (beam set, defaults
+    3/16/4096/30), TPULSAR_GW_STATUS_REPS (status-overhead sample
+    count, default 50), TPULSAR_GW_KEEP=1 keeps the scratch dir."""
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from tpulsar.config import TpulsarConfig, set_settings
+    from tpulsar.frontdoor import client
+    from tpulsar.frontdoor.gateway import GatewayServer
+    from tpulsar.frontdoor.queue import FilesystemSpoolQueue
+    from tpulsar.io import synth
+    from tpulsar.obs import fleetview, journal
+    from tpulsar.serve import protocol
+    from tpulsar.serve.server import SearchServer
+
+    nbeams = int(os.environ.get("TPULSAR_GW_NBEAMS", "3"))
+    nchan = int(os.environ.get("TPULSAR_GW_NCHAN", "16"))
+    nsamp = int(os.environ.get("TPULSAR_GW_NSAMP", "4096"))
+    dm_max = float(os.environ.get("TPULSAR_GW_DM_MAX", "30"))
+    status_reps = int(os.environ.get("TPULSAR_GW_STATUS_REPS", "50"))
+    base = tempfile.mkdtemp(prefix="tpulsar_gwbench_")
+
+    cfg = TpulsarConfig()
+    cfg.basic.log_dir = os.path.join(base, "logs")
+    cfg.background.jobtracker_db = os.path.join(base, "jt.db")
+    cfg.download.datadir = os.path.join(base, "raw")
+    cfg.processing.base_working_directory = os.path.join(base, "work")
+    cfg.processing.base_results_directory = os.path.join(base, "res")
+    cfg.resultsdb.url = os.path.join(base, "results.db")
+    cfg.searching.dm_max = dm_max
+    cfg.searching.use_hi_accel = False
+    cfg.searching.max_cands_to_fold = 2
+    cfg.check_sanity(create_dirs=True)
+    set_settings(cfg)
+
+    psr = synth.PulsarSpec(period_s=0.05, dm=20.0,
+                           snr_per_sample=1.5)
+    beams = []
+    for i in range(nbeams):
+        spec = synth.BeamSpec(nchan=nchan, nsamp=nsamp, nsblk=64,
+                              nbits=4, tsamp_s=5.24288e-4,
+                              scan=100 + i)
+        beams.append(synth.synth_beam(
+            os.path.join(base, f"data{i}"), spec, pulsars=[psr],
+            merged=True))
+
+    os.environ["TPULSAR_CACHE_DIR"] = os.path.join(base, "cache_gw")
+    _aot_cachedir.activate()
+    spool = os.path.join(base, "spool")
+    server = SearchServer(spool=spool, cfg=cfg, worker_id="w0",
+                          warm_boot=False, poll_s=0.05)
+    th = threading.Thread(target=server.serve, name="gw-bench-serve",
+                          daemon=True)
+    th.start()
+    # admission opens when the worker's heartbeat is fresh (the
+    # gateway 503s until then — exactly what a deployment sees)
+    deadline = time.time() + 60
+    while protocol.fleet_capacity(spool) is None \
+            and time.time() < deadline:
+        time.sleep(0.05)
+    gw = GatewayServer(queue=FilesystemSpoolQueue(spool),
+                       outdir_base=os.path.join(base, "out")).start()
+    _log(f"gateway {gw.url} over 1 warm worker; submitting "
+         f"{nbeams} beams over HTTP ...")
+
+    latency, failed, tickets = [], [], []
+    for i, fns in enumerate(beams):
+        rec = client.submit_beam(gw.url, fns, job_id=i)
+        res = client.wait_for_result(gw.url, rec["ticket"],
+                                     timeout_s=1200, poll_s=0.1)
+        tickets.append(rec["ticket"])
+        if res.get("status") != "done":
+            failed.append(rec["ticket"])
+            continue
+        evs = journal.read_events(spool, ticket=rec["ticket"])
+        t_recv = next(e["t"] for e in evs
+                      if e["event"] == "received")
+        t_term = next(e["t"] for e in evs
+                      if e["event"] == journal.TERMINAL_EVENT)
+        latency.append(round(t_term - t_recv, 3))
+        _log(f"beam {i}: submit->result {latency[-1]:.2f} s")
+
+    # the HTTP status hop vs reading the spool directly (what the
+    # PR 4-6 clients do) — the overhead the front door charges a
+    # poller per status check
+    tid = tickets[-1]
+    t0 = time.time()
+    for _ in range(status_reps):
+        client.ticket_status(gw.url, tid)
+    status_http_ms = round((time.time() - t0) / status_reps * 1e3, 3)
+    t0 = time.time()
+    for _ in range(status_reps):
+        protocol.read_result(spool, tid)
+    status_direct_ms = round((time.time() - t0) / status_reps * 1e3,
+                             3)
+
+    server.request_drain()
+    th.join(timeout=60)
+    gw.stop()
+
+    lat_sorted = sorted(latency)
+    warm = latency[1:]
+    result = {
+        "metric": "gateway_submit_to_result_latency",
+        "value": (round(statistics.median(lat_sorted), 3)
+                  if latency else -1.0),
+        "unit": "s",
+        "gateway": {
+            "nbeams": nbeams, "beams_done": len(latency),
+            "beams_failed": failed,
+            "submit_to_result_s": latency,
+            "submit_to_result_p50_s": (
+                round(fleetview._quantile(lat_sorted, 0.5), 3)
+                if latency else -1.0),
+            "submit_to_result_p95_s": (
+                round(fleetview._quantile(lat_sorted, 0.95), 3)
+                if latency else -1.0),
+            "submit_to_result_warm_s": (
+                round(statistics.median(warm), 3) if warm else -1.0),
+            "cold_first_beam_s": latency[0] if latency else -1.0,
+            "status_http_ms": status_http_ms,
+            "status_direct_ms": status_direct_ms,
+            "status_overhead_ms": round(
+                status_http_ms - status_direct_ms, 3),
+            "status_reps": status_reps,
+            "nchan": nchan, "nsamp": nsamp, "dm_max": dm_max,
+        },
+    }
+    _emit(result)
+    if os.environ.get("TPULSAR_GW_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _usable_cpus() -> list:
     """The CPU ids this process may actually run on, for taskset
     pinning (a cgroup cpuset need not start at 0 or be contiguous)."""
@@ -1568,6 +1714,9 @@ def main() -> None:
         return
     if "--fleet" in sys.argv:
         run_fleet()
+        return
+    if "--gateway" in sys.argv:
+        run_gateway()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

@@ -1,0 +1,465 @@
+"""Front-door tests: the TicketQueue backend CONTRACT (the PR-5
+exactly-once/attempts/quarantine invariants as backend-agnostic
+properties, run against the filesystem spool AND the in-memory
+backend), tenant priority/quota claim ordering, the short-TTL cached
+capacity probe, journal 'received' chain semantics, and federation
+routing on the -1 (load-shed) vs 0 (backpressure) distinction."""
+
+import json
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from tpulsar.frontdoor import federation, tenancy
+from tpulsar.frontdoor import queue as fq
+from tpulsar.obs import journal, telemetry
+from tpulsar.serve import protocol
+
+
+def _dead_pid() -> int:
+    p = subprocess.Popen(["true"])
+    p.wait()
+    return p.pid
+
+
+# --------------------------------------------------------------------
+# backend adapters: each knows how to build a queue and how to forge a
+# claim's recorded owner (the contract tests' crash simulation)
+# --------------------------------------------------------------------
+
+class _SpoolBackend:
+    name = "spool"
+
+    def make(self, tmp_path):
+        return fq.FilesystemSpoolQueue(str(tmp_path / "spool"))
+
+    def forge_claim_owner(self, q, tid, pid, worker=""):
+        path = protocol.ticket_path(q.spool, tid, "claimed")
+        rec = json.load(open(path))
+        rec["claimed_by"] = pid
+        if worker:
+            rec["claimed_by_worker"] = worker
+        protocol._atomic_write_json(path, rec)
+
+
+class _MemoryBackend:
+    name = "memory"
+
+    def make(self, tmp_path):
+        return fq.MemoryTicketQueue("contract-test")
+
+    def forge_claim_owner(self, q, tid, pid, worker=""):
+        with q._lock:
+            rec = q._states["claimed"][tid]
+            rec["claimed_by"] = pid
+            rec.pop("claimed_by_thread", None)
+            if worker:
+                rec["claimed_by_worker"] = worker
+
+
+@pytest.fixture(params=[_SpoolBackend(), _MemoryBackend()],
+                ids=["spool", "memory"])
+def backend(request):
+    return request.param
+
+
+@pytest.fixture()
+def q(backend, tmp_path):
+    return backend.make(tmp_path)
+
+
+# --------------------------------------------------------------------
+# the contract
+# --------------------------------------------------------------------
+
+def test_contract_claims_record_their_owner(q):
+    q.submit("t1", ["/a"], "/o", job_id=1)
+    rec = q.claim_next("w3")
+    assert rec["ticket"] == "t1"
+    assert rec["claimed_by"] == os.getpid()
+    assert rec["claimed_by_worker"] == "w3"
+    assert q.ticket_state("t1") == "claimed"
+    assert q.pending_count() == 0
+
+
+def test_contract_exactly_once_under_contention(q):
+    """The invariant the whole front door rests on, as a contract
+    property: N concurrent claimers on one queue, every ticket
+    claimed exactly once (same shape as the PR-5 multi-process test,
+    at thread granularity so both backends can run it)."""
+    tickets = [f"t{i:03d}" for i in range(24)]
+    for tid in tickets:
+        q.submit(tid, ["/x"], "/o", job_id=0)
+    got: dict[int, list] = {i: [] for i in range(4)}
+
+    def claimer(i):
+        while True:
+            rec = q.claim_next(f"w{i}")
+            if rec is None:
+                return
+            got[i].append(rec["ticket"])
+
+    threads = [threading.Thread(target=claimer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    claims = [t for lst in got.values() for t in lst]
+    assert sorted(claims) == sorted(tickets)       # none lost
+    assert len(claims) == len(set(claims))         # none doubled
+    assert q.pending_count() == 0
+
+
+def test_contract_crash_requeue_counts_attempts_then_quarantines(
+        q, backend):
+    """Dead-owner requeues strike the ticket; at the cap it is
+    quarantined with a terminal failed result (reason max_attempts)
+    and never claimable again — on every backend."""
+    q.submit("bad", ["/x"], "/o", job_id=1)
+    q.claim_next("w0")
+    backend.forge_claim_owner(q, "bad", _dead_pid(), "w0")
+    assert q.requeue_stale_claims(max_attempts=2) == ["bad"]
+    rec = q.read_ticket("bad")
+    assert rec["attempts"] == 1
+    assert "claimed_by" not in rec
+
+    q.claim_next("w1")
+    backend.forge_claim_owner(q, "bad", _dead_pid(), "w1")
+    assert q.requeue_stale_claims(max_attempts=2) == []
+    assert q.list_tickets("quarantine") == ["bad"]
+    result = q.read_result("bad")
+    assert result["status"] == "failed"
+    assert result["reason"] == "max_attempts"
+    assert result["attempts"] == 2
+    assert q.ticket_state("bad") == "done"
+    assert q.claim_next("w2") is None
+    # the journal tells the same story on both backends
+    evs = q.read_events(ticket="bad")
+    assert journal.validate_chain(evs) == [], evs
+    names = [e["event"] for e in evs]
+    assert names.count("takeover") == 1
+    assert "quarantined" in names
+
+
+def test_contract_live_owner_claims_are_not_stolen(q, backend):
+    q.submit("live", ["/x"], "/o", job_id=1)
+    q.claim_next("wa")
+    live = subprocess.Popen(["sleep", "5"])
+    try:
+        backend.forge_claim_owner(q, "live", live.pid, "wa")
+        assert q.requeue_stale_claims() == []
+        assert q.ticket_state("live") == "claimed"
+    finally:
+        live.kill()
+        live.wait()
+
+
+def test_contract_drain_requeue_is_attempt_neutral(q):
+    q.submit("t1", ["/x"], "/o", job_id=1)
+    q.claim_next("w0")
+    assert q.requeue_own_claims() == ["t1"]
+    rec = q.read_ticket("t1")
+    assert rec["attempts"] == 0
+    assert "claimed_by" not in rec
+    assert q.claim_next("w1")["ticket"] == "t1"
+
+
+def test_contract_result_durable_and_one_terminal_event(q):
+    q.submit("t1", ["/x"], "/odir", job_id=1)
+    rec = q.claim_next("w0")
+    q.write_result("t1", "done", outdir="/odir", worker="w0",
+                   attempts=rec.get("attempts", 0),
+                   trace_id=rec.get("trace_id", ""))
+    assert q.ticket_state("t1") == "done"
+    assert q.claimed_count() == 0
+    assert q.read_result("t1")["status"] == "done"
+    evs = q.read_events(ticket="t1")
+    assert journal.validate_chain(evs) == [], evs
+    terminals = [e for e in evs
+                 if e["event"] == journal.TERMINAL_EVENT]
+    assert len(terminals) == 1
+    # ONE trace id spans the chain
+    assert len({e["trace_id"] for e in evs
+                if e.get("trace_id")}) == 1
+
+
+def test_contract_cancel_only_while_pending(q):
+    q.submit("t1", ["/x"], "/o", job_id=1)
+    assert q.cancel("t1") is True
+    assert q.ticket_state("t1") == "unknown"
+    q.submit("t2", ["/x"], "/o", job_id=2)
+    q.claim_next("w0")
+    assert q.cancel("t2") is False
+    assert q.ticket_state("t2") == "claimed"
+
+
+def test_contract_capacity_shed_vs_backpressure(q):
+    """None = zero fresh workers (load-shed); 0 = fresh workers with
+    a full queue (backpressure) — the distinction federation and the
+    gateway's 503-vs-429 ride on."""
+    assert q.capacity() is None
+    q.heartbeat("w0", status="running", max_queue_depth=2)
+    assert q.capacity() == 2
+    q.submit("t1", ["/x"], "/o")
+    q.submit("t2", ["/y"], "/o")
+    assert q.capacity() == 0
+    q.heartbeat("w0", status="draining", max_queue_depth=2)
+    assert q.capacity() is None
+
+
+def test_contract_tenancy_priority_and_quota_in_claim_order(
+        q, backend):
+    """The acceptance property, per backend: a low-priority tenant AT
+    QUOTA with an older backlog never blocks (or even delays) a
+    high-priority tenant's claim; its beams resume as its in-flight
+    work finishes."""
+    policy = tenancy.TenantPolicy({
+        "bulk": {"priority": "low", "max_inflight": 1},
+        "ops": {"priority": "high"},
+    })
+    for i in range(3):
+        q.submit(f"b{i}", ["/x"], "/o", job_id=i, tenant="bulk")
+        time.sleep(0.002)
+    # bulk claims one beam: now at its in-flight quota
+    first = q.claim_next("w0", policy=policy)
+    assert first["ticket"] == "b0"
+    q.submit("o0", ["/y"], "/o", job_id=9, tenant="ops")
+    # the NEWEST ticket wins the next claim: ops is high priority and
+    # bulk (older backlog and all) is at quota
+    assert q.claim_next("w1", policy=policy)["ticket"] == "o0"
+    # bulk still at quota: its backlog is deferred, not claimable
+    assert q.claim_next("w2", policy=policy) is None
+    assert q.pending_count() == 2                 # ...but not dropped
+    # finishing bulk's in-flight beam frees its quota slot
+    q.write_result("b0", "done", outdir="/o", worker="w0",
+                   attempts=0)
+    assert q.claim_next("w2", policy=policy)["ticket"] == "b1"
+
+
+# --------------------------------------------------------------------
+# tenancy policy logic
+# --------------------------------------------------------------------
+
+def test_priority_resolution_and_cap():
+    policy = tenancy.TenantPolicy(
+        {"ops": {"priority": "high"}, "bulk": {"priority": 3}})
+    assert policy.spec("ops").priority == 20
+    assert policy.spec("bulk").priority == 3
+    assert policy.spec("nobody").priority == 10       # default class
+    # a ticket may ask DOWN, never up
+    assert policy.priority_of({"tenant": "bulk"}) == 3
+    assert policy.priority_of({"tenant": "ops",
+                               "priority": "low"}) == 0
+    assert policy.priority_of({"tenant": "bulk",
+                               "priority": "high"}) == 3
+    with pytest.raises(ValueError):
+        tenancy.resolve_priority("urgent")
+    with pytest.raises(ValueError):
+        tenancy.TenantPolicy({"x": {"prio": 1}})
+    with pytest.raises(ValueError):
+        tenancy.TenantPolicy({"x": {"priority": "urgent"}})
+
+
+def test_claim_order_budgets_quota_headroom_in_one_pass():
+    """One ordering pass must not hand N workers N beams of a tenant
+    whose quota allows only one more: headroom is consumed by the
+    tenant's own higher-ranked pending tickets."""
+    policy = tenancy.TenantPolicy(
+        {"bulk": {"priority": "low", "max_inflight": 2}})
+    pending = [{"ticket": f"b{i}", "tenant": "bulk",
+                "submitted_at": float(i)} for i in range(5)]
+    order = policy.claim_order(pending, {"bulk": 1})
+    assert order == ["b0"]                    # 2 - 1 in flight = 1
+    order = policy.claim_order(pending, {})
+    assert order == ["b0", "b1"]
+    deferred = telemetry.frontdoor_quota_deferred().value(
+        tenant="bulk")
+    assert deferred == 3
+
+
+def test_gateway_admission_quota():
+    policy = tenancy.TenantPolicy(
+        {"bulk": {"max_pending": 2}})
+    ok, _ = policy.admit("bulk", {"bulk": 1})
+    assert ok
+    ok, reason = policy.admit("bulk", {"bulk": 2})
+    assert not ok and "max_pending" in reason
+    ok, _ = policy.admit("other", {"bulk": 99})
+    assert ok                                 # quotas are per-tenant
+
+
+def test_inflight_by_tenant_counts_midclaim_sidefiles(tmp_path):
+    """A ticket between its two claim renames (.claiming side-file)
+    is neither pending nor a plain claim — the quota count must still
+    see it, or a concurrent worker's ordering pass overshoots
+    max_inflight through that window."""
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o", tenant="bulk")
+    src = protocol.ticket_path(spool, "t1", "incoming")
+    dst = protocol.ticket_path(spool, "t1", "claimed")
+    protocol._rename_held(src, f"{dst}.claiming.{os.getpid()}")
+    assert protocol.inflight_by_tenant(spool) == {"bulk": 1}
+    policy = tenancy.TenantPolicy(
+        {"bulk": {"max_inflight": 1}})
+    protocol.write_ticket(spool, "t2", ["/y"], "/o", tenant="bulk")
+    # bulk's quota slot is held by the mid-claim ticket
+    assert protocol.claim_next_ticket(spool, "w1",
+                                      policy=policy) is None
+
+
+# --------------------------------------------------------------------
+# the cached capacity probe (satellite: hot-loop fix)
+# --------------------------------------------------------------------
+
+def test_capacity_probe_caches_within_ttl(tmp_path, monkeypatch):
+    spool = str(tmp_path / "spool")
+    protocol.write_heartbeat(spool, worker_id="w0", status="running",
+                             max_queue_depth=4)
+    calls = []
+    real = protocol.fresh_workers
+
+    def counting(spool_, *a, **kw):
+        calls.append(spool_)
+        return real(spool_, *a, **kw)
+    monkeypatch.setattr(protocol, "fresh_workers", counting)
+    protocol._invalidate_capacity(spool)
+    assert protocol.fleet_capacity_cached(spool) == 4
+    assert protocol.fleet_capacity_cached(spool) == 4
+    assert len(calls) == 1                    # second read was cached
+    # a same-process write that changes the answer invalidates NOW
+    protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    assert protocol.fleet_capacity_cached(spool) == 3
+    assert len(calls) == 2
+    protocol.write_heartbeat(spool, worker_id="w1", status="running",
+                             max_queue_depth=2)
+    assert protocol.fleet_capacity_cached(spool) == 5
+    assert len(calls) == 3
+    # a different question (max_age_s) is never served from the cache
+    assert protocol.fleet_capacity_cached(spool, max_age_s=0.0) \
+        is None
+    assert len(calls) == 4
+    # ttl expiry re-reads even without an invalidating write
+    protocol._capacity_cache[spool] = (time.time() - 1.0,
+                                       protocol.HEARTBEAT_MAX_AGE_S,
+                                       8, 99)
+    assert protocol.fleet_capacity_cached(spool) == 5
+    assert len(calls) == 5
+
+
+# --------------------------------------------------------------------
+# journal: the gateway-edge 'received' head
+# --------------------------------------------------------------------
+
+def _ev(event, t, **kw):
+    return {"t": t, "event": event, **kw}
+
+
+def test_validate_chain_accepts_received_head():
+    chain = [
+        _ev("received", 1.0, ticket="t", trace_id="x"),
+        _ev("submitted", 1.1, ticket="t", attempt=0, trace_id="x"),
+        _ev("claimed", 3.1, ticket="t", attempt=0, worker="w0"),
+        _ev("result", 5.0, ticket="t", attempt=0, status="done"),
+    ]
+    assert journal.validate_chain(chain) == []
+    # received must be FOLLOWED by submitted
+    assert journal.validate_chain(chain[:1]) != []
+    assert journal.validate_chain([chain[0], chain[2],
+                                   chain[3]]) != []
+    # and a bare-submitted chain stays valid (no gateway involved)
+    assert journal.validate_chain(chain[1:]) == []
+
+
+def test_chain_summary_measures_queue_wait_from_http_arrival():
+    chain = [
+        _ev("received", 1.0, ticket="t", trace_id="x", tenant="ops"),
+        _ev("submitted", 1.5, ticket="t", attempt=0, trace_id="x"),
+        _ev("claimed", 3.0, ticket="t", attempt=0, worker="w0"),
+        _ev("result", 5.0, ticket="t", attempt=0, status="done"),
+    ]
+    digest = journal.chain_summary(chain)
+    assert digest["queue_wait_s"] == pytest.approx(2.0)   # from 1.0
+    assert digest["e2e_s"] == pytest.approx(4.0)
+    assert digest["tenant"] == "ops"
+    # without a gateway the spool write is the epoch, as before
+    digest = journal.chain_summary(chain[1:])
+    assert digest["queue_wait_s"] == pytest.approx(1.5)
+    assert digest["e2e_s"] == pytest.approx(3.5)
+
+
+# --------------------------------------------------------------------
+# federation routing
+# --------------------------------------------------------------------
+
+def _router(caps: dict, posts: list | None = None, fail: set = ()):
+    """A router over fake members: ``caps`` maps name -> capacity
+    reading served by the fake /v1/capacity; ``fail`` names members
+    whose POST raises."""
+    def fetch(url, timeout):
+        name = url.split("//")[1].split(".")[0]
+        return {"capacity": caps[name]}
+
+    def post(url, payload, timeout):
+        name = url.split("//")[1].split(".")[0]
+        if name in fail:
+            raise OSError(f"{name} down")
+        if posts is not None:
+            posts.append((name, payload))
+        return {"ticket": f"{name}-t1", "trace_id": "x"}
+    return federation.FederationRouter(
+        [(n, f"http://{n}.example") for n in caps],
+        fetch=fetch, post=post)
+
+
+def test_parse_members():
+    assert federation.parse_members(
+        "a=http://h1:1, b=http://h2:2/") == [
+            ("a", "http://h1:1"), ("b", "http://h2:2")]
+    assert federation.parse_members("http://h1:1")[0][1] \
+        == "http://h1:1"
+    with pytest.raises(ValueError):
+        federation.parse_members(" , ")
+
+
+def test_router_prefers_headroom_and_sheds_away_from_minus_one():
+    """The acceptance property: a host advertising -1 (load-shed) is
+    routed AROUND while capacity flows to the host with headroom."""
+    posts = []
+    router = _router({"a": -1, "b": 3, "c": 1}, posts)
+    host, resp = router.submit({"datafiles": ["/x"]})
+    assert host == "b" and resp["ticket"] == "b-t1"
+    # the cached reading was decremented; b still leads
+    assert router.submit({"datafiles": ["/y"]})[0] == "b"
+    assert [p[0] for p in posts] == ["b", "b"]
+    caps = {m.name: m.capacity for m in router.capacities()}
+    assert caps["a"] == -1 and caps["b"] == 1
+
+
+def test_router_all_saturated_is_backpressure_not_shed():
+    router = _router({"a": 0, "b": 0})
+    with pytest.raises(federation.AllSaturated):
+        router.choose()
+    router = _router({"a": -1, "b": -1})
+    with pytest.raises(federation.AllShedding):
+        router.choose()
+
+
+def test_router_fails_over_when_a_member_dies_mid_submit():
+    posts = []
+    router = _router({"a": 5, "b": 2}, posts, fail={"a"})
+    host, _ = router.submit({"datafiles": ["/x"]})
+    assert host == "b"
+    caps = {m.name: m.capacity for m in router.capacities()}
+    assert caps["a"] == -1                    # marked shedding
+    assert [p[0] for p in posts] == ["b"]
+
+
+def test_router_rotates_ties():
+    router = _router({"a": 4, "b": 4})
+    seen = {router.choose().name for _ in range(4)}
+    assert seen == {"a", "b"}

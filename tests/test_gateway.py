@@ -1,0 +1,410 @@
+"""HTTP gateway tests: submission round trips (trace id minted at
+the edge, 'received' journal head), admission semantics (quota 429 /
+backpressure 429 / load-shed 503), the status stream, the result
+store's candidate query, router mode over real sockets, and the
+`tpulsar submit` client command — all against live GatewayServers on
+ephemeral ports."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpulsar.frontdoor import client, federation, tenancy
+from tpulsar.frontdoor import queue as fq
+from tpulsar.frontdoor.gateway import GatewayServer
+from tpulsar.obs import journal
+
+
+# --------------------------------------------------------------------
+# harness: an in-memory queue, a worker thread, a live gateway
+# --------------------------------------------------------------------
+
+def _write_candlist(outdir, sigmas=(12.0, 6.5, 4.2)):
+    from tpulsar.io import accelcands
+    from tpulsar.search.sifting import Candidate
+    cands = [Candidate(r=100.0 + i, z=0.0, sigma=s, power=40.0,
+                       numharm=8, dm=20.0 + i, period_s=0.05,
+                       freq_hz=20.0, dm_hits=[(20.0 + i, s)])
+             for i, s in enumerate(sigmas)]
+    accelcands.write_candlist(
+        cands, os.path.join(outdir, "beam.accelcands"))
+
+
+class _Worker:
+    """A protocol-faithful worker thread: claims, 'searches' (writes
+    a candidate list), records the result."""
+
+    def __init__(self, q, worker_id="w0", beam_s=0.02,
+                 sigmas=(12.0, 6.5, 4.2), policy=None):
+        self.q, self.worker_id = q, worker_id
+        self.beam_s, self.sigmas = beam_s, sigmas
+        self.policy = policy
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        daemon=True)
+
+    def start(self):
+        self.q.heartbeat(self.worker_id, status="running",
+                         max_queue_depth=8)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            rec = self.q.claim_next(self.worker_id,
+                                    policy=self.policy)
+            if rec is None:
+                time.sleep(0.01)
+                continue
+            time.sleep(self.beam_s)
+            outdir = rec.get("outdir", "")
+            if outdir:
+                os.makedirs(outdir, exist_ok=True)
+                _write_candlist(outdir, self.sigmas)
+            self.q.write_result(
+                rec["ticket"], "done", rc=0, outdir=outdir,
+                worker=self.worker_id,
+                attempts=rec.get("attempts", 0),
+                trace_id=rec.get("trace_id", ""),
+                beam_seconds=self.beam_s)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
+@pytest.fixture()
+def q():
+    return fq.MemoryTicketQueue("gw-test")
+
+
+@pytest.fixture()
+def gw(q, tmp_path):
+    server = GatewayServer(
+        queue=q, outdir_base=str(tmp_path / "results"),
+        policy=tenancy.TenantPolicy(
+            {"capped": {"max_pending": 1}})).start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def worker(q):
+    w = _Worker(q).start()
+    yield w
+    w.stop()
+
+
+# --------------------------------------------------------------------
+# submission round trip
+# --------------------------------------------------------------------
+
+def test_submit_roundtrip_received_chain_and_result(gw, q, worker):
+    rec = client.submit_beam(gw.url, ["/data/a.fits"], tenant="ops")
+    assert rec["ticket"].startswith("gw-")
+    assert rec["trace_id"]
+    result = client.wait_for_result(gw.url, rec["ticket"],
+                                    timeout_s=30)
+    assert result["status"] == "done"
+    assert result["worker"] == "w0"
+    # the chain starts at the NETWORK EDGE and carries ONE trace id,
+    # the one minted by the gateway
+    evs = q.read_events(ticket=rec["ticket"])
+    assert journal.validate_chain(evs) == [], evs
+    assert evs[0]["event"] == "received"
+    assert evs[0]["tenant"] == "ops"
+    trace_ids = {e["trace_id"] for e in evs if e.get("trace_id")}
+    assert trace_ids == {rec["trace_id"]}
+    # queue-wait SLO epoch is the received event
+    status = client.ticket_status(gw.url, rec["ticket"])
+    assert status["state"] == "done"
+    chain = status["chain"]
+    assert chain["events"][0] == "received"
+    claimed = next(e for e in evs if e["event"] == "claimed")
+    assert chain["queue_wait_s"] == pytest.approx(
+        claimed["t"] - evs[0]["t"], abs=0.05)
+
+
+def test_submit_validates_request(gw):
+    with pytest.raises(client.ClientError) as ei:
+        client.submit_beam(gw.url, [])
+    assert ei.value.code == 400
+    req = urllib.request.Request(
+        gw.url + "/v1/beams", data=b"not json",
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei2:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei2.value.code == 400
+
+
+def test_unknown_ticket_404(gw):
+    with pytest.raises(client.ClientError) as ei:
+        client.ticket_status(gw.url, "nope")
+    assert ei.value.code == 404
+
+
+# --------------------------------------------------------------------
+# admission: load-shed vs backpressure vs quota
+# --------------------------------------------------------------------
+
+def test_load_shed_503_with_zero_fresh_workers(gw):
+    with pytest.raises(client.ClientError) as ei:
+        client.submit_beam(gw.url, ["/data/a.fits"])
+    assert ei.value.code == 503
+    assert ei.value.payload["capacity"] == -1
+    cap = client.capacity(gw.url)
+    assert cap["capacity"] == -1 and cap["fresh_workers"] == 0
+
+
+def test_backpressure_429_when_queue_full(gw, q):
+    q.heartbeat("w0", status="running", max_queue_depth=1)
+    client.submit_beam(gw.url, ["/data/a.fits"])      # fills depth 1
+    with pytest.raises(client.ClientError) as ei:
+        client.submit_beam(gw.url, ["/data/b.fits"])
+    assert ei.value.code == 429
+    assert ei.value.payload["capacity"] == 0
+    assert ei.value.retry_after_s is not None
+    assert client.capacity(gw.url)["capacity"] == 0
+
+
+def test_tenant_max_pending_quota_429(gw, q):
+    q.heartbeat("w0", status="running", max_queue_depth=8)
+    client.submit_beam(gw.url, ["/a"], tenant="capped")
+    with pytest.raises(client.ClientError) as ei:
+        client.submit_beam(gw.url, ["/b"], tenant="capped")
+    assert ei.value.code == 429
+    assert "max_pending" in ei.value.payload["error"]
+    # the quota is per-tenant: others are unaffected
+    assert client.submit_beam(gw.url, ["/c"],
+                              tenant="other")["ticket"]
+
+
+# --------------------------------------------------------------------
+# status streaming + result store
+# --------------------------------------------------------------------
+
+def test_events_stream_follows_to_terminal(gw, q, worker):
+    rec = client.submit_beam(gw.url, ["/data/a.fits"])
+    events = list(client.stream_events(gw.url, rec["ticket"],
+                                       timeout_s=30))
+    names = [e["event"] for e in events]
+    assert names[0] == "received"
+    assert names[-1] == journal.TERMINAL_EVENT
+    # the non-follow spelling returns the full chain too
+    evs = client.ticket_events(gw.url, rec["ticket"])
+    assert [e["event"] for e in evs] == names
+
+
+def test_result_store_candidate_query_roundtrip(gw, q, worker):
+    recs = [client.submit_beam(gw.url, [f"/data/{i}.fits"])
+            for i in range(2)]
+    for rec in recs:
+        client.wait_for_result(gw.url, rec["ticket"], timeout_s=30)
+    # per-ticket result carries parsed candidates
+    res = client.result(gw.url, recs[0]["ticket"])
+    assert [c["sigma"] for c in res["candidates"]] \
+        == [12.0, 6.5, 4.2]
+    assert res["candidates"][0]["dm"] == 20.0
+    # the query API filters, sorts strongest-first, and reports the
+    # pre-truncation total
+    out = client.query_candidates(gw.url, min_sigma=6.0)
+    assert out["total"] == 4 and out["returned"] == 4
+    assert [c["sigma"] for c in out["candidates"]] \
+        == [12.0, 12.0, 6.5, 6.5]
+    assert {c["ticket"] for c in out["candidates"]} \
+        == {r["ticket"] for r in recs}
+    out = client.query_candidates(gw.url, min_sigma=6.0, limit=3)
+    assert out["total"] == 4 and out["returned"] == 3
+    out = client.query_candidates(gw.url,
+                                  ticket=recs[1]["ticket"])
+    assert out["total"] == 3
+    # no result yet -> 404 with the ticket's state
+    with pytest.raises(client.ClientError) as ei:
+        client.result(gw.url, "nope")
+    assert ei.value.code == 404
+
+
+def test_metrics_endpoint_exports_gateway_series(gw, q, worker):
+    rec = client.submit_beam(gw.url, ["/data/a.fits"])
+    client.wait_for_result(gw.url, rec["ticket"], timeout_s=30)
+    with urllib.request.urlopen(gw.url + "/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    assert "tpulsar_gateway_requests_total" in text
+    assert 'route="submit"' in text
+    assert ('tpulsar_gateway_submissions_total{'
+            'tenant="default",outcome="accepted"}') in text
+
+
+def test_events_follow_unknown_ticket_404s_immediately(gw):
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            gw.url + "/v1/tickets/nope/events?follow=1&timeout_s=30",
+            timeout=10)
+    assert ei.value.code == 404
+    assert time.time() - t0 < 5.0        # no held-open stream
+
+
+def test_submission_metric_clamps_unknown_tenants(gw, q, worker):
+    from tpulsar.obs import telemetry
+    counter = telemetry.gateway_submissions_total()
+    before = counter.value(tenant="other", outcome="accepted")
+    for i in range(3):
+        client.submit_beam(gw.url, [f"/data/{i}.fits"],
+                           tenant=f"rando-{i}")
+    # every unconfigured tenant collapsed into ONE bounded series
+    assert counter.value(tenant="other",
+                         outcome="accepted") == before + 3
+    with urllib.request.urlopen(gw.url + "/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    assert "rando-" not in text
+
+
+def test_healthz(gw):
+    with urllib.request.urlopen(gw.url + "/healthz",
+                                timeout=10) as resp:
+        assert json.loads(resp.read())["ok"] is True
+
+
+# --------------------------------------------------------------------
+# filesystem-backend gateway (the journal is a real file)
+# --------------------------------------------------------------------
+
+def test_fs_spool_gateway_received_lands_in_journal(tmp_path):
+    q = fq.FilesystemSpoolQueue(str(tmp_path / "spool"))
+    gw = GatewayServer(queue=q,
+                       outdir_base=str(tmp_path / "res")).start()
+    w = _Worker(q).start()
+    try:
+        rec = client.submit_beam(gw.url, ["/data/a.fits"])
+        result = client.wait_for_result(gw.url, rec["ticket"],
+                                        timeout_s=30)
+        assert result["status"] == "done"
+        evs = journal.read_events(str(tmp_path / "spool"),
+                                  ticket=rec["ticket"])
+        assert journal.validate_chain(evs) == [], evs
+        assert evs[0]["event"] == "received"
+        assert evs[0]["trace_id"] == rec["trace_id"]
+        digest = journal.chain_summary(evs)
+        assert digest["queue_wait_s"] >= 0.0
+    finally:
+        w.stop()
+        gw.stop()
+
+
+# --------------------------------------------------------------------
+# router mode (federation over real sockets)
+# --------------------------------------------------------------------
+
+def test_router_mode_routes_submissions_to_live_member(tmp_path):
+    qa = fq.MemoryTicketQueue("member-a")
+    member = GatewayServer(
+        queue=qa, outdir_base=str(tmp_path / "res")).start()
+    wa = _Worker(qa).start()
+    router = GatewayServer(router=federation.FederationRouter(
+        [("a", member.url),
+         ("dead", "http://127.0.0.1:1")],         # unreachable: shed
+        poll_timeout_s=1.0)).start()
+    try:
+        cap = client.capacity(router.url)
+        assert cap["role"] == "router"
+        assert cap["members"]["dead"] == -1
+        assert cap["capacity"] == cap["members"]["a"] > 0
+        rec = client.submit_beam(router.url, ["/data/a.fits"])
+        assert rec["host"] == "a"
+        # the ticket lives on the member; the router says so
+        with pytest.raises(client.ClientError) as ei:
+            client.ticket_status(router.url, rec["ticket"])
+        assert ei.value.code == 404
+        result = client.wait_for_result(member.url, rec["ticket"],
+                                        timeout_s=30)
+        assert result["status"] == "done"
+    finally:
+        router.stop()
+        wa.stop()
+        member.stop()
+
+
+def test_router_mirrors_member_refusal_class(tmp_path):
+    """A member's 429 admission refusal must survive the router hop
+    as a retryable 429 (with Retry-After), never collapse into a
+    hard 502 — the client retry contract crosses federation."""
+    qa = fq.MemoryTicketQueue("member-c")
+    qa.heartbeat("w0", status="running", max_queue_depth=8)
+    member = GatewayServer(
+        queue=qa, outdir_base=str(tmp_path / "res"),
+        policy=tenancy.TenantPolicy(
+            {"capped": {"max_pending": 1}})).start()
+    router = GatewayServer(router=federation.FederationRouter(
+        [("a", member.url)], poll_timeout_s=2.0)).start()
+    try:
+        # fill the tenant's pending quota directly on the member
+        client.submit_beam(member.url, ["/a"], tenant="capped")
+        with pytest.raises(client.ClientError) as ei:
+            client.submit_beam(router.url, ["/b"], tenant="capped")
+        assert ei.value.code == 429
+        assert "max_pending" in ei.value.payload["error"]
+        assert ei.value.retry_after_s is not None
+    finally:
+        router.stop()
+        member.stop()
+
+
+def test_candidate_query_clamps_negative_limit(gw, q, worker):
+    rec = client.submit_beam(gw.url, ["/data/a.fits"])
+    client.wait_for_result(gw.url, rec["ticket"], timeout_s=30)
+    out = client.query_candidates(gw.url, limit=-5)
+    assert out["returned"] == 0 and out["candidates"] == []
+    assert out["total"] == 3
+
+
+def test_router_mode_all_members_shedding_is_503(tmp_path):
+    qa = fq.MemoryTicketQueue("member-b")     # no fresh workers
+    member = GatewayServer(
+        queue=qa, outdir_base=str(tmp_path / "res")).start()
+    router = GatewayServer(router=federation.FederationRouter(
+        [("a", member.url)], poll_timeout_s=1.0)).start()
+    try:
+        assert client.capacity(router.url)["capacity"] == -1
+        with pytest.raises(client.ClientError) as ei:
+            client.submit_beam(router.url, ["/data/a.fits"])
+        assert ei.value.code == 503
+    finally:
+        router.stop()
+        member.stop()
+
+
+# --------------------------------------------------------------------
+# the CLI client
+# --------------------------------------------------------------------
+
+def test_cli_submit_wait_roundtrip(gw, q, worker, tmp_path, capsys):
+    from tpulsar.cli.main import main as cli_main
+    rc = cli_main(["submit", str(tmp_path / "beam.fits"),
+                   "--gateway", gw.url, "--wait", "--timeout", "30"])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["ticket"].startswith("gw-")
+    assert lines[1]["status"] == "done"
+
+
+def test_cli_submit_load_shed_rc3(tmp_path, capsys):
+    from tpulsar.cli.main import main as cli_main
+    q = fq.MemoryTicketQueue("shed")          # zero fresh workers
+    gw = GatewayServer(queue=q,
+                       outdir_base=str(tmp_path / "res")).start()
+    try:
+        rc = cli_main(["submit", str(tmp_path / "beam.fits"),
+                       "--gateway", gw.url])
+        assert rc == 3
+        err = json.loads(capsys.readouterr().err.strip())
+        assert err["code"] == 503
+    finally:
+        gw.stop()

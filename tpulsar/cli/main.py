@@ -327,6 +327,88 @@ def cmd_fleet(args):
     return rc
 
 
+def cmd_gateway(args):
+    """The network front door (tpulsar/frontdoor/): an HTTP gateway
+    accepting beam submissions (trace id minted at the edge),
+    streaming per-ticket status from the journal, and serving the
+    result store's candidate query API — or, with federation members
+    configured, a router load-balancing submissions across hosts by
+    advertised capacity."""
+    import signal
+    import threading
+
+    from tpulsar.config import settings
+    from tpulsar.frontdoor.federation import FederationRouter
+    from tpulsar.frontdoor.gateway import GatewayServer
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    from tpulsar.frontdoor.tenancy import TenantPolicy
+
+    cfg = settings()
+    fd = cfg.frontdoor
+    host = args.host or fd.gateway_host
+    port = args.port if args.port is not None else fd.gateway_port
+    policy = TenantPolicy.from_config(cfg)
+    federate = args.federate or fd.federate
+    if federate:
+        gw = GatewayServer(router=FederationRouter(federate),
+                           policy=policy, host=host, port=port)
+        role = f"router over {federate}"
+    else:
+        queue = get_ticket_queue(args.queue or _serve_spool(cfg))
+        gw = GatewayServer(
+            queue=queue, policy=policy, host=host, port=port,
+            outdir_base=args.outdir_base or os.path.join(
+                cfg.processing.base_results_directory, "gateway"),
+            default_depth=cfg.jobpooler.serve_queue_depth,
+            query_limit=fd.results_query_limit)
+        role = f"front of {queue!r}"
+    gw.start()
+    print(f"gateway: {gw.url} ({role})", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *a: stop.set())
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        gw.stop()
+        _export_metrics("gateway")
+    print("gateway: stopped")
+    return 0
+
+
+def cmd_submit(args):
+    """Submit a beam over HTTP to a front-door gateway (and with
+    --wait, poll until its terminal result).  Exit codes: 0 done or
+    skipped, 1 failed, 2 refused (quota/backpressure — retryable),
+    3 load-shed (submit to another host)."""
+    import json
+
+    from tpulsar.frontdoor import client
+
+    files = [os.path.abspath(f) for f in args.files]
+    try:
+        rec = client.submit_beam(
+            args.gateway, files, outdir=args.outdir,
+            tenant=args.tenant, priority=args.priority,
+            job_id=args.job_id)
+    except client.ClientError as e:
+        print(json.dumps({"code": e.code, **e.payload}),
+              file=sys.stderr)
+        return 3 if e.code == 503 else 2 if e.code == 429 else 1
+    print(json.dumps(rec))
+    if not args.wait:
+        return 0
+    try:
+        result = client.wait_for_result(args.gateway, rec["ticket"],
+                                        timeout_s=args.timeout)
+    except TimeoutError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(json.dumps(result))
+    return 0 if result.get("status") in ("done", "skipped") else 1
+
+
 def cmd_status(args):
     t = _tracker(args)
     print("=== tpulsar status ===")
@@ -1073,6 +1155,51 @@ def build_parser() -> argparse.ArgumentParser:
                          "worker (repeatable), e.g. "
                          "--worker-arg=--no-warmstart")
     sp.set_defaults(fn=cmd_fleet)
+
+    sp = sub.add_parser(
+        "gateway",
+        help="network front door: HTTP beam submission + status "
+             "streaming + candidate query API over a ticket queue — "
+             "or a federation router over member gateways "
+             "(--federate / frontdoor.federate)")
+    sp.add_argument("--host", default=None,
+                    help="bind address (default: "
+                         "frontdoor.gateway_host)")
+    sp.add_argument("--port", type=int, default=None,
+                    help="bind port (default: frontdoor.gateway_port;"
+                         " 0 = ephemeral, printed at boot)")
+    sp.add_argument("--spool", "--queue", dest="queue", default=None,
+                    help="ticket queue: a spool dir (default: the "
+                         "serve spool) or memory:<name>")
+    sp.add_argument("--federate", default=None, metavar="N=URL,...",
+                    help="run as a federation ROUTER over these "
+                         "member gateways instead of fronting a "
+                         "local queue")
+    sp.add_argument("--outdir-base", default=None,
+                    help="results dir root for submissions that "
+                         "name no outdir (default: "
+                         "<base_results_directory>/gateway)")
+    sp.set_defaults(fn=cmd_gateway)
+
+    sp = sub.add_parser(
+        "submit",
+        help="submit a beam over HTTP to a front-door gateway")
+    sp.add_argument("files", nargs="+", help="beam data files")
+    sp.add_argument("--gateway", default="http://127.0.0.1:8970",
+                    metavar="URL")
+    sp.add_argument("--outdir", default=None,
+                    help="results dir (default: gateway derives one)")
+    sp.add_argument("--tenant", default="")
+    sp.add_argument("--priority", default=None,
+                    help="low|normal|high or an integer (capped at "
+                         "the tenant's class)")
+    sp.add_argument("--job-id", type=int, default=None)
+    sp.add_argument("--wait", action="store_true",
+                    help="poll until the terminal result and exit "
+                         "by its status")
+    sp.add_argument("--timeout", type=float, default=600.0,
+                    help="--wait timeout seconds")
+    sp.set_defaults(fn=cmd_submit)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
 

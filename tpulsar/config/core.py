@@ -104,6 +104,27 @@ class JobPoolerConfig:
 
 
 @dataclasses.dataclass
+class FrontdoorConfig:
+    """The network front door (tpulsar/frontdoor/): HTTP gateway,
+    tenant admission policy, federation membership."""
+    gateway_host: str = "127.0.0.1"        # bind address; 0.0.0.0 to
+    #                                        serve beyond localhost
+    gateway_port: int = 8970
+    #: tenant name -> {"priority": "low|normal|high"|int,
+    #:                 "max_inflight": N, "max_pending": N}
+    #: (0 = unlimited); unknown tenants get default_priority and no
+    #: quotas.  Enforced in claim ordering (max_inflight) and at
+    #: gateway admission (max_pending).
+    tenants: dict = dataclasses.field(default_factory=dict)
+    default_priority: str = "normal"
+    #: comma-separated "name=url" member gateways; non-empty turns
+    #: `tpulsar gateway` into a federation router over these hosts
+    federate: str = ""
+    #: cap on candidate rows per result-store query response
+    results_query_limit: int = 200
+
+
+@dataclasses.dataclass
 class SearchingConfig:
     use_hi_accel: bool = True
     lo_accel_numharm: int = 16
@@ -165,6 +186,8 @@ class TpulsarConfig:
         default_factory=ProcessingConfig)
     jobpooler: JobPoolerConfig = dataclasses.field(
         default_factory=JobPoolerConfig)
+    frontdoor: FrontdoorConfig = dataclasses.field(
+        default_factory=FrontdoorConfig)
     searching: SearchingConfig = dataclasses.field(
         default_factory=SearchingConfig)
     email: EmailConfig = dataclasses.field(default_factory=EmailConfig)
@@ -250,6 +273,17 @@ class TpulsarConfig:
             problems.append("email.enabled but email.recipient empty")
         if self.searching.nsub < 1:
             problems.append("searching.nsub must be >= 1")
+        if not (0 <= self.frontdoor.gateway_port <= 65535):
+            problems.append("frontdoor.gateway_port out of range")
+        if self.frontdoor.results_query_limit < 1:
+            problems.append(
+                "frontdoor.results_query_limit must be >= 1")
+        try:
+            from tpulsar.frontdoor.tenancy import TenantPolicy
+            TenantPolicy(self.frontdoor.tenants,
+                         self.frontdoor.default_priority)
+        except ValueError as e:
+            problems.append(f"frontdoor.tenants: {e}")
 
         if problems:
             raise InsaneConfigsError(problems)

@@ -299,8 +299,10 @@ class FleetController:
         for st in ("fresh", "stale", "dead"):
             telemetry.fleet_workers().set(
                 sum(1 for s in states.values() if s == st), state=st)
-        cap = protocol.fleet_capacity(self.spool,
-                                      self.heartbeat_max_age_s)
+        # cached probe: _aggregate runs every poll second and the raw
+        # capacity read re-stats every heartbeat + the pending listing
+        cap = protocol.fleet_capacity_cached(self.spool,
+                                             self.heartbeat_max_age_s)
         # -1 = ZERO fresh workers (clients load-shed); 0 = fresh
         # workers but a full queue (backpressure) — a dashboard must
         # be able to tell a down fleet from a busy one

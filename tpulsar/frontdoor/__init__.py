@@ -1,0 +1,32 @@
+"""The network front door: a service layer above the fleet.
+
+PRs 4-6 built a single-filesystem serving stack — spool protocol,
+warm workers, fleet supervision, lifecycle journal.  This package is
+the layer that turns that stack into a *service* (ROADMAP open item:
+"millions of users need a service, not a directory"):
+
+  * ``queue``      — the pluggable TicketQueue interface.  The PR-5
+                     filesystem spool is the reference backend; an
+                     in-memory backend serves tests and embedded use.
+                     The exactly-once claim semantics are contract
+                     guarantees, not filesystem accidents.
+  * ``tenancy``    — per-tenant priority classes and in-flight quotas
+                     enforced in claim ordering (a saturated tenant
+                     cannot starve others).
+  * ``gateway``    — a stdlib-only HTTP gateway: beam submission
+                     (trace_id minted at the network edge), per-ticket
+                     status streaming from the journal, the result
+                     store's candidate query API, and capacity
+                     advertisement for federation.
+  * ``results``    — the result store: candidate lists parsed from
+                     done tickets' result directories, queryable.
+  * ``federation`` — a router load-balancing submissions across
+                     member hosts by advertised capacity, honouring
+                     the PR-5 load-shed (-1) vs backpressure (0)
+                     distinction.
+  * ``client``     — a tiny urllib client for the gateway API (used
+                     by ``tpulsar submit``, CI smoke, and bench).
+
+Processes here never import jax: the gateway and router are pure
+control plane and run happily on hosts with no accelerator.
+"""

@@ -1,0 +1,546 @@
+"""The HTTP gateway: beam submission and results over the network.
+
+stdlib-only (``http.server``): the gateway is pure control plane — it
+writes tickets, reads the journal and the result store, and never
+imports jax.  One ThreadingHTTPServer thread per request; every
+mutation lands in the TicketQueue, so N gateway processes over one
+spool are as safe as N workers are.
+
+API (all JSON):
+
+    POST /v1/beams                submit a beam
+        {"datafiles": [...], "outdir"?: str, "job_id"?: int,
+         "tenant"?: str, "priority"?: "low|normal|high"|int}
+        -> 201 {"ticket", "trace_id", "tenant", "priority", "outdir",
+                "status_url"}      (trace_id minted HERE, at the edge)
+        -> 400 invalid  | 429 tenant quota or fleet backpressure
+        (Retry-After set) | 503 load-shed (zero fresh workers)
+    GET /v1/tickets/<id>          lifecycle status (state + the
+                                  journal chain summary + result)
+    GET /v1/tickets/<id>/events   the journal chain; ``?follow=1``
+                                  streams NDJSON until the terminal
+                                  event (or ``timeout_s``)
+    GET /v1/results/<id>          terminal record + parsed candidates
+    GET /v1/candidates            result-store query
+        ?ticket=&min_sigma=&limit=
+    GET /v1/capacity              admission headroom: >0 accepting,
+                                  0 backpressure, -1 load-shed (the
+                                  federation router's poll target)
+    GET /healthz                  liveness
+    GET /metrics                  this gateway's registry (Prometheus
+                                  text)
+
+Admission at the edge mirrors the warm backend's semantics: capacity
+None (zero fresh workers) is a 503 load-shed — nothing will drain the
+queue, the client must go elsewhere (a federation router does this
+automatically); capacity 0 with fresh workers is a 429 backpressure —
+the queue will drain, retry.  Tenant ``max_pending`` quotas are
+refused here too (429), before the spool ever sees the ticket.
+
+In ROUTER mode (``router=`` set) the gateway owns no queue:
+``POST /v1/beams`` load-balances to member gateways by advertised
+capacity and ``/v1/capacity`` aggregates the members' headroom, so
+routers stack (a global router over regional routers over hosts).
+
+The trace_id is minted at the network edge: the ``received`` journal
+event carries it, ``write_ticket`` reuses it (never re-mints), and
+every span/journal event downstream joins on it — so a beam's
+timeline starts at HTTP arrival, and queue-wait SLOs include the
+gateway hop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpulsar.frontdoor import federation, results, tenancy
+from tpulsar.obs import journal as journal_mod
+from tpulsar.obs import metrics, telemetry
+from tpulsar.serve import protocol
+
+#: ``?follow=1`` event streams give up after this long without a
+#: terminal event (clients re-attach; a gateway must not accumulate
+#: immortal streaming threads)
+STREAM_TIMEOUT_S = 600.0
+STREAM_POLL_S = 0.25
+
+
+class GatewayError(Exception):
+    def __init__(self, code: int, message: str, **extra):
+        super().__init__(message)
+        self.code = code
+        self.payload = {"error": message, **extra}
+
+
+class GatewayServer:
+    """One gateway: a TicketQueue front (or, with ``router=``, a
+    federation front).  ``port=0`` binds an ephemeral port
+    (``.port`` after ``start()``)."""
+
+    def __init__(self, queue=None, *, router=None,
+                 policy: tenancy.TenantPolicy | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 outdir_base: str | None = None,
+                 max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S,
+                 default_depth: int = 8,
+                 query_limit: int = 200, logger=None):
+        if (queue is None) == (router is None):
+            raise ValueError(
+                "exactly one of queue= (gateway mode) or router= "
+                "(router mode) is required")
+        self.queue = queue
+        self.router = router
+        self.policy = policy or tenancy.TenantPolicy()
+        self.outdir_base = outdir_base
+        self.max_age_s = max_age_s
+        self.default_depth = default_depth
+        self.query_limit = query_limit
+        if logger is None:
+            from tpulsar.obs.log import get_logger
+            logger = get_logger("frontdoor.gateway")
+        self.log = logger
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        #: serializes admission-check + ticket write: handler threads
+        #: racing the same pending_by_tenant()/capacity() snapshot
+        #: would otherwise all pass a quota with one slot left (the
+        #: claim side budgets its headroom in one pass for the same
+        #: reason).  The guarded section is the capacity probe
+        #: (cached, short-TTL), one spool write, and — only for
+        #: tenants with a max_pending quota — the pending-backlog
+        #: parse that quota is defined over
+        self._admit_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          _make_handler(self))
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "GatewayServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="gateway-http",
+            daemon=True)
+        self._thread.start()
+        self.log.info("gateway listening on %s (%s)", self.url,
+                      "router" if self.router else
+                      f"queue {self.queue!r}")
+        return self
+
+    def serve_forever(self) -> None:
+        self.log.info("gateway listening on %s", self.url)
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------- helpers
+
+    def _next_ticket_id(self) -> str:
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        return (f"gw-{os.getpid()}-{seq}-"
+                f"{int(time.time() * 1000) % 100000}")
+
+    # -------------------------------------------------------------- routes
+
+    def handle_submit(self, payload: dict) -> tuple[int, dict]:
+        if self.router is not None:
+            return self._route_submit(payload)
+        datafiles = payload.get("datafiles")
+        if (not isinstance(datafiles, list) or not datafiles
+                or not all(isinstance(f, str) and f
+                           for f in datafiles)):
+            self._count_submission(payload, "invalid")
+            raise GatewayError(
+                400, "datafiles must be a non-empty list of paths")
+        tenant = str(payload.get("tenant", "")
+                     or tenancy.DEFAULT_TENANT)
+        ticket_id = self._next_ticket_id()
+        outdir = payload.get("outdir") or (
+            os.path.join(self.outdir_base, ticket_id)
+            if self.outdir_base else "")
+        if not outdir:
+            self._count_submission(payload, "invalid")
+            raise GatewayError(
+                400, "no outdir in the request and the gateway has "
+                     "no --outdir-base to derive one")
+        priority = self.policy.priority_of(
+            {"tenant": tenant, "priority": payload.get("priority")})
+        with self._admit_lock:
+            # pending_by_tenant is an O(backlog) parse on the spool
+            # backend: only pay it when a max_pending quota actually
+            # applies to THIS tenant (the claim side short-circuits
+            # trivial policies for the same reason)
+            if self.policy.spec(tenant).max_pending > 0:
+                ok, reason = self.policy.admit(
+                    tenant, self.queue.pending_by_tenant())
+                if not ok:
+                    self._count_submission(payload, "quota")
+                    raise GatewayError(429, reason,
+                                       retry_after_s=5.0)
+            cap = self.queue.capacity(self.max_age_s,
+                                      self.default_depth)
+            if cap is None:
+                self._count_submission(payload, "load_shed")
+                raise GatewayError(
+                    503, "load-shed: zero fresh workers on this "
+                         "host — nothing will drain the queue; "
+                         "submit elsewhere",
+                    capacity=-1)
+            if cap <= 0:
+                self._count_submission(payload, "backpressure")
+                raise GatewayError(
+                    429, "backpressure: the fleet queue is full; "
+                         "retry",
+                    capacity=0, retry_after_s=5.0)
+            # the trace id is minted HERE — the network edge is the
+            # start of the beam's observable life, and the
+            # 'received' event is journaled before the ticket exists
+            # so queue-wait measures from HTTP arrival (a crash
+            # between the two leaves an in-flight chain with no
+            # ticket: honest, and harmless)
+            trace_id = uuid.uuid4().hex[:16]
+            self.queue.record_event("received", ticket=ticket_id,
+                                    trace_id=trace_id, tenant=tenant,
+                                    priority=priority)
+            self.queue.submit(
+                ticket_id, datafiles, outdir,
+                job_id=payload.get("job_id"), trace_id=trace_id,
+                tenant=tenant, priority=priority,
+                submitted_via="gateway")
+        self._count_submission({"tenant": tenant}, "accepted")
+        return 201, {"ticket": ticket_id, "trace_id": trace_id,
+                     "tenant": tenant, "priority": priority,
+                     "outdir": outdir,
+                     "status_url": f"/v1/tickets/{ticket_id}"}
+
+    def _route_submit(self, payload: dict) -> tuple[int, dict]:
+        import urllib.error
+
+        tenant = str(payload.get("tenant", "")
+                     or tenancy.DEFAULT_TENANT)
+        try:
+            host, resp = self.router.submit(payload)
+        except federation.AllSaturated as e:
+            self._count_submission({"tenant": tenant},
+                                   "backpressure")
+            raise GatewayError(429, str(e), retry_after_s=5.0)
+        except federation.AllShedding as e:
+            self._count_submission({"tenant": tenant}, "load_shed")
+            raise GatewayError(503, str(e))
+        except urllib.error.HTTPError as e:
+            # a member ANSWERED with an admission refusal and no
+            # other member took the beam: mirror the member's class
+            # so the client's retry contract survives the hop (a 429
+            # quota/backpressure refusal must stay retryable, never
+            # become a hard 502)
+            try:
+                body = json.loads(e.read().decode() or "{}")
+            except (ValueError, OSError):
+                body = {"error": str(e)}
+            outcome = {429: "backpressure" if "capacity" in body
+                       else "quota",
+                       503: "load_shed"}.get(e.code, "error")
+            self._count_submission({"tenant": tenant}, outcome)
+            if e.code == 429:
+                body.setdefault("retry_after_s", 5.0)
+            raise GatewayError(e.code,
+                               body.get("error", str(e)), **{
+                                   k: v for k, v in body.items()
+                                   if k != "error"})
+        except Exception as e:            # noqa: BLE001 — transport
+            # failures on every member (the router already shed away
+            # from each as it failed)
+            self._count_submission({"tenant": tenant}, "error")
+            raise GatewayError(502, f"every member failed: {e}")
+        self._count_submission({"tenant": tenant}, "routed")
+        return 201, {**resp, "host": host}
+
+    def _count_submission(self, payload: dict, outcome: str) -> None:
+        # the label set must be BOUNDED: the tenant string is
+        # client-supplied (and counted even on refused/invalid
+        # requests), so anything outside the configured tenant table
+        # collapses to one 'other' series instead of minting a new
+        # metric series per request
+        tenant = str(payload.get("tenant", "")
+                     or tenancy.DEFAULT_TENANT)
+        if tenant != tenancy.DEFAULT_TENANT \
+                and tenant not in self.policy.tenants:
+            tenant = "other"
+        telemetry.gateway_submissions_total().inc(
+            tenant=tenant, outcome=outcome)
+
+    def handle_ticket_status(self, ticket: str) -> tuple[int, dict]:
+        self._require_queue()
+        state = self.queue.ticket_state(ticket)
+        events = self.queue.read_events(ticket=ticket)
+        if state == "unknown" and not events:
+            raise GatewayError(404, f"unknown ticket {ticket!r}")
+        out = {"ticket": ticket, "state": state,
+               "result": self.queue.read_result(ticket)}
+        if events:
+            out["chain"] = journal_mod.chain_summary(events)
+        return 200, out
+
+    def handle_events(self, ticket: str) -> tuple[int, dict]:
+        self._require_queue()
+        events = self.queue.read_events(ticket=ticket)
+        if not events:
+            raise GatewayError(
+                404, f"no journal events for ticket {ticket!r}")
+        return 200, {"ticket": ticket, "events": events}
+
+    def iter_events_follow(self, ticket: str, timeout_s: float):
+        """Yield journal events for one ticket as they land, ending
+        after the terminal event (or the timeout).  Re-reads the
+        journal per poll — fine for the handful of live streams a
+        host serves; a busier deployment would tail by offset."""
+        self._require_queue()
+        seen = 0
+        deadline = time.time() + timeout_s
+        while True:
+            events = self.queue.read_events(ticket=ticket)
+            for ev in events[seen:]:
+                yield ev
+            seen = len(events)
+            if any(e.get("event") == journal_mod.TERMINAL_EVENT
+                   for e in events):
+                return
+            if time.time() >= deadline:
+                return
+            time.sleep(STREAM_POLL_S)
+
+    def handle_result(self, ticket: str) -> tuple[int, dict]:
+        self._require_queue()
+        rec = results.result_with_candidates(self.queue, ticket)
+        if rec is None:
+            state = self.queue.ticket_state(ticket)
+            if state == "unknown" and not self.queue.read_events(
+                    ticket=ticket):
+                raise GatewayError(404, f"unknown ticket {ticket!r}")
+            raise GatewayError(404, f"no result yet for {ticket!r}",
+                               state=state)
+        return 200, rec
+
+    def handle_candidates(self, params: dict) -> tuple[int, dict]:
+        self._require_queue()
+        try:
+            min_sigma = float(params.get("min_sigma", ["0"])[0])
+            limit = int(params.get(
+                "limit", [str(self.query_limit)])[0])
+        except ValueError:
+            raise GatewayError(400, "min_sigma/limit must be numeric")
+        ticket = params.get("ticket", [None])[0]
+        return 200, results.query_candidates(
+            self.queue, ticket=ticket, min_sigma=min_sigma,
+            limit=min(max(0, limit), self.query_limit))
+
+    def handle_capacity(self) -> tuple[int, dict]:
+        if self.router is not None:
+            states = self.router.capacities()
+            accepting = sum(m.capacity for m in states
+                            if m.capacity > 0)
+            if accepting > 0:
+                cap = accepting
+            elif any(m.capacity == 0 for m in states):
+                cap = 0
+            else:
+                cap = -1
+            return 200, {
+                "capacity": cap, "role": "router",
+                "members": {m.name: m.capacity for m in states}}
+        cap = self.queue.capacity(self.max_age_s, self.default_depth)
+        fresh = self.queue.fresh_workers(self.max_age_s)
+        return 200, {
+            "capacity": -1 if cap is None else cap,
+            "fresh_workers": len(fresh),
+            "pending": self.queue.pending_count(),
+            "backend": self.queue.backend, "role": "gateway"}
+
+    def _require_queue(self) -> None:
+        if self.queue is None:
+            raise GatewayError(
+                404, "this is a federation router: it holds no "
+                     "tickets — query the member host that accepted "
+                     "the submission (the 'host' field)")
+
+
+def _make_handler(gw: GatewayServer):
+    class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.0: connection close delimits streamed bodies, so
+        # ?follow=1 needs no chunked-encoding bookkeeping
+        protocol_version = "HTTP/1.0"
+
+        def log_message(self, fmt, *args):
+            gw.log.debug("%s " + fmt, self.client_address[0], *args)
+
+        # ------------------------------------------------- plumbing
+
+        def _send_json(self, code: int, obj: dict,
+                       extra_headers: dict | None = None) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _observe(self, route: str, code: int,
+                     t0: float) -> None:
+            telemetry.gateway_requests_total().inc(
+                route=route, code=str(code))
+            telemetry.gateway_request_seconds().observe(
+                time.time() - t0, route=route)
+
+        def _dispatch(self, route: str, fn) -> None:
+            t0 = time.time()
+            headers: dict = {}
+            try:
+                code, payload = fn()
+            except GatewayError as e:
+                code, payload = e.code, e.payload
+                if "retry_after_s" in e.payload:
+                    headers["Retry-After"] = str(int(
+                        e.payload["retry_after_s"]) or 1)
+            except Exception as e:        # noqa: BLE001 — one bad
+                # request must never take the gateway down
+                gw.log.exception("gateway %s failed", route)
+                code, payload = 500, {"error": str(e)[:500]}
+            # the send is guarded SEPARATELY so a client that hung
+            # up mid-response (even mid-error-response) still gets
+            # counted — refusal rates must not under-report exactly
+            # when clients time out
+            try:
+                self._send_json(code, payload, headers)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                code = 499        # client went away mid-response
+            self._observe(route, code, t0)
+
+        # --------------------------------------------------- routes
+
+        def do_POST(self):
+            path = urllib.parse.urlparse(self.path).path
+            if path != "/v1/beams":
+                self._dispatch("other", lambda: (_ for _ in ()).throw(
+                    GatewayError(404, f"no POST route {path!r}")))
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(
+                    self.rfile.read(length).decode() or "{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._dispatch("submit", lambda: (_ for _ in ()).throw(
+                    GatewayError(400, f"bad JSON body: {e}")))
+                return
+            self._dispatch("submit",
+                           lambda: gw.handle_submit(payload))
+
+        def do_GET(self):
+            parsed = urllib.parse.urlparse(self.path)
+            path = parsed.path.rstrip("/")
+            params = urllib.parse.parse_qs(parsed.query)
+            parts = [p for p in path.split("/") if p]
+            if path == "/healthz":
+                self._dispatch("healthz", lambda: (200, {
+                    "ok": True,
+                    "role": "router" if gw.router else "gateway"}))
+            elif path == "/metrics":
+                self._metrics()
+            elif path == "/v1/capacity":
+                self._dispatch("capacity", gw.handle_capacity)
+            elif path == "/v1/candidates":
+                self._dispatch("candidates",
+                               lambda: gw.handle_candidates(params))
+            elif len(parts) == 3 and parts[:2] == ["v1", "tickets"]:
+                self._dispatch(
+                    "ticket",
+                    lambda: gw.handle_ticket_status(parts[2]))
+            elif len(parts) == 4 and parts[:2] == ["v1", "tickets"] \
+                    and parts[3] == "events":
+                if params.get("follow", ["0"])[0] in ("1", "true"):
+                    self._stream_events(parts[2], params)
+                else:
+                    self._dispatch(
+                        "events",
+                        lambda: gw.handle_events(parts[2]))
+            elif len(parts) == 3 and parts[:2] == ["v1", "results"]:
+                self._dispatch("result",
+                               lambda: gw.handle_result(parts[2]))
+            else:
+                self._dispatch("other", lambda: (_ for _ in ()).throw(
+                    GatewayError(404, f"no route {path!r}")))
+
+        def _metrics(self) -> None:
+            t0 = time.time()
+            text = metrics.REGISTRY.prometheus_text()
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            self._observe("metrics", 200, t0)
+
+        def _stream_events(self, ticket: str, params: dict) -> None:
+            t0 = time.time()
+            try:
+                timeout_s = float(params.get(
+                    "timeout_s", [str(STREAM_TIMEOUT_S)])[0])
+            except ValueError:
+                timeout_s = STREAM_TIMEOUT_S
+            # an unknown ticket must 404 like the non-follow route —
+            # never hold a 200 stream (and a gateway thread, and a
+            # 4-Hz full-journal re-read) open for the whole timeout
+            # waiting for events that will never come
+            if gw.queue is None \
+                    or (not gw.queue.read_events(ticket=ticket)
+                        and gw.queue.ticket_state(ticket)
+                        == "unknown"):
+                try:
+                    self._send_json(404, {
+                        "error": f"unknown ticket {ticket!r}"})
+                except OSError:
+                    pass
+                self._observe("events_stream", 404, t0)
+                return
+            code = 200
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.end_headers()
+                for ev in gw.iter_events_follow(ticket, timeout_s):
+                    self.wfile.write(
+                        (json.dumps(ev, sort_keys=True) + "\n")
+                        .encode())
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                code = 499
+            self._observe("events_stream", code, t0)
+
+    return Handler

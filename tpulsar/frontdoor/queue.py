@@ -1,0 +1,571 @@
+"""The pluggable TicketQueue interface: the ticket lifecycle as a
+contract, not a directory layout.
+
+PR 4-5 hardened an exactly-once ticket protocol on a shared
+filesystem; this module extracts that lifecycle behind an interface
+so the front door (gateway, federation router, tests, embedded
+pipelines) can speak *tickets* without speaking *spools*:
+
+  * ``FilesystemSpoolQueue`` — the reference backend, a thin
+    delegation to serve/protocol.py.  All serving processes (workers,
+    fleet controller, janitors) keep using the protocol module
+    directly; this adapter is the same state, same files, same
+    semantics.
+  * ``MemoryTicketQueue`` — a process-local backend with the same
+    contract (thread-safe claims, attempts counting, quarantine, an
+    in-memory journal), for tests and single-process embedding.
+
+THE CONTRACT every backend must honour (the PR-5 invariants, verified
+by the backend-parameterized tests in tests/test_frontdoor.py):
+
+  1. exactly-once claims: of N concurrent ``claim_next`` callers, at
+     most one receives any given ticket, and a claimed ticket is
+     never observable as pending;
+  2. a claim always records its owner (pid + worker id) — there is no
+     ownerless in-flight work;
+  3. results are durable before the claim is released: a crash
+     between the two leaves a *finished* ticket to reconcile, never a
+     lost one;
+  4. ``requeue_stale_claims`` steals only from DEAD owners, counts
+     each crash-shaped requeue against the ticket's ``attempts``, and
+     quarantines (with a terminal failed result, reason
+     ``max_attempts``) at the cap; ``requeue_own_claims`` is
+     attempt-neutral;
+  5. every transition lands in the journal (``read_events``), and a
+     finished ticket's chain satisfies ``journal.validate_chain``;
+  6. claim ordering is FIFO by submission time unless a
+     ``tenancy.TenantPolicy`` reorders it — the policy changes WHICH
+     ticket a claimer gets, never the exclusivity of getting it.
+
+``get_ticket_queue`` resolves backend URLs: a bare path or
+``spool:<dir>`` is the filesystem backend; ``memory:`` or
+``memory:<name>`` a (named, process-global) in-memory queue.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+
+from tpulsar.obs import journal, telemetry
+from tpulsar.serve import protocol
+
+_STATES = ("incoming", "claimed", "done", "quarantine")
+
+
+class TicketQueue:
+    """Abstract ticket queue (see the module contract above)."""
+
+    backend = "?"
+
+    # ----------------------------------------------------- submission
+    def submit(self, ticket_id: str, datafiles: list[str],
+               outdir: str, job_id: int | None = None,
+               **extra) -> str:
+        raise NotImplementedError
+
+    def cancel(self, ticket_id: str) -> bool:
+        """Remove a still-pending ticket; False once claimed."""
+        raise NotImplementedError
+
+    # --------------------------------------------------------- claims
+    def claim_next(self, worker_id: str = "",
+                   policy=None) -> dict | None:
+        raise NotImplementedError
+
+    def requeue_stale_claims(
+            self, max_attempts: int = protocol.DEFAULT_MAX_ATTEMPTS
+    ) -> list[str]:
+        raise NotImplementedError
+
+    def requeue_own_claims(self) -> list[str]:
+        raise NotImplementedError
+
+    # -------------------------------------------------------- results
+    def write_result(self, ticket_id: str, status: str, rc: int = 0,
+                     error: str = "", **extra) -> None:
+        raise NotImplementedError
+
+    def read_result(self, ticket_id: str) -> dict | None:
+        raise NotImplementedError
+
+    # -------------------------------------------------- introspection
+    def ticket_state(self, ticket_id: str) -> str:
+        raise NotImplementedError
+
+    def list_tickets(self, state: str) -> list[str]:
+        raise NotImplementedError
+
+    def read_ticket(self, ticket_id: str) -> dict | None:
+        """The ticket record from whichever non-terminal state holds
+        it (None when only a result exists, or nothing does)."""
+        raise NotImplementedError
+
+    def pending_count(self) -> int:
+        return self.state_count("incoming")
+
+    def claimed_count(self) -> int:
+        return self.state_count("claimed")
+
+    def state_count(self, state: str) -> int:
+        raise NotImplementedError
+
+    def pending_by_tenant(self) -> dict[str, int]:
+        raise NotImplementedError
+
+    def inflight_by_tenant(self) -> dict[str, int]:
+        raise NotImplementedError
+
+    # ---------------------------------------------- liveness/capacity
+    def heartbeat(self, worker_id: str = "", **fields) -> None:
+        raise NotImplementedError
+
+    def fresh_workers(
+            self, max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S
+    ) -> dict[str, dict]:
+        raise NotImplementedError
+
+    def capacity(self,
+                 max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S,
+                 default_depth: int = 8) -> int | None:
+        """Remaining admission capacity; None = zero fresh workers
+        (load-shed), 0 = fresh workers but a full queue
+        (backpressure) — the PR-5 distinction federation rides on."""
+        raise NotImplementedError
+
+    # -------------------------------------------------------- journal
+    def record_event(self, event: str, **fields) -> None:
+        """Append a lifecycle event outside the built-in transitions
+        (the gateway's ``received``); observational, never raises."""
+        raise NotImplementedError
+
+    def read_events(self, ticket: str | None = None) -> list[dict]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------
+# filesystem backend (the reference implementation)
+# --------------------------------------------------------------------
+
+class FilesystemSpoolQueue(TicketQueue):
+    """serve/protocol.py as a TicketQueue.  ``spool`` is shared state:
+    any number of these adapters, raw-protocol workers, and janitors
+    may point at one directory concurrently — that concurrency is the
+    protocol's whole design."""
+
+    backend = "spool"
+
+    def __init__(self, spool: str):
+        self.spool = protocol.ensure_spool(spool)
+
+    def __repr__(self):
+        return f"FilesystemSpoolQueue({self.spool!r})"
+
+    def submit(self, ticket_id, datafiles, outdir, job_id=None,
+               **extra):
+        return protocol.write_ticket(self.spool, ticket_id, datafiles,
+                                     outdir, job_id=job_id, **extra)
+
+    def cancel(self, ticket_id):
+        return protocol.cancel_ticket(self.spool, ticket_id)
+
+    def claim_next(self, worker_id="", policy=None):
+        return protocol.claim_next_ticket(self.spool, worker_id,
+                                          policy=policy)
+
+    def requeue_stale_claims(
+            self, max_attempts=protocol.DEFAULT_MAX_ATTEMPTS):
+        return protocol.requeue_stale_claims(self.spool, max_attempts)
+
+    def requeue_own_claims(self):
+        return protocol.requeue_own_claims(self.spool)
+
+    def write_result(self, ticket_id, status, rc=0, error="",
+                     **extra):
+        protocol.write_result(self.spool, ticket_id, status, rc=rc,
+                              error=error, **extra)
+
+    def read_result(self, ticket_id):
+        return protocol.read_result(self.spool, ticket_id)
+
+    def ticket_state(self, ticket_id):
+        return protocol.ticket_state(self.spool, ticket_id)
+
+    def list_tickets(self, state):
+        return protocol.list_tickets(self.spool, state)
+
+    def read_ticket(self, ticket_id):
+        for state in ("claimed", "incoming", "quarantine"):
+            rec = protocol._read_json(
+                protocol.ticket_path(self.spool, ticket_id, state))
+            if rec is not None:
+                return rec
+        return None
+
+    def state_count(self, state):
+        return protocol.state_count(self.spool, state)
+
+    def claimed_count(self):
+        return protocol.claimed_count(self.spool)
+
+    def pending_by_tenant(self):
+        counts: dict[str, int] = {}
+        for rec in protocol.pending_records(self.spool):
+            tenant = rec.get("tenant") or "default"
+            counts[tenant] = counts.get(tenant, 0) + 1
+        return counts
+
+    def inflight_by_tenant(self):
+        return protocol.inflight_by_tenant(self.spool)
+
+    def heartbeat(self, worker_id="", **fields):
+        protocol.write_heartbeat(self.spool, worker_id=worker_id,
+                                 **fields)
+
+    def fresh_workers(self,
+                      max_age_s=protocol.HEARTBEAT_MAX_AGE_S):
+        return protocol.fresh_workers(self.spool, max_age_s)
+
+    def capacity(self, max_age_s=protocol.HEARTBEAT_MAX_AGE_S,
+                 default_depth=8):
+        # the short-TTL cached probe: this sits on every gateway
+        # admission decision
+        return protocol.fleet_capacity_cached(self.spool, max_age_s,
+                                              default_depth)
+
+    def record_event(self, event, **fields):
+        journal.record(self.spool, event, **fields)
+
+    def read_events(self, ticket=None):
+        return journal.read_events(self.spool, ticket=ticket)
+
+
+# --------------------------------------------------------------------
+# in-memory backend
+# --------------------------------------------------------------------
+
+class MemoryTicketQueue(TicketQueue):
+    """The contract without a filesystem: dicts under one lock, an
+    in-memory journal, thread-granularity concurrency.  Claims record
+    the owning pid exactly like the spool backend, so the stale-claim
+    machinery (dead-owner detection, attempts, quarantine) behaves
+    identically — which is what lets the PR-5 contention tests run
+    against both backends unchanged."""
+
+    backend = "memory"
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._lock = threading.RLock()
+        self._states: dict[str, dict[str, dict]] = {
+            s: {} for s in _STATES}
+        self._heartbeats: dict[str, dict] = {}
+        self._events: list[dict] = []
+
+    def __repr__(self):
+        return f"MemoryTicketQueue({self.name!r})"
+
+    # ----------------------------------------------------- submission
+
+    def submit(self, ticket_id, datafiles, outdir, job_id=None,
+               **extra):
+        rec = {"ticket": ticket_id, "datafiles": list(datafiles),
+               "outdir": outdir, "job_id": job_id,
+               "submitted_at": time.time(), "attempts": 0, **extra}
+        rec.setdefault("trace_id", uuid.uuid4().hex[:16])
+        self.record_event("submitted", ticket=ticket_id, attempt=0,
+                          trace_id=rec["trace_id"], outdir=outdir)
+        with self._lock:
+            self._states["incoming"][ticket_id] = rec
+        return ticket_id
+
+    def cancel(self, ticket_id):
+        with self._lock:
+            return self._states["incoming"].pop(ticket_id,
+                                                None) is not None
+
+    # --------------------------------------------------------- claims
+
+    def claim_next(self, worker_id="", policy=None):
+        with self._lock:
+            pending = list(self._states["incoming"].values())
+            if policy is None or getattr(policy, "is_trivial",
+                                         False):
+                order = [r["ticket"] for r in sorted(
+                    pending, key=lambda r: (r.get("submitted_at", 0.0),
+                                            r["ticket"]))]
+            else:
+                order = policy.claim_order(pending,
+                                           self.inflight_by_tenant())
+            for tid in order:
+                rec = self._states["incoming"].pop(tid, None)
+                if rec is None:
+                    continue
+                rec = dict(rec)
+                rec["claimed_at"] = time.time()
+                rec["claimed_by"] = os.getpid()
+                # this backend's claimers are threads of one process,
+                # so pid-liveness alone would make every claim read
+                # live forever — the thread ident is the in-memory
+                # analogue of the spool backend's owner pid
+                rec["claimed_by_thread"] = threading.get_ident()
+                if worker_id:
+                    rec["claimed_by_worker"] = worker_id
+                self._states["claimed"][tid] = rec
+                self.record_event(
+                    "claimed", ticket=tid, worker=worker_id,
+                    pid=os.getpid(),
+                    attempt=int(rec.get("attempts", 0)),
+                    trace_id=rec.get("trace_id", ""),
+                    queue_wait_s=round(
+                        rec["claimed_at"]
+                        - rec.get("submitted_at", rec["claimed_at"]),
+                        3))
+                return rec
+            return None
+
+    def _requeue(self, verdict_fn, max_attempts: int,
+                 neutral_reason: str) -> list[str]:
+        requeued = []
+        with self._lock:
+            for tid in list(self._states["claimed"]):
+                rec = self._states["claimed"][tid]
+                if tid in self._states["done"]:
+                    del self._states["claimed"][tid]
+                    continue
+                verdict = verdict_fn(rec)
+                if verdict is None:
+                    continue
+                del self._states["claimed"][tid]
+                owner_pid = rec.get("claimed_by")
+                owner_worker = rec.get("claimed_by_worker", "")
+                rec = protocol._strip_claim_stamps(dict(rec))
+                rec.pop("claimed_by_thread", None)
+                if verdict == "strike":
+                    rec["attempts"] = int(rec.get("attempts", 0)) + 1
+                    if rec["attempts"] >= max_attempts:
+                        self._quarantine(rec, max_attempts)
+                        continue
+                self._states["incoming"][tid] = rec
+                if verdict == "strike":
+                    self.record_event(
+                        "takeover", ticket=tid,
+                        attempt=int(rec.get("attempts", 0)),
+                        trace_id=rec.get("trace_id", ""),
+                        from_worker=owner_worker, from_pid=owner_pid,
+                        by_pid=os.getpid())
+                else:
+                    self.record_event(
+                        "drain_requeue", ticket=tid,
+                        worker=owner_worker,
+                        attempt=int(rec.get("attempts", 0)),
+                        trace_id=rec.get("trace_id", ""),
+                        reason=neutral_reason)
+                requeued.append(tid)
+        return requeued
+
+    def _quarantine(self, rec: dict, max_attempts: int) -> None:
+        # called under the lock
+        tid = rec.get("ticket", "?")
+        rec["quarantined_at"] = time.time()
+        self._states["quarantine"][tid] = rec
+        self.record_event("quarantined", ticket=tid,
+                          attempt=int(rec.get("attempts", 0)),
+                          trace_id=rec.get("trace_id", ""),
+                          max_attempts=max_attempts)
+        self._write_result_locked(
+            tid, "failed", rc=1,
+            error=(f"quarantined after {rec.get('attempts', 0)} "
+                   f"crash-shaped claim(s) (max_attempts "
+                   f"{max_attempts}): this beam repeatedly killed "
+                   f"its worker"),
+            reason="max_attempts", attempts=rec.get("attempts", 0),
+            outdir=rec.get("outdir", ""),
+            trace_id=rec.get("trace_id", ""))
+
+    def requeue_stale_claims(
+            self, max_attempts=protocol.DEFAULT_MAX_ATTEMPTS):
+        me = os.getpid()
+
+        def verdict(rec):
+            owner = rec.get("claimed_by")
+            if owner == me:
+                # in-process claims are same-pid by construction; a
+                # boot-recovery sweep treats them like the spool
+                # backend treats its own: requeue without a strike
+                return None if self._owner_thread_live(rec) \
+                    else "neutral"
+            if owner is not None and protocol._pid_alive(owner):
+                return None
+            return "strike"
+        return self._requeue(verdict, max_attempts,
+                             neutral_reason="boot_recovery")
+
+    @staticmethod
+    def _owner_thread_live(rec: dict) -> bool:
+        """A same-pid claim is live while its claiming thread is —
+        this backend's analogue of pid liveness.  Claims made by
+        threads that have since exited are recoverable orphans."""
+        ident = rec.get("claimed_by_thread")
+        if ident is None:
+            return True
+        return any(t.ident == ident for t in threading.enumerate())
+
+    def requeue_own_claims(self):
+        me = os.getpid()
+        return self._requeue(
+            lambda rec: ("neutral" if rec.get("claimed_by") == me
+                         else None),
+            protocol.DEFAULT_MAX_ATTEMPTS, neutral_reason="drain")
+
+    # -------------------------------------------------------- results
+
+    def write_result(self, ticket_id, status, rc=0, error="",
+                     **extra):
+        with self._lock:
+            self._write_result_locked(ticket_id, status, rc=rc,
+                                      error=error, **extra)
+
+    def _write_result_locked(self, ticket_id, status, rc=0, error="",
+                             **extra):
+        trace_id = extra.get("trace_id", "")
+        if not trace_id:
+            claim = self._states["claimed"].get(ticket_id)
+            trace_id = (claim or {}).get("trace_id", "")
+        rec = {"ticket": ticket_id, "status": status, "rc": rc,
+               "error": error, "finished_at": time.time(), **extra}
+        if trace_id:
+            rec["trace_id"] = trace_id
+        # result durable before the claim releases (contract #3);
+        # "durable" here is dict-insertion order under the lock, but
+        # the ordering property — a crash between the two leaves a
+        # finished ticket — is the same observable contract
+        self._states["done"][ticket_id] = rec
+        self._states["claimed"].pop(ticket_id, None)
+        self.record_event("result", ticket=ticket_id,
+                          worker=str(extra.get("worker", "") or ""),
+                          attempt=int(extra.get("attempts", 0) or 0),
+                          trace_id=trace_id, status=status, rc=rc)
+
+    def read_result(self, ticket_id):
+        with self._lock:
+            rec = self._states["done"].get(ticket_id)
+            return dict(rec) if rec is not None else None
+
+    # -------------------------------------------------- introspection
+
+    def ticket_state(self, ticket_id):
+        with self._lock:
+            for state in ("done", "claimed", "incoming"):
+                if ticket_id in self._states[state]:
+                    return state
+        return "unknown"
+
+    def list_tickets(self, state):
+        with self._lock:
+            recs = list(self._states[state].values())
+        return [r["ticket"] for r in sorted(
+            recs, key=lambda r: (r.get("submitted_at", 0.0),
+                                 r["ticket"]))]
+
+    def read_ticket(self, ticket_id):
+        with self._lock:
+            for state in ("claimed", "incoming", "quarantine"):
+                rec = self._states[state].get(ticket_id)
+                if rec is not None:
+                    return dict(rec)
+        return None
+
+    def state_count(self, state):
+        with self._lock:
+            return len(self._states[state])
+
+    def pending_by_tenant(self):
+        with self._lock:
+            counts: dict[str, int] = {}
+            for rec in self._states["incoming"].values():
+                tenant = rec.get("tenant") or "default"
+                counts[tenant] = counts.get(tenant, 0) + 1
+            return counts
+
+    def inflight_by_tenant(self):
+        with self._lock:
+            counts: dict[str, int] = {}
+            for rec in self._states["claimed"].values():
+                tenant = rec.get("tenant") or "default"
+                counts[tenant] = counts.get(tenant, 0) + 1
+            return counts
+
+    # ---------------------------------------------- liveness/capacity
+
+    def heartbeat(self, worker_id="", **fields):
+        with self._lock:
+            self._heartbeats[worker_id] = {
+                "t": time.time(), "pid": os.getpid(),
+                "worker": worker_id, **fields}
+
+    def fresh_workers(self,
+                      max_age_s=protocol.HEARTBEAT_MAX_AGE_S):
+        with self._lock:
+            return {wid: dict(rec)
+                    for wid, rec in self._heartbeats.items()
+                    if protocol._hb_fresh(rec, max_age_s)}
+
+    def capacity(self, max_age_s=protocol.HEARTBEAT_MAX_AGE_S,
+                 default_depth=8):
+        fresh = self.fresh_workers(max_age_s)
+        if not fresh:
+            return None
+        depth = sum(int(rec.get("max_queue_depth", default_depth))
+                    for rec in fresh.values())
+        return max(0, depth - self.pending_count())
+
+    # -------------------------------------------------------- journal
+
+    def record_event(self, event, **fields):
+        rec = telemetry.event_record(event, **{
+            k: v for k, v in fields.items() if v or v == 0})
+        with self._lock:
+            self._events.append(rec)
+
+    def read_events(self, ticket=None):
+        with self._lock:
+            evs = [dict(e) for e in self._events
+                   if ticket is None or e.get("ticket") == ticket]
+        evs.sort(key=lambda r: r.get("t", 0.0))
+        return evs
+
+
+# --------------------------------------------------------------------
+# resolution
+# --------------------------------------------------------------------
+
+_memory_queues: dict[str, MemoryTicketQueue] = {}
+_memory_lock = threading.Lock()
+
+
+def memory_queue(name: str = "") -> MemoryTicketQueue:
+    """The process-global named in-memory queue (so a gateway and an
+    embedded worker constructed independently share one)."""
+    with _memory_lock:
+        q = _memory_queues.get(name)
+        if q is None:
+            q = _memory_queues[name] = MemoryTicketQueue(name)
+        return q
+
+
+def get_ticket_queue(url: str) -> TicketQueue:
+    """Backend resolution: ``memory:`` / ``memory:<name>`` -> the
+    named in-memory queue; ``spool:<dir>`` or a bare directory path
+    -> the filesystem spool backend."""
+    if url.startswith("memory:"):
+        return memory_queue(url[len("memory:"):].lstrip("/"))
+    if url == "memory":
+        return memory_queue()
+    if url.startswith("spool:"):
+        url = url[len("spool:"):]
+    if not url:
+        raise ValueError("empty ticket-queue url")
+    return FilesystemSpoolQueue(url)

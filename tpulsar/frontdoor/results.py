@@ -1,0 +1,89 @@
+"""The result store: query candidates out of finished tickets.
+
+The serving stack already makes every beam's outcome durable — a
+result record in the queue plus a results directory (search_params,
+report, ``*.accelcands``, tarballs) laid out identically to the batch
+path.  This module is the read side the gateway serves: it joins the
+two (result record -> outdir -> parsed candidate list) into JSON rows
+a network client can query without filesystem access to the host.
+
+Candidates come from the sifted ``<basenm>.accelcands`` list
+(io/accelcands.py — the same file the uploader consumes), so the
+query API returns exactly what the pipeline would upload, not a
+recomputation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+
+
+def _candidate_rows(outdir: str) -> list[dict]:
+    """Every sifted candidate in a results dir, as JSON-able rows
+    (empty when the beam produced no candidate list — a clean skip,
+    or a failed beam)."""
+    from tpulsar.io import accelcands
+    rows: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(outdir,
+                                              "*.accelcands"))):
+        try:
+            cands = accelcands.parse_candlist(path)
+        except OSError:
+            continue
+        for i, c in enumerate(cands, start=1):
+            row = {k: (float(v) if isinstance(v, float) else v)
+                   for k, v in dataclasses.asdict(c).items()
+                   if k != "dm_hits"}
+            row["num"] = i
+            row["num_dm_hits"] = len(c.dm_hits)
+            row["file"] = os.path.basename(path)
+            rows.append(row)
+    return rows
+
+
+def result_with_candidates(queue, ticket: str) -> dict | None:
+    """One ticket's terminal record joined with its candidate rows
+    (None while the ticket has no result yet)."""
+    rec = queue.read_result(ticket)
+    if rec is None:
+        return None
+    out = dict(rec)
+    outdir = rec.get("outdir", "")
+    out["candidates"] = (_candidate_rows(outdir)
+                         if outdir and os.path.isdir(outdir) else [])
+    return out
+
+
+def query_candidates(queue, ticket: str | None = None,
+                     min_sigma: float = 0.0,
+                     limit: int = 200) -> dict:
+    """The candidate query API: rows across one ticket (or every done
+    ticket), filtered by sigma, sorted strongest first, truncated to
+    ``limit`` with the truncation made explicit (``total`` counts the
+    matching rows BEFORE the cut — a capped result must never read as
+    a complete one)."""
+    limit = max(0, limit)
+    tickets = ([ticket] if ticket is not None
+               else queue.list_tickets("done"))
+    rows: list[dict] = []
+    searched = 0
+    for tid in tickets:
+        rec = queue.read_result(tid)
+        if rec is None or rec.get("status") != "done":
+            continue
+        searched += 1
+        outdir = rec.get("outdir", "")
+        if not outdir or not os.path.isdir(outdir):
+            continue
+        for row in _candidate_rows(outdir):
+            if row.get("sigma", 0.0) < min_sigma:
+                continue
+            row["ticket"] = tid
+            rows.append(row)
+    rows.sort(key=lambda r: -r.get("sigma", 0.0))
+    return {"total": len(rows), "returned": min(len(rows), limit),
+            "tickets_searched": searched,
+            "min_sigma": min_sigma,
+            "candidates": rows[:max(0, limit)]}

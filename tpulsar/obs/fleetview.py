@@ -147,8 +147,9 @@ def slo_snapshot(spool: str, summary: dict | None = None) -> dict:
     slo = reg.gauge(
         "tpulsar_fleet_slo_seconds",
         "journal-derived fleet latency quantiles: queue_wait = "
-        "submit -> first claim, claim_to_start = claim -> device "
-        "work, beam_e2e = submit -> terminal result (exact "
+        "gateway receipt (HTTP arrival; spool submit when no "
+        "gateway) -> first claim, claim_to_start = claim -> device "
+        "work, beam_e2e = receipt -> terminal result (exact "
         "quantiles over the journal's raw durations, spanning every "
         "worker that touched each beam)",
         labelnames=("series", "quantile"))

@@ -8,7 +8,13 @@ every actor that moves a ticket through the spool state machine
 appends ONE stamped event per transition to
 ``<spool>/events/journal.jsonl``:
 
-    submitted        client wrote the ticket (trace id minted here)
+    received         OPTIONAL chain head: the HTTP gateway accepted
+                     the submission at the network edge (trace id
+                     minted there; tenant recorded) — queue-wait SLOs
+                     measure from here when present, so they include
+                     the gateway hop, not just the spool write
+    submitted        client wrote the ticket (trace id minted here
+                     unless a gateway minted it at the edge)
     claimed          a worker won the claim rename (worker, pid,
                      attempt, queue_wait_s)
     stagein_done /   the prefetch thread staged the beam's inputs
@@ -165,7 +171,10 @@ def validate_chain(events: list[dict]) -> list[str]:
     """Well-formedness problems in ONE ticket's event chain — the
     property every done/quarantined beam must satisfy:
 
-      * it starts with ``submitted``;
+      * it starts with ``submitted`` — or with the optional
+        gateway-edge ``received`` head, in which case ``submitted``
+        must follow it (an HTTP-accepted beam that never reached the
+        queue is an in-flight chain, not a well-formed one);
       * exactly one terminal ``result`` event, and nothing after it;
       * ``attempt`` never decreases, and every ``takeover`` strike
         raises it by exactly 1 over the claim it stole;
@@ -175,10 +184,15 @@ def validate_chain(events: list[dict]) -> list[str]:
     problems: list[str] = []
     if not events:
         return ["no events"]
-    if events[0].get("event") != "submitted":
+    head = events[0].get("event")
+    if head == "received":
+        if len(events) < 2 or events[1].get("event") != "submitted":
+            problems.append(
+                "gateway 'received' head not followed by 'submitted'")
+    elif head != "submitted":
         problems.append(
-            f"first event is {events[0].get('event')!r}, "
-            f"not 'submitted'")
+            f"first event is {head!r}, not 'submitted' (or a "
+            f"gateway 'received' head)")
     terminals = [i for i, ev in enumerate(events)
                  if ev.get("event") == TERMINAL_EVENT]
     if len(terminals) != 1:
@@ -244,8 +258,11 @@ def chain_summary(events: list[dict]) -> dict:
         "outdir": next((ev["outdir"] for ev in events
                         if ev.get("outdir")), ""),
     }
-    sub, claim, start = (first.get("submitted"), first.get("claimed"),
-                         last.get("search_start"))
+    # queue-wait and e2e measure from the gateway-edge 'received'
+    # event when one exists: the SLO a network submitter experiences
+    # starts at HTTP arrival, not at the spool write behind it
+    sub = first.get("received") or first.get("submitted")
+    claim, start = first.get("claimed"), last.get("search_start")
     if sub and claim:
         out["queue_wait_s"] = round(claim["t"] - sub["t"], 3)
     if start and last.get("claimed"):
@@ -253,6 +270,8 @@ def chain_summary(events: list[dict]) -> dict:
             start["t"] - last["claimed"]["t"], 3)
     if sub and terminal:
         out["e2e_s"] = round(terminal["t"] - sub["t"], 3)
+    if first.get("received"):
+        out["tenant"] = first["received"].get("tenant", "")
     return out
 
 

@@ -308,6 +308,69 @@ def fleet_capacity() -> metrics.Gauge:
         "fresh workers (clients load-shed to process-per-beam)")
 
 
+#: histogram buckets for gateway HTTP handling: sub-millisecond local
+#: routing up to multi-second federation forwards and staging waits
+GATEWAY_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0)
+
+
+def gateway_requests_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_gateway_requests_total",
+        "HTTP requests handled by the front-door gateway, by route "
+        "and response code",
+        labelnames=("route", "code"))
+
+
+def gateway_request_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_gateway_request_seconds",
+        "gateway HTTP handling latency per route (submission "
+        "includes admission checks and the queue write; streaming "
+        "routes observe the full stream duration)",
+        labelnames=("route",), buckets=GATEWAY_BUCKETS)
+
+
+def gateway_submissions_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_gateway_submissions_total",
+        "beam submissions at the gateway by tenant and outcome: "
+        "accepted (ticket written), routed (forwarded to a "
+        "federation member), quota (tenant max_pending refused, "
+        "HTTP 429), backpressure (fleet queue full, HTTP 429), "
+        "load_shed (zero fresh workers / every member shedding, "
+        "HTTP 503), invalid (bad request), error (router: every "
+        "member transport-failed, HTTP 502)",
+        labelnames=("tenant", "outcome"))
+
+
+def frontdoor_quota_deferred() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_frontdoor_quota_deferred",
+        "pending tickets skipped in the most recent claim-ordering "
+        "pass because their tenant is at its max_inflight quota "
+        "(deferred, not dropped: they re-enter ordering as the "
+        "tenant's in-flight beams finish)",
+        labelnames=("tenant",))
+
+
+def frontdoor_host_capacity() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_frontdoor_host_capacity",
+        "per-member-host advertised admission capacity as last "
+        "polled by the federation router: >0 = accepting, 0 = "
+        "saturated (backpressure), -1 = load-shedding or "
+        "unreachable (routed around)",
+        labelnames=("host",))
+
+
+def frontdoor_routed_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_frontdoor_routed_total",
+        "federation router submissions by member host and outcome "
+        "(ok | error)",
+        labelnames=("host", "outcome"))
+
+
 # --------------------------------------------------------------------
 # the shared heartbeat/progress event shape
 # --------------------------------------------------------------------

@@ -108,7 +108,12 @@ class WarmServerManager:
         return qid
 
     def can_submit(self) -> bool:
-        cap = protocol.fleet_capacity(
+        # the short-TTL cached probe: can_submit sits on the pool's
+        # submission loop and the raw capacity read re-stats every
+        # heartbeat file and the pending listing per call — our own
+        # submits/heartbeats invalidate the cache, so a just-written
+        # ticket is always counted
+        cap = protocol.fleet_capacity_cached(
             self.spool, self.heartbeat_max_age_s,
             default_depth=self.max_queue_depth)
         if cap is None:

@@ -148,6 +148,7 @@ def write_ticket(spool: str, ticket_id: str, datafiles: list[str],
                    attempt=0, trace_id=rec["trace_id"],
                    outdir=outdir)
     _atomic_write_json(ticket_path(spool, ticket_id, "incoming"), rec)
+    _invalidate_capacity(spool)
     return ticket_id
 
 
@@ -205,13 +206,64 @@ def claimed_count(spool: str) -> int:
                     or ".json.claiming." in n))
 
 
-def claim_next_ticket(spool: str, worker_id: str = "") -> dict | None:
+def pending_records(spool: str) -> list[dict]:
+    """Parsed incoming ticket records (unsorted; torn files skipped)
+    — the input a claim policy orders."""
+    d = os.path.join(spool, "incoming")
+    try:
+        names = [n for n in os.listdir(d) if n.endswith(".json")]
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        rec = _read_json(os.path.join(d, name))
+        if rec is not None:
+            rec.setdefault("ticket", name[:-5])
+            out.append(rec)
+    return out
+
+
+def inflight_by_tenant(spool: str) -> dict[str, int]:
+    """Currently claimed beams per tenant, INCLUDING tickets held in
+    transient side-files (``.claiming.<pid>`` mid-claim,
+    ``.takeover.<pid>`` mid-requeue) — same reasoning as
+    claimed_count: a ticket between its two claim renames is neither
+    pending nor a plain claim, and a quota pass that saw it as
+    neither would let a concurrent worker overshoot the tenant's
+    max_inflight through that window.  The claimed/ directory is
+    bounded by fleet in-flight depth, so the per-claim parse here is
+    cheap — unlike incoming/, which can hold a deep backlog."""
+    d = os.path.join(spool, "claimed")
+    try:
+        names = [n for n in os.listdir(d)
+                 if not n.endswith(".tmp")
+                 and (n.endswith(".json") or ".json.claiming." in n
+                      or ".json.takeover." in n)]
+    except OSError:
+        return {}
+    counts: dict[str, int] = {}
+    for name in names:
+        rec = _read_json(os.path.join(d, name)) or {}
+        tenant = rec.get("tenant") or "default"
+        counts[tenant] = counts.get(tenant, 0) + 1
+    return counts
+
+
+def claim_next_ticket(spool: str, worker_id: str = "",
+                      policy=None) -> dict | None:
     """Atomically move the oldest incoming ticket to claimed/ and
     return its record (None when the queue is empty).  Rename is the
     claim: two workers on one spool cannot claim the same ticket.
     The claim records the owner (pid + worker id) so the requeue
     machinery can tell a dead owner's orphan from a live co-worker's
     in-flight beam.
+
+    ``policy`` (a frontdoor.tenancy.TenantPolicy) replaces the FIFO
+    scan order with priority-class ordering and skips tickets of
+    tenants at their in-flight quota — ordering and eligibility only:
+    the claim itself is the same exclusive two-rename either way, so
+    the exactly-once guarantees below hold unchanged under any
+    policy.
 
     The claim lands in two renames: ``incoming/<tid>.json`` ->
     ``claimed/<tid>.json.claiming.<pid>`` (exclusive), stamp the owner
@@ -248,7 +300,14 @@ def claim_next_ticket(spool: str, worker_id: str = "") -> dict | None:
                 rec["claimed_at"] - rec.get("submitted_at",
                                             rec["claimed_at"]), 3))
 
-    for tid in list_tickets(spool, "incoming"):
+    if policy is None or getattr(policy, "is_trivial", False):
+        # a trivial policy (no tenants configured) IS FIFO: skip the
+        # ordering pass rather than re-deriving FIFO from it
+        order = list_tickets(spool, "incoming")
+    else:
+        order = policy.claim_order(pending_records(spool),
+                                   inflight_by_tenant(spool))
+    for tid in order:
         src = ticket_path(spool, tid, "incoming")
         dst = ticket_path(spool, tid, "claimed")
         staging = f"{dst}.claiming.{os.getpid()}"
@@ -312,12 +371,14 @@ def claim_next_ticket(spool: str, worker_id: str = "") -> dict | None:
                 os.rename(staging, dst)
             except OSError:
                 continue
+            _invalidate_capacity(spool)
             _journal_claim(rec)
             return rec
         try:
             os.unlink(staging)
         except OSError:
             pass
+        _invalidate_capacity(spool)
         _journal_claim(rec)
         return rec
     return None
@@ -329,9 +390,10 @@ def cancel_ticket(spool: str, ticket_id: str) -> bool:
     no cross-process way to abort the in-flight device work)."""
     try:
         os.unlink(ticket_path(spool, ticket_id, "incoming"))
-        return True
     except OSError:
         return False
+    _invalidate_capacity(spool)
+    return True
 
 
 def _pid_alive(pid) -> bool:
@@ -623,6 +685,7 @@ def _requeue_claims(spool: str, verdict_fn,
                     pass
                 continue
         _atomic_write_json(ticket_path(spool, tid, "incoming"), rec)
+        _invalidate_capacity(spool)
         try:
             os.unlink(tmp)
         except OSError:
@@ -764,6 +827,7 @@ def write_heartbeat(spool: str, worker_id: str = "", **fields) -> None:
     rec = {"t": time.time(), "pid": os.getpid(),
            "worker": worker_id, **fields}
     _atomic_write_json(heartbeat_path(spool, worker_id), rec)
+    _invalidate_capacity(spool)
 
 
 def read_heartbeat(spool: str, worker_id: str = "") -> dict | None:
@@ -828,3 +892,42 @@ def fleet_capacity(spool: str,
     depth = sum(int(rec.get("max_queue_depth", default_depth))
                 for rec in fresh.values())
     return max(0, depth - pending_count(spool))
+
+
+#: how long a cached capacity reading may serve admission decisions.
+#: Short on purpose: the probe's cost is O(heartbeat files) stat+parse
+#: per call and it sits on the submitter's can_submit loop, the
+#: controller's poll loop, and every gateway admission — but a
+#: reading more than ~a second old could admit into a fleet that just
+#: drained.  Same-process writes that change the answer (a new
+#: ticket, a heartbeat) invalidate immediately; cross-process churn
+#: is visible within the TTL.
+CAPACITY_PROBE_TTL_S = 1.0
+
+#: spool -> (expires_at, max_age_s, default_depth, capacity)
+_capacity_cache: dict[str, tuple] = {}
+
+
+def _invalidate_capacity(spool: str) -> None:
+    _capacity_cache.pop(spool, None)
+
+
+def fleet_capacity_cached(spool: str,
+                          max_age_s: float = HEARTBEAT_MAX_AGE_S,
+                          default_depth: int = 8,
+                          ttl_s: float = CAPACITY_PROBE_TTL_S
+                          ) -> int | None:
+    """``fleet_capacity`` behind a short-TTL per-spool cache — the
+    hot-loop spelling.  A cached entry is only served for the same
+    (max_age_s, default_depth) question; ``ttl_s=0`` bypasses the
+    cache entirely."""
+    now = time.time()
+    hit = _capacity_cache.get(spool)
+    if hit is not None and hit[0] > now and hit[1] == max_age_s \
+            and hit[2] == default_depth:
+        return hit[3]
+    cap = fleet_capacity(spool, max_age_s, default_depth)
+    if ttl_s > 0:
+        _capacity_cache[spool] = (now + ttl_s, max_age_s,
+                                  default_depth, cap)
+    return cap

@@ -63,10 +63,18 @@ class SearchServer:
                  prefetch_depth: int = 1,
                  poll_s: float = 0.5,
                  heartbeat_interval_s: float = 10.0,
+                 claim_policy=None,
                  beam_fn=None, logger=None):
         if cfg is None:
             from tpulsar.config import settings
             cfg = settings()
+        if claim_policy is None:
+            # tenant priority classes + in-flight quotas enforced at
+            # the claim (frontdoor/tenancy.py): with no tenants
+            # configured this degrades to FIFO, so it is always on
+            from tpulsar.frontdoor.tenancy import TenantPolicy
+            claim_policy = TenantPolicy.from_config(cfg)
+        self.claim_policy = claim_policy
         self.cfg = cfg
         self.spool = spool or protocol.default_spool_dir(cfg)
         self.worker_id = worker_id
@@ -86,8 +94,9 @@ class SearchServer:
         #: exit (a crash leaves claims in place — no drain, no result)
         self._crash = os._exit
         self.pipeline = StageInPipeline(
-            claim=lambda: protocol.claim_next_ticket(self.spool,
-                                                     self.worker_id),
+            claim=lambda: protocol.claim_next_ticket(
+                self.spool, self.worker_id,
+                policy=self.claim_policy),
             workdir_base=cfg.processing.base_working_directory,
             cfg=cfg, depth=prefetch_depth, poll_s=poll_s,
             logger=self.log, journal=self._journal)
