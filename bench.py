@@ -1401,6 +1401,124 @@ def run_gateway() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_chaos() -> None:
+    """``bench.py --chaos``: the same synthetic stub-beam workload
+    through a 2-worker fleet twice — once clean, once under a chaos
+    scenario (worker SIGKILL mid-backlog + a spool I/O fault window)
+    — and report recovery speed and the latency cost of the storm:
+    MTTR (kill -> victim beam terminal), takeover latency (the
+    janitor's share), and ticket e2e p95 under chaos vs clean.  The
+    invariant verifier runs over both spools and its violation count
+    is part of the record: the only acceptable value is 0 — this
+    bench regressing CORRECTNESS is worse than it regressing speed.
+    Emits one bench/v2 record with an additive ``chaos`` key.
+
+    Stub workers (tpulsar/chaos/worker.py) speak the full spool
+    protocol with millisecond beams, so the measured numbers isolate
+    the RECOVERY machinery (janitor cadence, takeover renames,
+    restart backoff), not device compute.  Knobs:
+    TPULSAR_CHAOS_NBEAMS/BEAM_S/INTERVAL_S (default 14/0.3/0.1),
+    TPULSAR_CHAOS_KEEP=1 keeps the scratch spools."""
+    import shutil
+    import tempfile
+
+    from tpulsar.chaos import invariants, runner, scenario
+    from tpulsar.obs import fleetview, journal
+
+    nbeams = int(os.environ.get("TPULSAR_CHAOS_NBEAMS", "14"))
+    beam_s = float(os.environ.get("TPULSAR_CHAOS_BEAM_S", "0.3"))
+    interval = float(os.environ.get("TPULSAR_CHAOS_INTERVAL_S",
+                                    "0.1"))
+    base = tempfile.mkdtemp(prefix="tpulsar_chaosbench_")
+    # the kill lands mid-backlog: submissions outpace two workers'
+    # service rate, so the victim worker is holding a beam
+    kill_t = round(nbeams * interval * 0.5, 2)
+
+    def one(tag: str, timeline: list) -> dict:
+        spool = os.path.join(base, f"spool_{tag}")
+        sc = scenario.from_dict({
+            "name": f"bench-{tag}", "seed": 7, "duration_s": 120.0,
+            "workers": 2, "worker_kind": "stub", "beam_s": beam_s,
+            "workload": {"beams": nbeams, "interval_s": interval},
+            "timeline": timeline, "quiesce_timeout_s": 90.0,
+        })
+        _log(f"chaos bench [{tag}]: {nbeams} beams x {beam_s:g} s "
+             f"through 2 stub workers"
+             + (f", {len(timeline)} action(s)" if timeline else ""))
+        manifest = runner.run_scenario(sc, spool)
+        events = journal.read_events(spool)
+        e2e = sorted(
+            rec["e2e_s"]
+            for rec in journal.summarize(spool)["tickets"].values()
+            if rec.get("status") == "done" and "e2e_s" in rec)
+        report = invariants.verify(spool,
+                                   quiesced=manifest["quiesced"])
+        rec_stats = invariants.recovery_stats(events)
+        return {
+            "quiesced": manifest["quiesced"],
+            "beams_done": len(e2e),
+            "e2e_p50_s": (round(fleetview._quantile(e2e, 0.5), 3)
+                          if e2e else -1.0),
+            "e2e_p95_s": (round(fleetview._quantile(e2e, 0.95), 3)
+                          if e2e else -1.0),
+            "mttr_s": rec_stats["mttr_s"],
+            "takeover_latency_s": rec_stats["takeover_latency_s"],
+            "invariant_violations": len(report["violations"]),
+            "violations": report["violations"][:10],
+        }
+
+    clean = one("clean", [])
+    chaos = one("chaos", [
+        {"t": kill_t, "action": "kill_worker", "worker": "w0",
+         "signal": "KILL"},
+        {"t": kill_t + 0.2, "action": "set_faults", "worker": "w1",
+         "until": kill_t + 4.0,
+         "faults": "spool.io:unimplemented:count=1,errno=EIO"},
+    ])
+    _log(f"clean p95 {clean['e2e_p95_s']:.2f} s; chaos p95 "
+         f"{chaos['e2e_p95_s']:.2f} s, mttr {chaos['mttr_s']} s, "
+         f"violations {clean['invariant_violations']}"
+         f"+{chaos['invariant_violations']}")
+    result = {
+        "metric": "chaos_recovery_mttr",
+        "value": (chaos["mttr_s"] if chaos["mttr_s"] is not None
+                  else -1.0),
+        "unit": "s",
+        "chaos": {
+            "nbeams": nbeams, "beam_s": beam_s,
+            "interval_s": interval, "kill_t_s": kill_t,
+            "mttr_s": (chaos["mttr_s"]
+                       if chaos["mttr_s"] is not None else -1.0),
+            "takeover_latency_s": (
+                chaos["takeover_latency_s"]
+                if chaos["takeover_latency_s"] is not None
+                else -1.0),
+            "e2e_p50_clean_s": clean["e2e_p50_s"],
+            "e2e_p95_clean_s": clean["e2e_p95_s"],
+            "e2e_p50_chaos_s": chaos["e2e_p50_s"],
+            "e2e_p95_chaos_s": chaos["e2e_p95_s"],
+            "e2e_p95_degradation": (
+                round(chaos["e2e_p95_s"] / clean["e2e_p95_s"], 3)
+                if clean["e2e_p95_s"] > 0 and chaos["e2e_p95_s"] > 0
+                else -1.0),
+            "beams_done_clean": clean["beams_done"],
+            "beams_done_chaos": chaos["beams_done"],
+            "quiesced": clean["quiesced"] and chaos["quiesced"],
+            # the correctness row: MUST be 0 — the bench gate skips
+            # zero-valued keys, so CI asserts this one explicitly
+            "invariant_violations": (
+                clean["invariant_violations"]
+                + chaos["invariant_violations"]),
+        },
+    }
+    if clean["violations"] or chaos["violations"]:
+        result["chaos"]["violation_sample"] = (
+            clean["violations"] + chaos["violations"])[:10]
+    _emit(result)
+    if os.environ.get("TPULSAR_CHAOS_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _usable_cpus() -> list:
     """The CPU ids this process may actually run on, for taskset
     pinning (a cgroup cpuset need not start at 0 or be contiguous)."""
@@ -1717,6 +1835,9 @@ def main() -> None:
         return
     if "--gateway" in sys.argv:
         run_gateway()
+        return
+    if "--chaos" in sys.argv:
+        run_chaos()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

@@ -1,0 +1,480 @@
+"""Chaos harness tests: scenario validation, the schedule-file fault
+transport, spool I/O containment, the invariant verifier's mutation
+suite (a verifier that can't fail is not an oracle), recovery stats,
+live tailing, and a real multi-process mini-storm."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from tpulsar.chaos import invariants, runner, scenario
+from tpulsar.obs import journal
+from tpulsar.resilience import faults
+from tpulsar.serve import protocol
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --------------------------------------------------------------------
+# scenario parsing
+# --------------------------------------------------------------------
+
+def _base_doc(**over):
+    doc = {"name": "t", "workers": 1,
+           "workload": {"beams": 2, "interval_s": 0.01},
+           "timeline": []}
+    doc.update(over)
+    return doc
+
+
+def test_scenario_validates_loudly():
+    sc = scenario.from_dict(_base_doc())
+    assert sc.workers == 1 and sc.workload.beams == 2
+    with pytest.raises(ValueError, match="unknown key"):
+        scenario.from_dict(_base_doc(typo=1))
+    with pytest.raises(ValueError, match="unknown action"):
+        scenario.from_dict(_base_doc(
+            timeline=[{"t": 0, "action": "explode"}]))
+    with pytest.raises(ValueError, match="needs a worker"):
+        scenario.from_dict(_base_doc(
+            timeline=[{"t": 0, "action": "kill_worker"}]))
+    with pytest.raises(ValueError, match="unknown fault point"):
+        scenario.from_dict(_base_doc(
+            timeline=[{"t": 0, "action": "set_faults",
+                       "worker": "w0", "faults": "nope:hang"}]))
+    with pytest.raises(ValueError, match="gateway"):
+        scenario.from_dict(_base_doc(
+            workload={"beams": 1, "via": "gateway"}))
+    with pytest.raises(ValueError, match="datafiles"):
+        scenario.from_dict(_base_doc(worker_kind="serve"))
+
+
+def test_packaged_ci_scenario_loads():
+    sc = scenario.load("ci_smoke")
+    assert sc.workers == 2 and sc.gateway
+    kinds = {a.action for a in sc.timeline}
+    assert {"kill_worker", "set_faults",
+            "restart_gateway"} <= kinds
+
+
+# --------------------------------------------------------------------
+# the schedule file drives the faults layer
+# --------------------------------------------------------------------
+
+def test_schedule_windows_open_close_and_address_workers(tmp_path):
+    sc = scenario.from_dict(_base_doc(timeline=[
+        {"t": 0.0, "action": "set_faults", "worker": "w1",
+         "faults": "journal.append:unimplemented:count=1"},
+        {"t": 999.0, "action": "set_faults", "worker": "*",
+         "faults": "spool.io:unimplemented"},
+    ]))
+    spool = str(tmp_path / "spool")
+    path = scenario.write_schedule(spool, sc, time.time())
+    assert os.path.exists(path)
+    # the addressed worker sees the open window; others don't
+    faults.configure_schedule(path, "w1")
+    assert faults.targets("journal.append")
+    assert not faults.targets("spool.io")      # not open yet (t=999)
+    with pytest.raises(OSError):
+        faults.fire("journal.append", make_exc=faults.io_error)
+    faults.fire("journal.append", make_exc=faults.io_error)  # count=1
+    faults.configure_schedule(path, "w0")
+    assert not faults.targets("journal.append")
+
+
+def test_schedule_window_closes_at_until(tmp_path):
+    path = str(tmp_path / "sched.json")
+    json.dump({"t0": time.time() - 10.0, "entries": [
+        {"worker": "*", "at": 0.0, "until": 5.0,
+         "faults": "spool.io:unimplemented"}]}, open(path, "w"))
+    faults.configure_schedule(path, "w0")
+    assert not faults.targets("spool.io")      # window already shut
+
+
+def test_delay_mode_sleeps_and_proceeds():
+    faults.configure("spool.io:delay:seconds=0.05")
+    t0 = time.time()
+    faults.fire("spool.io", make_exc=faults.io_error)
+    assert time.time() - t0 >= 0.05            # slow, not failed
+    assert faults.fired("spool.io") == 1
+
+
+# --------------------------------------------------------------------
+# spool I/O containment (the ENOSPC/EIO satellite)
+# --------------------------------------------------------------------
+
+def test_failed_ticket_write_fails_cleanly(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.ensure_spool(spool)
+    faults.configure("spool.io:unimplemented:errno=ENOSPC,count=1")
+    with pytest.raises(OSError):
+        protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    faults.reset()
+    # nothing half-visible: no ticket, no tmp litter
+    assert protocol.pending_count(spool) == 0
+    d = os.path.join(spool, "incoming")
+    assert all(not n.endswith(".tmp") for n in os.listdir(d))
+    # the journal tells the clean-refusal story and the chain is
+    # well-formed (a refused beam is not a lost beam)
+    evs = journal.read_events(spool, ticket="t1")
+    assert [e["event"] for e in evs] == ["submitted", "submit_failed"]
+    assert journal.validate_chain(evs) == []
+
+
+def test_failed_result_write_leaves_claim_intact(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    protocol.claim_next_ticket(spool, "w0")
+    faults.configure("spool.io:unimplemented:errno=EIO")
+    with pytest.raises(OSError):
+        protocol.write_result(spool, "t1", "done", worker="w0")
+    faults.reset()
+    # the transition failed CLEANLY: claim still owned, no done
+    # record, no torn json anywhere a claimer could parse
+    assert protocol.read_result(spool, "t1") is None
+    assert protocol.ticket_state(spool, "t1") == "claimed"
+    for state in ("claimed", "done"):
+        d = os.path.join(spool, state)
+        assert all(not n.endswith(".tmp") for n in os.listdir(d))
+
+
+def test_failed_claim_stamp_withdraws_cleanly(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    # first write (the claim stamp) fails: the claim must withdraw
+    faults.configure("spool.io:unimplemented:errno=ENOSPC,count=1")
+    with pytest.raises(OSError):
+        protocol.claim_next_ticket(spool, "w0")
+    faults.reset()
+    assert not any(
+        ".claiming." in n
+        for n in os.listdir(os.path.join(spool, "claimed")))
+    # the ticket went straight back and is claimable again
+    assert protocol.claim_next_ticket(spool, "w0")["ticket"] == "t1"
+
+
+def test_claimer_never_parses_a_torn_ticket(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.ensure_spool(spool)
+    with open(protocol.ticket_path(spool, "torn", "incoming"),
+              "w") as fh:
+        fh.write('{"ticket": "torn", "datafi')   # torn json
+    protocol.write_ticket(spool, "ok", ["/x"], "/o")
+    rec = protocol.claim_next_ticket(spool, "w0")
+    assert rec["ticket"] == "ok"                 # torn one dropped
+
+
+def test_journal_append_fault_never_fails_the_transition(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    faults.configure("journal.append:unimplemented")
+    assert protocol.claim_next_ticket(spool, "w0")["ticket"] == "t1"
+    protocol.write_result(spool, "t1", "done", worker="w0")
+    faults.reset()
+    # the work happened; only the evidence is missing
+    assert protocol.read_result(spool, "t1")["status"] == "done"
+    evs = journal.read_events(spool, ticket="t1")
+    assert [e["event"] for e in evs] == ["submitted"]
+
+
+# --------------------------------------------------------------------
+# verifier mutation tests: seed each violation class, assert the
+# verifier NAMES that invariant
+# --------------------------------------------------------------------
+
+def _chain(spool, tid, trace=None, worker="w0", status="done",
+           done_rec=True):
+    trace = trace or f"tr-{tid}"
+    journal.record(spool, "submitted", ticket=tid, attempt=0,
+                   trace_id=trace)
+    journal.record(spool, "claimed", ticket=tid, worker=worker,
+                   attempt=0, trace_id=trace)
+    journal.record(spool, "result", ticket=tid, worker=worker,
+                   attempt=0, trace_id=trace, status=status, rc=0)
+    if done_rec:
+        protocol.ensure_spool(spool)
+        protocol._atomic_write_json(
+            protocol.ticket_path(spool, tid, "done"),
+            {"ticket": tid, "status": status,
+             "finished_at": time.time(), "trace_id": trace})
+
+
+def _named(spool, **kw):
+    report = invariants.verify(spool, **kw)
+    return {name for name, n in report["invariants"].items() if n}
+
+
+def test_clean_chain_passes_every_invariant(tmp_path):
+    spool = str(tmp_path / "spool")
+    _chain(spool, "a")
+    _chain(spool, "b")
+    report = invariants.verify(spool)
+    assert report["ok"], report["violations"]
+    assert report["checked"]["terminal"] == 2
+
+
+def test_verifier_names_doubled_terminal(tmp_path):
+    spool = str(tmp_path / "spool")
+    _chain(spool, "a")
+    journal.record(spool, "result", ticket="a", worker="w1",
+                   attempt=0, trace_id="tr-a", status="done", rc=0)
+    assert "terminal_exactly_once" in _named(spool)
+
+
+def test_verifier_names_lost_ticket(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.ensure_spool(spool)
+    journal.record(spool, "submitted", ticket="ghost", attempt=0,
+                   trace_id="tr-g")
+    journal.record(spool, "claimed", ticket="ghost", worker="w0",
+                   attempt=0, trace_id="tr-g")
+    # no terminal, no spool presence anywhere: the beam is GONE
+    assert "no_lost_ticket" in _named(spool)
+    # ... but a ticket still waiting at quiesce is NOT lost
+    protocol.write_ticket(spool, "waiting", ["/x"], "/o")
+    report = invariants.verify(spool)
+    assert report["checked"]["pending_at_quiesce"] == 1
+    assert not any(v["ticket"] == "waiting"
+                   for v in report["violations"])
+
+
+def test_verifier_names_quota_overshoot(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.ensure_spool(spool)
+    for tid in ("a", "b", "c"):
+        journal.record(spool, "submitted", ticket=tid, attempt=0,
+                       trace_id=f"tr-{tid}", tenant="cap2")
+        journal.record(spool, "claimed", ticket=tid, worker="w0",
+                       attempt=0, trace_id=f"tr-{tid}",
+                       tenant="cap2")
+    names = _named(spool, tenants={"cap2": {"max_inflight": 2}},
+                   quiesced=False)
+    assert "tenant_quota" in names
+    # under the cap: no violation
+    names = _named(spool, tenants={"cap2": {"max_inflight": 3}},
+                   quiesced=False)
+    assert "tenant_quota" not in names
+
+
+def test_verifier_names_reminted_trace_and_shared_trace(tmp_path):
+    spool = str(tmp_path / "spool")
+    _chain(spool, "a")
+    journal.record(spool, "drain_requeue", ticket="a",
+                   attempt=0, trace_id="tr-REMINTED",
+                   reason="drain")
+    assert "trace_minted_once" in _named(spool)
+    spool2 = str(tmp_path / "spool2")
+    _chain(spool2, "x", trace="shared")
+    _chain(spool2, "y", trace="shared")
+    assert "trace_minted_once" in _named(spool2)
+
+
+def test_verifier_names_orphaned_sidefile(tmp_path):
+    spool = str(tmp_path / "spool")
+    _chain(spool, "a")
+    with open(os.path.join(spool, "claimed",
+                           "a.json.claiming.12345"), "w") as fh:
+        fh.write("{}")
+    assert "no_orphan_sidefiles" in _named(spool)
+    # a LIVE audit must not flag transients (they are mid-flight)
+    assert "no_orphan_sidefiles" not in _named(spool,
+                                               quiesced=False)
+
+
+def test_verifier_names_attempts_violations(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.ensure_spool(spool)
+    # takeover that skipped a strike (attempt jumps 0 -> 2)
+    journal.record(spool, "submitted", ticket="a", attempt=0,
+                   trace_id="tr-a")
+    journal.record(spool, "claimed", ticket="a", worker="w0",
+                   attempt=0, trace_id="tr-a")
+    journal.record(spool, "takeover", ticket="a", attempt=2,
+                   trace_id="tr-a", from_worker="w0")
+    assert "attempts_monotone" in _named(spool, quiesced=False)
+    # quarantine below the cap
+    spool2 = str(tmp_path / "spool2")
+    protocol.ensure_spool(spool2)
+    journal.record(spool2, "submitted", ticket="q", attempt=0,
+                   trace_id="tr-q")
+    journal.record(spool2, "claimed", ticket="q", worker="w0",
+                   attempt=0, trace_id="tr-q")
+    journal.record(spool2, "takeover", ticket="q", attempt=1,
+                   trace_id="tr-q", from_worker="w0")
+    journal.record(spool2, "quarantined", ticket="q", attempt=1,
+                   trace_id="tr-q", max_attempts=3)
+    journal.record(spool2, "result", ticket="q", attempt=1,
+                   trace_id="tr-q", status="failed", rc=1)
+    protocol._atomic_write_json(
+        protocol.ticket_path(spool2, "q", "done"),
+        {"ticket": "q", "status": "failed",
+         "finished_at": time.time()})
+    assert "attempts_monotone" in _named(spool2, max_attempts=3)
+
+
+def test_verifier_counts_journal_gap_and_flags_corruption(tmp_path):
+    spool = str(tmp_path / "spool")
+    # a durable done record whose terminal event never landed (kill
+    # between the write and the append) is a counted GAP, not a
+    # violation — the spool truth fills it
+    protocol.ensure_spool(spool)
+    journal.record(spool, "submitted", ticket="a", attempt=0,
+                   trace_id="tr-a")
+    protocol._atomic_write_json(
+        protocol.ticket_path(spool, "a", "done"),
+        {"ticket": "a", "status": "done",
+         "finished_at": time.time()})
+    report = invariants.verify(spool)
+    assert report["ok"], report["violations"]
+    assert report["checked"]["journal_gaps"] == 1
+    assert report["checked"]["terminal"] == 1
+    # mid-file corruption IS reported, never silently skipped
+    with open(journal.journal_path(spool), "a") as fh:
+        fh.write("corrupt line no braces\n")
+    journal.record(spool, "submitted", ticket="b", attempt=0,
+                   trace_id="tr-b")
+    protocol.write_ticket(spool, "b2", ["/x"], "/o")
+    report = invariants.verify(spool)
+    assert any("unparseable" in v["detail"]
+               for v in report["violations"])
+
+
+def test_verifier_names_capacity_inconsistency(tmp_path):
+    spool = str(tmp_path / "spool")
+    _chain(spool, "a")
+    protocol._atomic_write_json(
+        os.path.join(spool, "fleet.json"),
+        {"capacity": None, "workers": [
+            {"id": "w0", "state": "fresh"}],
+         "external_workers": []})
+    assert "capacity_consistent" in _named(spool)
+
+
+def test_recovery_stats_computes_mttr_from_the_journal(tmp_path):
+    spool = str(tmp_path / "spool")
+    protocol.ensure_spool(spool)
+    t0 = time.time()
+    journal.record(spool, "submitted", ticket="v", attempt=0,
+                   trace_id="tr-v")
+    journal.record(spool, "claimed", ticket="v", worker="w0",
+                   attempt=0, trace_id="tr-v")
+    journal.record(spool, "chaos_action", action="kill_worker",
+                   worker="w0", t_rel=1.0)
+    journal.record(spool, "takeover", ticket="v", attempt=1,
+                   trace_id="tr-v", from_worker="w0")
+    journal.record(spool, "claimed", ticket="v", worker="w1",
+                   attempt=1, trace_id="tr-v")
+    journal.record(spool, "result", ticket="v", worker="w1",
+                   attempt=1, trace_id="tr-v", status="done", rc=0)
+    stats = invariants.recovery_stats(journal.read_events(spool))
+    assert len(stats["kills"]) == 1
+    kill = stats["kills"][0]
+    assert [v["ticket"] for v in kill["victims"]] == ["v"]
+    assert kill["mttr_s"] is not None and kill["mttr_s"] >= 0.0
+    assert stats["mttr_s"] == kill["mttr_s"]
+    assert stats["takeover_latency_s"] is not None
+    assert time.time() - t0 < 5.0
+
+
+def test_tail_verify_reports_live_and_stops_at_run_end(tmp_path):
+    spool = str(tmp_path / "spool")
+    _chain(spool, "a")
+    journal.record(spool, "result", ticket="a", worker="w1",
+                   attempt=0, trace_id="tr-a", status="done", rc=0)
+    journal.record(spool, "chaos_run_end", status="quiesced",
+                   quiesced=True)
+    lines = []
+    report = invariants.tail_verify(spool, poll_s=0.05,
+                                    timeout_s=5.0,
+                                    echo=lines.append)
+    assert any("terminal_exactly_once" in ln for ln in lines)
+    assert not report["ok"]
+    assert report["quiesced"]        # the run announced its end
+
+
+# --------------------------------------------------------------------
+# offset-tailed reads across both queue backends
+# --------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["spool", "memory"])
+def test_read_events_after_contract(backend, tmp_path):
+    from tpulsar.frontdoor import queue as fq
+    if backend == "spool":
+        q = fq.FilesystemSpoolQueue(str(tmp_path / "spool"))
+    else:
+        q = fq.MemoryTicketQueue("offset-test")
+    q.submit("t1", ["/x"], "/o")
+    evs, off = q.read_events_after(0)
+    assert [e["event"] for e in evs] == ["submitted"]
+    evs, off2 = q.read_events_after(off)
+    assert evs == [] and off2 == off
+    q.claim_next("w0")
+    q.write_result("t1", "done", worker="w0")
+    evs, _ = q.read_events_after(off, ticket="t1")
+    assert [e["event"] for e in evs] == ["claimed", "result"]
+
+
+# --------------------------------------------------------------------
+# the real thing: a multi-process mini-storm
+# --------------------------------------------------------------------
+
+def test_mini_storm_kill_recovers_exactly_once(tmp_path):
+    """2 real chaos-worker processes under a controller; w0 is
+    SIGKILLed mid-backlog and a spool.io window opens on w1 — every
+    beam must still end terminal exactly once, and the verifier must
+    agree from the journal alone."""
+    spool = str(tmp_path / "spool")
+    sc = scenario.from_dict({
+        "name": "mini", "seed": 3, "duration_s": 60.0,
+        "workers": 2, "worker_kind": "stub", "beam_s": 0.2,
+        "poll_s": 0.2,
+        "workload": {"beams": 6, "interval_s": 0.05},
+        "timeline": [
+            {"t": 0.4, "action": "kill_worker", "worker": "w0",
+             "signal": "KILL"},
+            {"t": 0.5, "action": "set_faults", "worker": "w1",
+             "until": 4.0,
+             "faults": "spool.io:unimplemented:count=1,errno=EIO"},
+        ],
+        "quiesce_timeout_s": 40.0})
+    manifest = runner.run_scenario(sc, spool)
+    assert manifest["quiesced"], manifest
+    assert len(manifest["tickets"]) == 6
+    for tid in manifest["tickets"]:
+        rec = protocol.read_result(spool, tid)
+        assert rec is not None and rec["status"] == "done", (tid, rec)
+    report = invariants.verify(spool, max_attempts=sc.max_attempts)
+    assert report["ok"], report["violations"]
+    assert report["checked"]["terminal"] == 6
+    # the kill is part of the journaled record
+    stats = invariants.recovery_stats(journal.read_events(spool))
+    assert len(stats["kills"]) == 1
+    # and the console renders
+    text = invariants.render_report(spool)
+    assert "kill w0" in text and "PASS" in text
+
+
+def test_chaos_cli_verify_flags_violations(tmp_path, capsys):
+    from tpulsar.cli.main import main as cli_main
+    spool = str(tmp_path / "spool")
+    _chain(spool, "a")
+    journal.record(spool, "result", ticket="a", worker="w1",
+                   attempt=0, trace_id="tr-a", status="done", rc=0)
+    rc = cli_main(["chaos", "verify", "--spool", spool])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "terminal_exactly_once" in out and "FAIL" in out
+    # a clean spool exits 0
+    spool2 = str(tmp_path / "spool2")
+    _chain(spool2, "b")
+    rc = cli_main(["chaos", "verify", "--spool", spool2])
+    assert rc == 0
+    assert "PASS" in capsys.readouterr().out

@@ -2,8 +2,9 @@
 counting + poisoned-beam quarantine, exactly-once claims under
 multi-process contention, aggregate admission control, and the
 controller's spawn/restart/janitor/drain/rolling-restart machinery
-(driven against tests/fleet_stub_worker.py — a protocol-faithful
-worker with millisecond beams and deterministic crashes)."""
+(driven against tpulsar/chaos/worker.py — the protocol-faithful
+stub worker with millisecond beams and deterministic crashes that
+the chaos harness conducts)."""
 
 import json
 import multiprocessing
@@ -22,8 +23,10 @@ from tpulsar.resilience import faults
 from tpulsar.serve import protocol
 from tpulsar.serve.server import SearchServer
 
-STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                    "fleet_stub_worker.py")
+# the protocol-faithful stub worker lives in the package now
+# (tpulsar/chaos/worker.py): controller tests and chaos scenarios
+# drive ONE implementation, so a protocol change cannot drift them
+_STUB_ARGV = [sys.executable, "-m", "tpulsar.chaos.worker"]
 
 
 @pytest.fixture(autouse=True)
@@ -50,7 +53,7 @@ def _reclaim(spool, tid, owner, worker=""):
 
 def _stub_cmd(spool, extra=()):
     def cmd(wid):
-        return [sys.executable, STUB, "--spool", spool,
+        return [*_STUB_ARGV, "--spool", spool,
                 "--worker-id", wid, *extra]
     return cmd
 
@@ -594,7 +597,7 @@ def test_spawn_failure_still_shuts_down_spawned_workers(tmp_path):
     def cmd(wid):
         if wid == "w1":
             raise RuntimeError("no binary for w1")
-        return [sys.executable, STUB, "--spool", spool,
+        return [*_STUB_ARGV, "--spool", spool,
                 "--worker-id", wid, "--beam-s", "0.05"]
 
     ctrl = _controller(spool, workers=2, worker_cmd=cmd,
@@ -616,7 +619,7 @@ def test_controller_crash_recovery_exactly_once(tmp_path):
 
     def cmd(wid):
         extra = ("--crash-after", "1") if wid == "w0" else ()
-        return [sys.executable, STUB, "--spool", spool,
+        return [*_STUB_ARGV, "--spool", spool,
                 "--worker-id", wid, "--once", "--beam-s", "0.1",
                 *extra]
 

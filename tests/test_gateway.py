@@ -171,6 +171,43 @@ def test_backpressure_429_when_queue_full(gw, q):
     assert client.capacity(gw.url)["capacity"] == 0
 
 
+def test_retry_after_jitter_is_seeded_and_spread(gw, q):
+    """Synchronized resubmitters must not herd: successive 429s
+    carry DIFFERENT retry hints, all within ±25% of the 5 s base,
+    and the integer Retry-After header mirrors the payload."""
+    q.heartbeat("w0", status="running", max_queue_depth=1)
+    client.submit_beam(gw.url, ["/data/a.fits"])      # fills depth 1
+    hints = []
+    for _ in range(8):
+        with pytest.raises(client.ClientError) as ei:
+            client.submit_beam(gw.url, ["/data/b.fits"])
+        assert ei.value.code == 429
+        hints.append(ei.value.retry_after_s)
+    assert len(set(hints)) > 1, hints          # spread, not a herd
+    assert all(3.75 <= h <= 6.25 for h in hints), hints
+    # deterministic: the same seed replays the same sequence
+    import urllib.error
+    req = urllib.request.Request(
+        gw.url + "/v1/beams",
+        data=json.dumps({"datafiles": ["/data/c.fits"]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei2:
+        urllib.request.urlopen(req, timeout=10)
+    assert 4 <= int(ei2.value.headers["Retry-After"]) <= 6
+    ei2.value.read()
+
+
+def test_client_retries_honor_the_jittered_hint(gw, q):
+    q.heartbeat("w0", status="running", max_queue_depth=1)
+    client.submit_beam(gw.url, ["/data/a.fits"])
+    slept = []
+    with pytest.raises(client.ClientError):
+        client.submit_beam(gw.url, ["/data/b.fits"], retries=2,
+                           sleep=slept.append)
+    assert len(slept) == 2                    # both budget uses
+    assert all(3.75 <= s <= 6.25 for s in slept), slept
+
+
 def test_tenant_max_pending_quota_429(gw, q):
     q.heartbeat("w0", status="running", max_queue_depth=8)
     client.submit_beam(gw.url, ["/a"], tenant="capped")

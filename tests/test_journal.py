@@ -95,6 +95,67 @@ def test_journal_skips_torn_lines_and_rotates(tmp_path, monkeypatch):
         == ["submitted", "claimed"]
 
 
+def test_journal_torn_tail_tolerance_contract(tmp_path):
+    """Exactly one TRAILING partial line per generation is skipped;
+    a later O_APPEND writer merging onto a torn prefix has its
+    complete record RECOVERED; pure mid-file garbage raises
+    JournalCorrupt (or lands in bad_lines for the auditor)."""
+    spool = str(tmp_path / "spool")
+    journal.record(spool, "submitted", ticket="a", attempt=0)
+    path = journal.journal_path(spool)
+    with open(path, "a") as fh:
+        fh.write('{"event": "claimed", "ticket": "a", "t":')  # torn
+    assert [e["event"] for e in journal.read_events(spool)] \
+        == ["submitted"]
+    # the next append lands on the same physical line: its record
+    # was durable and must be recovered, not lost to the wreckage
+    journal.record(spool, "result", ticket="a", attempt=0,
+                   status="done")
+    assert [e["event"] for e in journal.read_events(spool)] \
+        == ["submitted", "result"]
+    # a mid-file line that is garbage (no recoverable suffix) is
+    # CORRUPTION: raised by default, collected on request
+    with open(path, "a") as fh:
+        fh.write("not json at all\n")
+    journal.record(spool, "submitted", ticket="b", attempt=0)
+    with pytest.raises(journal.JournalCorrupt):
+        journal.read_events(spool)
+    bad = []
+    evs = journal.read_events(spool, bad_lines=bad)
+    assert len(bad) == 1 and len(evs) == 3
+
+
+def test_read_events_after_offset_tails_incrementally(tmp_path,
+                                                      monkeypatch):
+    spool = str(tmp_path / "spool")
+    journal.record(spool, "submitted", ticket="t", attempt=0)
+    evs, off = journal.read_events(spool, after_offset=0)
+    assert [e["event"] for e in evs] == ["submitted"] and off > 0
+    # nothing new: same offset back, no events
+    evs, off2 = journal.read_events(spool, after_offset=off)
+    assert evs == [] and off2 == off
+    journal.record(spool, "claimed", ticket="t", attempt=0)
+    evs, off3 = journal.read_events(spool, after_offset=off)
+    assert [e["event"] for e in evs] == ["claimed"]
+    # a torn trailing line is NOT consumed: the offset holds until
+    # the next writer completes the line, then both parse
+    with open(journal.journal_path(spool), "a") as fh:
+        fh.write('{"event": "res')
+    evs, off4 = journal.read_events(spool, after_offset=off3)
+    assert evs == [] and off4 == off3
+    journal.record(spool, "result", ticket="t", attempt=0,
+                   status="done")
+    evs, off5 = journal.read_events(spool, after_offset=off4)
+    assert [e["event"] for e in evs] == ["result"]
+    # rotation between polls: the unread tail is found in the .1
+    # generation, the new generation is read from its start
+    monkeypatch.setattr(journal, "MAX_BYTES", 1)
+    journal.record(spool, "submitted", ticket="u", attempt=0)
+    evs, _ = journal.read_events(spool, after_offset=off5)
+    assert [e["event"] for e in evs] == ["submitted"]
+    assert evs[0]["ticket"] == "u"
+
+
 def test_takeover_and_quarantine_chain(tmp_path):
     """A steal writes the crash evidence (takeover names the dead
     owner, attempt = the strike); the cap writes quarantined + ONE

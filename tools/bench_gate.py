@@ -63,6 +63,10 @@ DEFAULT_KEYS = (
     ("gateway.submit_to_result_p50_s", "lower"),
     ("gateway.submit_to_result_warm_s", "lower"),
     ("gateway.status_http_ms", "lower"),
+    ("chaos.mttr_s", "lower"),
+    ("chaos.takeover_latency_s", "lower"),
+    ("chaos.e2e_p95_chaos_s", "lower"),
+    ("chaos.e2e_p95_clean_s", "lower"),
 )
 
 

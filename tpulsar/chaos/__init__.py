@@ -1,0 +1,41 @@
+"""The chaos harness: reproducible fleet-wide failure storms and a
+journal-audited verifier of the serving stack's system invariants.
+
+Five layers of this codebase (resilience, serve, fleet, obs,
+frontdoor) each test their exactly-once/quota/trace guarantees in
+isolation; this package is where those guarantees are proven to
+COMPOSE under correlated, multi-process failure — the paper's
+every-beam-is-precious contract, continuously demonstrated instead of
+assumed:
+
+  scenario.py   — seeded, declarative chaos scenarios: a timeline of
+                  coordinated actions (SIGKILL/SIGSTOP a worker,
+                  restart the gateway, pause the janitor, open
+                  per-worker fault windows) plus a synthetic beam
+                  workload, serialized into ONE schedule file under
+                  ``<spool>/chaos/`` that every process's faults
+                  layer polls — one spec drives the whole fleet
+                  deterministically;
+  worker.py     — a protocol-complete, jax-free spool worker (claims,
+                  heartbeats, journal, drain, crash/fault points) so
+                  scenarios run dozens of beams in seconds;
+  runner.py     — the conductor: stand up a controller-supervised
+                  fleet (optionally behind the HTTP gateway), submit
+                  the workload, execute the schedule, quiesce/drain,
+                  write the run manifest;
+  invariants.py — the auditor: replay the ticket journal + spool
+                  state + result store and assert the system-level
+                  contract as NAMED, individually-reportable
+                  invariants (exactly one terminal per ticket, no
+                  ticket lost, attempts monotone with takeover = +1,
+                  no orphaned side-files, result-before-release,
+                  tenant quota never overshot, trace id minted once,
+                  capacity semantics) — the reusable oracle every
+                  future queue backend and streaming mode is judged
+                  against.
+
+Operator surface: ``tpulsar chaos run|verify|report``.
+stdlib + the jax-free tpulsar layers only.
+"""
+
+from tpulsar.chaos import invariants, scenario  # noqa: F401

@@ -1,0 +1,598 @@
+"""The system-invariant auditor: replay the evidence, name the crime.
+
+After (or during — ``--tail``) a chaos run, this module replays the
+ticket journal, the spool state, and the result store, and asserts
+the serving stack's SYSTEM-level contract as named, individually
+reportable invariants.  Per-layer tests prove each mechanism in
+isolation; this auditor is the oracle that proves they still compose
+when workers die mid-beam, the disk refuses writes, and the gateway
+restarts — and it is deliberately reusable: any future queue backend
+or streaming mode that claims the ticket contract is judged against
+exactly this list.
+
+The invariants (violation ``invariant`` field -> meaning):
+
+  terminal_exactly_once   every submitted beam has EXACTLY one
+                          terminal ``result`` journal event — zero
+                          is a beam the fleet dropped, two is a beam
+                          it double-processed (survey completeness
+                          corrupts silently either way)
+  no_lost_ticket          submitted => terminal, quarantined, still
+                          pending/claimed at quiesce, or a clean
+                          ``submit_failed`` refusal; a ticket with
+                          no terminal AND no spool presence is LOST
+  attempts_monotone       attempts never decrease; the k-th takeover
+                          carries attempt k (every strike is +1);
+                          quarantine happens exactly at the cap,
+                          never below it; the terminal attempt
+                          matches the final claim's (or the
+                          quarantine strike's)
+  result_before_release   the terminal event ends its chain; a
+                          terminal ticket has a durable done/ record;
+                          at quiesce nothing is both done and still
+                          claimed/pending
+  no_orphan_sidefiles     after quiesce no ``.claiming.<pid>`` /
+                          ``.takeover.<pid>`` / ``.tmp`` transients
+                          remain — every crashed two-rename was
+                          reconciled
+  tenant_quota            per-tenant in-flight, reconstructed from
+                          the journal's claim/release instants, never
+                          exceeds ``max_inflight`` at ANY instant
+  trace_minted_once       one trace id per ticket, constant across
+                          every steal/requeue, and never shared by
+                          two tickets (re-minting would sever the
+                          cross-worker timeline)
+  capacity_consistent     fleet.json's advertised capacity agrees
+                          with its own worker states (None/-1 shed
+                          only with zero fresh workers, else >= 0)
+  journal_integrity       the journal parses (one trailing torn line
+                          per generation is expected wreckage;
+                          anything else is corruption), and disk
+                          state implied by it exists (a done record
+                          without its terminal event is a lost
+                          append)
+
+``verify()`` is the one entry point; ``tail_verify()`` runs the
+online subset while a run is still in flight (riding
+``journal.read_events(after_offset=)``); ``recovery_stats()``
+extracts MTTR from the conductor's journaled kill actions (the
+bench/v2 ``chaos`` key reads it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from tpulsar.obs import journal
+from tpulsar.serve import protocol
+
+#: invariant name -> one-line contract (docs/operations.md renders
+#: this table; keep the names stable — they are the report API)
+INVARIANTS = {
+    "terminal_exactly_once":
+        "exactly one terminal 'result' event per submitted ticket",
+    "no_lost_ticket":
+        "submitted => terminal | quarantined | pending at quiesce | "
+        "submit_failed",
+    "attempts_monotone":
+        "attempts never decrease; takeover k carries attempt k; "
+        "quarantine only at the cap",
+    "result_before_release":
+        "terminal ends the chain; durable done/ record backs it; "
+        "nothing both done and in-flight at quiesce",
+    "no_orphan_sidefiles":
+        "no .claiming/.takeover/.tmp transients survive quiesce",
+    "tenant_quota":
+        "reconstructed per-tenant inflight never exceeds "
+        "max_inflight at any journal instant",
+    "trace_minted_once":
+        "one trace id per ticket, constant across steals, unique "
+        "across tickets",
+    "capacity_consistent":
+        "advertised fleet capacity matches worker freshness "
+        "(shed only at zero fresh workers)",
+    "journal_integrity":
+        "journal parses (single torn tail per generation tolerated; "
+        "a kill between durable result and journal append is a "
+        "counted gap, not a violation) and chains start at "
+        "submission",
+}
+
+#: events that RELEASE a claim (close an inflight interval)
+_RELEASES = ("takeover", "drain_requeue", "quarantined",
+             journal.TERMINAL_EVENT)
+
+
+def _v(invariant: str, ticket: str = "", detail: str = "") -> dict:
+    return {"invariant": invariant, "ticket": ticket,
+            "detail": detail}
+
+
+def _ticket_tenant(events: list[dict]) -> str:
+    for ev in events:
+        t = ev.get("tenant")
+        if t:
+            return t
+    return "default"
+
+
+def _spool_presence(spool: str, tid: str) -> dict:
+    """Which states physically hold the ticket right now."""
+    out = {}
+    for state in ("incoming", "claimed", "done", "quarantine"):
+        out[state] = os.path.exists(
+            protocol.ticket_path(spool, tid, state))
+    return out
+
+
+def _audit_chain(tid: str, events: list[dict], presence: dict,
+                 max_attempts: int, quiesced: bool) -> list[dict]:
+    """The per-ticket audits (everything except the cross-ticket
+    quota/trace/sidefile/capacity sweeps)."""
+    out: list[dict] = []
+    names = [e.get("event") for e in events]
+
+    if "submit_failed" in names:
+        extra = [n for n in names if n not in
+                 ("received", "submitted", "submit_failed")]
+        if extra:
+            out.append(_v("no_lost_ticket", tid,
+                          f"events after a failed submission: "
+                          f"{extra}"))
+        return out
+    if "submitted" not in names:
+        # a gateway-edge 'received' whose process died before the
+        # spool write: an accounted near-miss, not a lost beam —
+        # unless something DID happen to a ticket never submitted
+        if set(names) - {"received"}:
+            out.append(_v("journal_integrity", tid,
+                          f"chain without 'submitted': {names}"))
+        return out
+
+    terminals = [i for i, e in enumerate(events)
+                 if e.get("event") == journal.TERMINAL_EVENT]
+    if len(terminals) > 1:
+        out.append(_v("terminal_exactly_once", tid,
+                      f"{len(terminals)} terminal result events"))
+    elif len(terminals) == 1 and terminals[0] != len(events) - 1:
+        tail = [e.get("event") for e in events[terminals[0] + 1:]]
+        out.append(_v("result_before_release", tid,
+                      f"events after the terminal: {tail}"))
+    if not terminals:
+        # presence["done"] with no terminal event is NOT a violation:
+        # the journal is observational, appended AFTER the durable
+        # result — a SIGKILL (or journal.append fault) in that window
+        # loses only the evidence, and the spool truth fills the gap.
+        # verify() counts these as journal_gaps.
+        if not presence["done"] and quiesced \
+                and not (presence["incoming"]
+                         or presence["claimed"]
+                         or presence["quarantine"]):
+            out.append(_v("no_lost_ticket", tid,
+                          "no terminal event and no spool presence "
+                          f"(chain: {names})"))
+    else:
+        if not presence["done"]:
+            out.append(_v("result_before_release", tid,
+                          "terminal event without a durable done/ "
+                          "record"))
+        if quiesced and (presence["incoming"] or presence["claimed"]):
+            where = [s for s in ("incoming", "claimed")
+                     if presence[s]]
+            out.append(_v("result_before_release", tid,
+                          f"terminal ticket still present in "
+                          f"{where} after quiesce"))
+
+    # ---- attempts discipline
+    claims = [e for e in events if e.get("event") == "claimed"]
+    takeovers = [e for e in events if e.get("event") == "takeover"]
+    quarantine = next((e for e in events
+                       if e.get("event") == "quarantined"), None)
+    c_atts = [int(e.get("attempt", 0)) for e in claims]
+    t_atts = sorted(int(e.get("attempt", 0)) for e in takeovers)
+    if any(b < a for a, b in zip(c_atts, c_atts[1:])):
+        out.append(_v("attempts_monotone", tid,
+                      f"claim attempts decreased: {c_atts}"))
+    if t_atts != list(range(1, len(t_atts) + 1)):
+        out.append(_v("attempts_monotone", tid,
+                      f"takeover strikes not consecutive +1: "
+                      f"{t_atts}"))
+    if c_atts and max(c_atts) > len(t_atts):
+        out.append(_v("attempts_monotone", tid,
+                      f"claim attempt {max(c_atts)} exceeds "
+                      f"{len(t_atts)} recorded takeover(s)"))
+    if quarantine is not None:
+        q_att = int(quarantine.get("attempt", 0))
+        cap = int(quarantine.get("max_attempts", max_attempts))
+        if q_att < cap:
+            out.append(_v("attempts_monotone", tid,
+                          f"quarantined at attempt {q_att}, below "
+                          f"the cap {cap}"))
+    if len(terminals) == 1:
+        term = events[terminals[0]]
+        term_att = int(term.get("attempt", 0))
+        if quarantine is not None:
+            expect = int(quarantine.get("attempt", 0))
+        elif c_atts:
+            expect = c_atts[-1]
+        else:
+            out.append(_v("attempts_monotone", tid,
+                          "terminal result without any claim or "
+                          "quarantine"))
+            expect = term_att
+        if term_att != expect:
+            out.append(_v("attempts_monotone", tid,
+                          f"terminal attempt {term_att} != expected "
+                          f"{expect}"))
+    return out
+
+
+def _quota_sweep(per_ticket: dict[str, list[dict]],
+                 done_recs: dict[str, dict],
+                 tenants: dict) -> list[dict]:
+    """Reconstruct per-tenant inflight from claim/release instants
+    and flag any instant above ``max_inflight``.  A result's release
+    instant is the done record's ``finished_at`` when available: the
+    claim file is unlinked BETWEEN the durable write and the journal
+    append, so the event timestamp alone would overcount a tenant
+    whose next claim squeezed into that gap."""
+    caps = {}
+    for name, spec in (tenants or {}).items():
+        cap = int((spec or {}).get("max_inflight", 0))
+        if cap > 0:
+            caps[name] = cap
+    if not caps:
+        return []
+    points: list[tuple[float, int, str, str]] = []
+    for tid, events in per_ticket.items():
+        tenant = _ticket_tenant(events)
+        if tenant not in caps:
+            continue
+        open_t = None
+        for ev in events:
+            name = ev.get("event")
+            if name == "claimed":
+                if open_t is None:
+                    open_t = ev.get("t", 0.0)
+            elif name in _RELEASES and open_t is not None:
+                end = ev.get("t", 0.0)
+                if name == journal.TERMINAL_EVENT:
+                    fin = (done_recs.get(tid) or {}).get("finished_at")
+                    if fin:
+                        end = min(end, float(fin))
+                points.append((open_t, +1, tenant, tid))
+                points.append((end, -1, tenant, tid))
+                open_t = None
+        if open_t is not None:        # still claimed at audit time
+            points.append((open_t, +1, tenant, tid))
+    # releases sort before acquires at the same instant: a handoff at
+    # one timestamp is a handoff, not a double-occupancy
+    points.sort(key=lambda p: (p[0], p[1]))
+    out, inflight = [], {}
+    flagged = set()
+    for t, delta, tenant, tid in points:
+        n = inflight.get(tenant, 0) + delta
+        inflight[tenant] = n
+        if delta > 0 and n > caps[tenant] and tenant not in flagged:
+            flagged.add(tenant)
+            out.append(_v("tenant_quota", tid,
+                          f"tenant {tenant!r} reached {n} inflight "
+                          f"(max_inflight {caps[tenant]}) at "
+                          f"t={t:.3f}"))
+    return out
+
+
+def _sidefile_sweep(spool: str) -> list[dict]:
+    out = []
+    for state in ("incoming", "claimed", "done", "quarantine"):
+        d = os.path.join(spool, state)
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for name in names:
+            if name.endswith(".tmp") or ".json.claiming." in name \
+                    or ".json.takeover." in name:
+                out.append(_v(
+                    "no_orphan_sidefiles",
+                    name.split(".json")[0],
+                    f"{state}/{name} survived quiesce"))
+    return out
+
+
+def _capacity_check(spool: str) -> list[dict]:
+    rec = protocol._read_json(os.path.join(spool, "fleet.json"))
+    if rec is None:
+        return []
+    cap = rec.get("capacity")
+    fresh = [w["id"] for w in rec.get("workers", ())
+             if w.get("state") == "fresh"]
+    external = rec.get("external_workers") or []
+    if cap is None:
+        if fresh and not external:
+            return [_v("capacity_consistent", "",
+                       f"capacity advertised as load-shed (None/-1) "
+                       f"with fresh worker(s) {fresh} in the same "
+                       f"snapshot")]
+    elif cap < 0:
+        return [_v("capacity_consistent", "",
+                   f"negative non-shed capacity {cap}")]
+    return []
+
+
+def verify(spool: str, *, tenants: dict | None = None,
+           max_attempts: int = protocol.DEFAULT_MAX_ATTEMPTS,
+           quiesced: bool = True) -> dict:
+    """Run every invariant over the spool's journal + state.
+
+    ``quiesced=False`` (a live or aborted run) skips the judgments
+    that only hold after drain: lost-ticket (it may still be in
+    flight), leftover side-files, and done-but-still-claimed.
+    Returns ``{"ok", "violations", "invariants", "checked"}``."""
+    bad_lines: list = []
+    violations: list[dict] = []
+    events = journal.read_events(spool, bad_lines=bad_lines)
+    for bad in bad_lines:
+        violations.append(_v(
+            "journal_integrity", "",
+            f"unparseable mid-file line {bad['line']} of "
+            f"{os.path.basename(bad['path'])}: {bad['text'][:80]!r}"))
+    per_ticket = journal.iter_tickets(events)
+    done_recs = {tid: protocol.read_result(spool, tid) or {}
+                 for tid in per_ticket}
+
+    traces: dict[str, set] = {}
+    counts = {"tickets": len(per_ticket), "events": len(events),
+              "terminal": 0, "pending_at_quiesce": 0,
+              "submit_failed": 0, "takeovers": 0, "quarantined": 0,
+              "journal_gaps": 0}
+    for tid, evs in sorted(per_ticket.items()):
+        presence = _spool_presence(spool, tid)
+        violations.extend(_audit_chain(tid, evs, presence,
+                                       max_attempts, quiesced))
+        names = [e.get("event") for e in evs]
+        if journal.TERMINAL_EVENT in names:
+            counts["terminal"] += 1
+        elif "submit_failed" in names:
+            counts["submit_failed"] += 1
+        elif presence["done"]:
+            # terminal on disk, evidence lost in the kill window —
+            # see _audit_chain; surfaced here so a run with gaps is
+            # visibly different from one without
+            counts["terminal"] += 1
+            counts["journal_gaps"] += 1
+        elif presence["incoming"] or presence["claimed"]:
+            counts["pending_at_quiesce"] += 1
+        counts["takeovers"] += names.count("takeover")
+        counts["quarantined"] += names.count("quarantined")
+        ids = {e["trace_id"] for e in evs if e.get("trace_id")}
+        if len(ids) > 1:
+            violations.append(_v(
+                "trace_minted_once", tid,
+                f"{len(ids)} distinct trace ids in one chain: "
+                f"{sorted(ids)}"))
+        elif not ids and "submitted" in names:
+            violations.append(_v("trace_minted_once", tid,
+                                 "no trace id anywhere in the chain"))
+        for tr in ids:
+            traces.setdefault(tr, set()).add(tid)
+    for tr, tids in sorted(traces.items()):
+        if len(tids) > 1:
+            violations.append(_v(
+                "trace_minted_once", ",".join(sorted(tids)),
+                f"trace id {tr} shared by {len(tids)} tickets"))
+
+    violations.extend(_quota_sweep(per_ticket, done_recs, tenants))
+    if quiesced:
+        violations.extend(_sidefile_sweep(spool))
+    violations.extend(_capacity_check(spool))
+
+    by_inv = {name: 0 for name in INVARIANTS}
+    for v in violations:
+        by_inv[v["invariant"]] = by_inv.get(v["invariant"], 0) + 1
+    return {"ok": not violations, "violations": violations,
+            "invariants": by_inv, "checked": counts,
+            "spool": spool, "quiesced": quiesced}
+
+
+# ------------------------------------------------------------ live tail
+
+def tail_verify(spool: str, *, tenants: dict | None = None,
+                max_attempts: int = protocol.DEFAULT_MAX_ATTEMPTS,
+                poll_s: float = 0.5, timeout_s: float = 0.0,
+                echo=print, _stop=None) -> dict:
+    """Follow the journal by offset and audit incrementally: chain,
+    trace, and quota violations are reported the moment the evidence
+    lands, not at the post-mortem.  Ends at a ``chaos_run_end``
+    event, the optional timeout, Ctrl-C — or ``_stop()`` returning
+    True (tests) — then runs one full ``verify`` (quiesced iff the
+    run announced its end) and returns its report."""
+    offset = 0
+    seen: set[tuple] = set()
+    ended = False
+    per_ticket: dict[str, list[dict]] = {}
+    traces: dict[str, set] = {}
+    deadline = time.time() + timeout_s if timeout_s else None
+
+    def _report(v: dict) -> None:
+        key = (v["invariant"], v["ticket"], v["detail"])
+        if key not in seen:
+            seen.add(key)
+            echo(f"[{v['invariant']}] {v['ticket'] or '-'}: "
+                 f"{v['detail']}")
+
+    try:
+        while True:
+            try:
+                new, offset = journal.read_events(
+                    spool, after_offset=offset)
+            except journal.JournalCorrupt as e:
+                echo(f"[journal_integrity] {e}")
+                break
+            # incremental: only the chains the new batch touched are
+            # re-audited — the poll cost is O(new events), not a full
+            # journal replay per batch (cross-ticket sweeps like the
+            # quota reconstruction wait for the final full verify)
+            touched: set[str] = set()
+            for ev in new:
+                if ev.get("event") == "chaos_run_end":
+                    ended = True
+                tid = ev.get("ticket")
+                if tid:
+                    per_ticket.setdefault(tid, []).append(ev)
+                    touched.add(tid)
+            for tid in sorted(touched):
+                evs = per_ticket[tid]
+                presence = _spool_presence(spool, tid)
+                for v in _audit_chain(tid, evs, presence,
+                                      max_attempts, quiesced=False):
+                    _report(v)
+                ids = {e["trace_id"] for e in evs
+                       if e.get("trace_id")}
+                if len(ids) > 1:
+                    _report(_v("trace_minted_once", tid,
+                               f"{len(ids)} distinct trace ids in "
+                               f"one chain: {sorted(ids)}"))
+                for tr in ids:
+                    tids = traces.setdefault(tr, set())
+                    tids.add(tid)
+                    if len(tids) > 1:
+                        _report(_v(
+                            "trace_minted_once",
+                            ",".join(sorted(tids)),
+                            f"trace id {tr} shared by "
+                            f"{len(tids)} tickets"))
+            if ended or (deadline and time.time() >= deadline) \
+                    or (_stop is not None and _stop()):
+                break
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        pass
+    return verify(spool, tenants=tenants, max_attempts=max_attempts,
+                  quiesced=ended)
+
+
+# --------------------------------------------------------- MTTR / report
+
+def recovery_stats(events: list[dict]) -> dict:
+    """Recovery timing extracted from the journal alone: for every
+    conductor-journaled worker kill, the victims are the tickets that
+    worker held at the kill instant — MTTR is kill -> their terminal
+    event (takeover latency is the janitor's share of it)."""
+    per_ticket = journal.iter_tickets(events)
+    kills = [e for e in events
+             if e.get("event") == "chaos_action"
+             and e.get("action") == "kill_worker"]
+    out = {"kills": [], "mttr_s": None, "takeover_latency_s": None}
+    for kill in kills:
+        w, t_kill = kill.get("worker", ""), kill.get("t", 0.0)
+        victims = []
+        for tid, evs in per_ticket.items():
+            holder, held_since = None, None
+            for ev in evs:
+                if ev.get("t", 0.0) > t_kill:
+                    break
+                name = ev.get("event")
+                if name == "claimed":
+                    holder = ev.get("worker", "")
+                    held_since = ev.get("t")
+                elif name in _RELEASES:
+                    holder = None
+            if holder != w:
+                continue
+            term = next((e for e in evs
+                         if e.get("event") == journal.TERMINAL_EVENT
+                         and e.get("t", 0.0) >= t_kill), None)
+            steal = next((e for e in evs
+                          if e.get("event") == "takeover"
+                          and e.get("t", 0.0) >= t_kill), None)
+            victims.append({
+                "ticket": tid, "held_since": held_since,
+                "takeover_s": (round(steal["t"] - t_kill, 3)
+                               if steal else None),
+                "recovered_s": (round(term["t"] - t_kill, 3)
+                                if term else None)})
+        rec = {"worker": w, "t": t_kill, "victims": victims}
+        done = [v["recovered_s"] for v in victims
+                if v["recovered_s"] is not None]
+        steals = [v["takeover_s"] for v in victims
+                  if v["takeover_s"] is not None]
+        rec["mttr_s"] = max(done) if done else None
+        rec["takeover_latency_s"] = min(steals) if steals else None
+        out["kills"].append(rec)
+    mttrs = [k["mttr_s"] for k in out["kills"]
+             if k["mttr_s"] is not None]
+    lats = [k["takeover_latency_s"] for k in out["kills"]
+            if k["takeover_latency_s"] is not None]
+    if mttrs:
+        out["mttr_s"] = max(mttrs)
+    if lats:
+        out["takeover_latency_s"] = max(lats)
+    return out
+
+
+def render_verify(report: dict) -> str:
+    lines = [f"chaos verify: {report['spool']} "
+             f"({'quiesced' if report['quiesced'] else 'LIVE'})"]
+    c = report["checked"]
+    lines.append(
+        f"  {c['tickets']} tickets / {c['events']} events: "
+        f"{c['terminal']} terminal, {c['pending_at_quiesce']} "
+        f"pending, {c['submit_failed']} submit-failed, "
+        f"{c['takeovers']} takeover(s), {c['quarantined']} "
+        f"quarantined, {c['journal_gaps']} journal gap(s)")
+    width = max(len(n) for n in INVARIANTS)
+    for name in INVARIANTS:
+        n = report["invariants"].get(name, 0)
+        mark = "ok " if n == 0 else "VIOLATED"
+        lines.append(f"  [{mark:>8s}] {name:<{width}s} "
+                     + (f"({n})" if n else ""))
+    for v in report["violations"]:
+        lines.append(f"    {v['invariant']}: {v['ticket'] or '-'}: "
+                     f"{v['detail']}")
+    lines.append("PASS: 0 invariant violations" if report["ok"]
+                 else f"FAIL: {len(report['violations'])} "
+                      f"violation(s)")
+    return "\n".join(lines)
+
+
+def render_report(spool: str) -> str:
+    """The post-run digest: the conductor's manifest, the journal's
+    per-status counts, recovery timing, and the invariant verdict."""
+    from tpulsar.chaos import scenario as scenario_mod
+    lines = [f"chaos report: {spool}"]
+    manifest = protocol._read_json(scenario_mod.run_path(spool))
+    if manifest:
+        lines.append(
+            f"  scenario {manifest.get('scenario', '?')!r} seed "
+            f"{manifest.get('seed')} — {manifest.get('status', '?')}"
+            f" in {manifest.get('wall_s', 0):.1f} s, "
+            f"{len(manifest.get('actions', []))} action(s), "
+            f"{len(manifest.get('tickets', []))} beam(s)")
+        for a in manifest.get("actions", []):
+            lines.append(
+                f"    t+{a.get('t', 0):6.2f}  {a.get('action'):16s} "
+                f"{a.get('worker', '') or '-':6s} "
+                f"{a.get('detail', '')}")
+    else:
+        lines.append("  (no run manifest — verify-only spool)")
+    events = journal.read_events(spool, bad_lines=[])
+    summary = journal.summarize(spool)
+    lines.append(f"  statuses: {summary['statuses']}  takeovers: "
+                 f"{summary['takeovers']}  quarantined: "
+                 f"{summary['quarantined']}")
+    rec = recovery_stats(events)
+    for k in rec["kills"]:
+        lines.append(
+            f"  kill {k['worker']}: {len(k['victims'])} victim "
+            f"beam(s), takeover latency "
+            f"{k['takeover_latency_s'] if k['takeover_latency_s'] is not None else '-'} s, "
+            f"mttr {k['mttr_s'] if k['mttr_s'] is not None else '-'} s")
+    tenants = (manifest or {}).get("tenants") or {}
+    report = verify(spool, tenants=tenants,
+                    quiesced=bool((manifest or {}).get("quiesced",
+                                                       True)))
+    lines.append(render_verify(report))
+    return "\n".join(lines)

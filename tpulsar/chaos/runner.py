@@ -1,0 +1,333 @@
+"""The chaos conductor: one scenario in, one auditable run out.
+
+``ChaosRunner.run()`` stands up a controller-supervised fleet on a
+spool (optionally fronted by the HTTP gateway), submits the
+scenario's synthetic beam workload, executes the timeline — kills,
+SIGSTOPs, gateway restarts, janitor pauses, while the schedule file
+opens the per-worker fault windows inside the workers themselves —
+then quiesces (every submitted beam terminal, or the timeout),
+drains the fleet, and writes the run manifest to
+``<spool>/chaos/run.json``.
+
+Everything the conductor DOES is journaled as ``chaos_action``
+events, bracketed by ``chaos_run_start``/``chaos_run_end``: the
+run's own violence is part of the same evidence stream the
+invariant auditor replays, which is how MTTR ("kill at t, victim
+terminal at t+x") falls out of the journal with no side channel.
+
+The conductor's faults layer is NOT armed: fault windows address
+workers (the processes under test); the conductor must keep
+observing and submitting through the storm it causes.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+
+from tpulsar.chaos import scenario as scenario_mod
+from tpulsar.obs import journal, telemetry
+from tpulsar.obs.log import get_logger
+from tpulsar.serve import protocol
+
+_SIGNALS = {"KILL": signal.SIGKILL, "TERM": signal.SIGTERM,
+            "STOP": signal.SIGSTOP, "CONT": signal.SIGCONT}
+
+
+class ChaosRunner:
+    def __init__(self, sc: scenario_mod.Scenario, spool: str, *,
+                 worker_extra_args: tuple[str, ...] = (),
+                 logger=None, sleeper=time.sleep):
+        self.sc = sc
+        self.spool = protocol.ensure_spool(spool)
+        self.worker_extra_args = tuple(worker_extra_args)
+        self.log = logger or get_logger("chaos")
+        self.sleeper = sleeper
+        self.gateway = None
+        self._gateway_port = 0
+        self._ctrl = None
+        self._ctrl_thread: threading.Thread | None = None
+        self._stopped_pids: set[int] = set()
+        self.tickets: list[str] = []
+        self.actions: list[dict] = []
+
+    # ------------------------------------------------------------- fleet
+
+    def _worker_cmd(self, worker_id: str) -> list[str]:
+        import sys
+        if self.sc.worker_kind == "stub":
+            return [sys.executable, "-m", "tpulsar.chaos.worker",
+                    "--spool", self.spool, "--worker-id", worker_id,
+                    "--beam-s", str(self.sc.beam_s),
+                    "--max-attempts", str(self.sc.max_attempts),
+                    *self.worker_extra_args]
+        argv = [sys.executable, "-m", "tpulsar.cli"]
+        cfgpath = os.environ.get("TPULSAR_CONFIG")
+        if cfgpath:
+            argv += ["--config", cfgpath]
+        argv += ["serve", "--spool", self.spool,
+                 "--worker-id", worker_id, "--no-warmstart",
+                 *self.worker_extra_args]
+        return argv
+
+    def _worker_env(self, worker_id: str) -> dict:
+        import json as _json
+        env = {"TPULSAR_CHAOS_SCHEDULE":
+               scenario_mod.schedule_path(self.spool),
+               "TPULSAR_CHAOS_WORKER": worker_id}
+        if self.sc.tenants:
+            env["TPULSAR_CHAOS_TENANTS"] = _json.dumps(
+                self.sc.tenants)
+        return env
+
+    def _start_fleet(self):
+        from tpulsar.fleet.controller import FleetController
+        self._ctrl = FleetController(
+            self.spool, workers=self.sc.workers,
+            worker_cmd=self._worker_cmd,
+            worker_env=self._worker_env,
+            max_worker_restarts=self.sc.max_worker_restarts,
+            ticket_max_attempts=self.sc.max_attempts,
+            poll_s=self.sc.poll_s,
+            drain_timeout_s=20.0, logger=self.log)
+        self._ctrl_thread = threading.Thread(
+            target=self._ctrl.run, name="chaos-fleet", daemon=True)
+        self._ctrl_thread.start()
+
+    def _start_gateway(self, port: int = 0):
+        from tpulsar.frontdoor.gateway import GatewayServer
+        from tpulsar.frontdoor.queue import FilesystemSpoolQueue
+        from tpulsar.frontdoor.tenancy import TenantPolicy
+        self.gateway = GatewayServer(
+            queue=FilesystemSpoolQueue(self.spool),
+            policy=TenantPolicy(self.sc.tenants),
+            port=port,
+            outdir_base=os.path.join(
+                scenario_mod.chaos_dir(self.spool), "out"),
+            retry_jitter_seed=self.sc.seed).start()
+        self._gateway_port = self.gateway.port
+
+    def _wait_fleet_fresh(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if len(protocol.fresh_workers(self.spool)) \
+                    >= self.sc.workers:
+                return True
+            self.sleeper(0.1)
+        return False
+
+    # ----------------------------------------------------------- actions
+
+    def _journal_action(self, t_rel: float, action: str,
+                        worker: str = "", **extra) -> None:
+        rec = {"t": round(t_rel, 3), "action": action,
+               "worker": worker, **extra}
+        self.actions.append(rec)
+        telemetry.chaos_actions_total().inc(action=action)
+        journal.record(self.spool, "chaos_action", action=action,
+                       worker=worker, t_rel=round(t_rel, 3), **extra)
+        self.log.info("chaos t+%.2f: %s %s %s", t_rel, action,
+                      worker or "-", extra or "")
+
+    def _worker_pid(self, worker_id: str) -> int | None:
+        hb = protocol.read_heartbeat(self.spool, worker_id)
+        pid = (hb or {}).get("pid")
+        return int(pid) if pid else None
+
+    def _do_action(self, a: scenario_mod.Action,
+                   t_rel: float) -> None:
+        if a.action in ("kill_worker", "stop_worker", "cont_worker"):
+            pid = self._worker_pid(a.worker)
+            if pid is None:
+                self._journal_action(t_rel, a.action, a.worker,
+                                     detail="no heartbeat pid — "
+                                            "skipped")
+                return
+            sig = {"kill_worker": _SIGNALS[a.signal.upper()],
+                   "stop_worker": signal.SIGSTOP,
+                   "cont_worker": signal.SIGCONT}[a.action]
+            try:
+                os.kill(pid, sig)
+            except OSError as e:
+                self._journal_action(t_rel, a.action, a.worker,
+                                     pid=pid, detail=f"kill failed: "
+                                                     f"{e}")
+                return
+            if a.action == "stop_worker":
+                self._stopped_pids.add(pid)
+            elif a.action == "cont_worker":
+                self._stopped_pids.discard(pid)
+            self._journal_action(
+                t_rel, a.action, a.worker, pid=pid,
+                **({"signal": a.signal.upper()}
+                   if a.action == "kill_worker" else {}))
+        elif a.action == "restart_gateway":
+            if self.gateway is None:
+                self._journal_action(t_rel, a.action,
+                                     detail="no gateway — skipped")
+                return
+            port = self._gateway_port
+            self.gateway.stop()
+            self._start_gateway(port=port)
+            self._journal_action(t_rel, a.action, port=port)
+        elif a.action == "pause_janitor":
+            self._ctrl.pause_janitor(a.seconds)
+            self._journal_action(t_rel, a.action,
+                                 seconds=a.seconds)
+
+    # ---------------------------------------------------------- workload
+
+    def _submit(self, i: int, t_rel: float) -> None:
+        wl = self.sc.workload
+        datafiles = list(wl.datafiles or ["chaos://synthetic"])
+        outdir = os.path.join(scenario_mod.chaos_dir(self.spool),
+                              "out", f"beam{i:03d}")
+        if wl.via == "gateway":
+            from tpulsar.frontdoor import client
+            # the gateway may be mid-restart at this instant — that
+            # is the point; a refused connection is retried briefly,
+            # a 429 honors the jittered Retry-After
+            last: Exception | None = None
+            for _ in range(8):
+                try:
+                    rec = client.submit_beam(
+                        self.gateway.url, datafiles, outdir=outdir,
+                        tenant=wl.tenant, priority=wl.priority,
+                        job_id=i, retries=2)
+                    self.tickets.append(rec["ticket"])
+                    return
+                except client.ClientError as e:
+                    last = e
+                    if e.code == 503:
+                        self.sleeper(0.2)   # shed: fleet mid-recovery
+                        continue
+                    break
+                except OSError as e:        # connection refused
+                    last = e
+                    self.sleeper(0.2)
+            self._journal_action(t_rel, "submit_refused",
+                                 detail=str(last)[:120], beam=i)
+            return
+        tid = f"{self.sc.name}-{i:03d}"
+        extra = {"beam_s": self.sc.beam_s}
+        if wl.tenant:
+            extra["tenant"] = wl.tenant
+        if wl.priority not in (None, ""):
+            extra["priority"] = wl.priority
+        try:
+            protocol.write_ticket(self.spool, tid, datafiles, outdir,
+                                  job_id=i, **extra)
+            self.tickets.append(tid)
+        except OSError as e:
+            self._journal_action(t_rel, "submit_refused",
+                                 detail=str(e)[:120], beam=i)
+
+    # ------------------------------------------------------------ driver
+
+    def run(self) -> dict:
+        sc = self.sc
+        os.makedirs(scenario_mod.chaos_dir(self.spool),
+                    exist_ok=True)
+        t0 = time.time()
+        # placeholder (no entries): workers must FIND the schedule at
+        # boot, but no window may open until the workload anchor
+        scenario_mod.write_schedule(self.spool, sc, t0, arm=False)
+        self._start_fleet()
+        status = "aborted"
+        quiesced = False
+        try:
+            if not self._wait_fleet_fresh():
+                raise RuntimeError(
+                    f"fleet never became fresh ({sc.workers} "
+                    f"worker(s)) — check "
+                    f"{self.spool}/workers/*.log")
+            if sc.gateway:
+                self._start_gateway()
+            # the schedule's t0 is re-anchored to the WORKLOAD start:
+            # scenario times mean "seconds into the storm", and fleet
+            # boot must not eat into window positions
+            t0 = time.time()
+            scenario_mod.write_schedule(self.spool, sc, t0)
+            journal.record(self.spool, "chaos_run_start",
+                           scenario=sc.name, seed=sc.seed,
+                           workers=sc.workers,
+                           gateway=bool(sc.gateway))
+            # one merged, seeded dispatch plan: submissions at their
+            # (jittered) cadence, conductor actions at their t
+            rng = random.Random(sc.seed)
+            plan: list[tuple[float, object]] = []
+            for i in range(sc.workload.beams):
+                jitter = (rng.random() - 0.5) * 0.5 \
+                    * sc.workload.interval_s
+                plan.append((max(0.0, i * sc.workload.interval_s
+                                 + jitter), i))
+            for a in sc.conductor_actions():
+                plan.append((a.t, a))
+            plan.sort(key=lambda p: (p[0],
+                                     isinstance(p[1], int)))
+            for t_rel, item in plan:
+                now_rel = time.time() - t0
+                if t_rel > now_rel:
+                    self.sleeper(t_rel - now_rel)
+                if time.time() - t0 > sc.duration_s:
+                    self.log.warning("duration_s %.0f exhausted "
+                                     "mid-plan", sc.duration_s)
+                    break
+                if isinstance(item, int):
+                    self._submit(item, t_rel)
+                else:
+                    self._do_action(item, t_rel)
+            # ---- quiesce: every submitted beam terminal
+            deadline = min(t0 + sc.duration_s,
+                           time.time() + sc.quiesce_timeout_s)
+            while time.time() < deadline:
+                if all(protocol.read_result(self.spool, tid)
+                       is not None for tid in self.tickets):
+                    quiesced = True
+                    break
+                self.sleeper(0.25)
+            status = "quiesced" if quiesced else "quiesce_timeout"
+        except Exception as e:   # noqa: BLE001 — the manifest must
+            status = f"error: {e}"            # record HOW it died
+            self.log.exception("chaos run failed")
+        finally:
+            # SIGCONT anything still frozen — a stopped worker would
+            # ignore the drain and hang the controller shutdown
+            for pid in list(self._stopped_pids):
+                try:
+                    os.kill(pid, signal.SIGCONT)
+                except OSError:
+                    pass
+            journal.record(self.spool, "chaos_run_end",
+                           scenario=sc.name, status=status,
+                           quiesced=quiesced)
+            if self._ctrl is not None:
+                self._ctrl.request_drain()
+            if self._ctrl_thread is not None:
+                self._ctrl_thread.join(timeout=40.0)
+            if self.gateway is not None:
+                self.gateway.stop()
+        manifest = {
+            "scenario": sc.name, "seed": sc.seed,
+            "tenants": sc.tenants, "max_attempts": sc.max_attempts,
+            "workers": sc.workers, "worker_kind": sc.worker_kind,
+            "gateway": bool(sc.gateway),
+            "gateway_port": self._gateway_port,
+            "t0": t0, "wall_s": round(time.time() - t0, 3),
+            "status": status, "quiesced": quiesced,
+            "actions": self.actions, "tickets": self.tickets,
+        }
+        try:
+            protocol._atomic_write_json(
+                scenario_mod.run_path(self.spool), manifest)
+        except OSError:
+            pass
+        return manifest
+
+
+def run_scenario(sc: scenario_mod.Scenario, spool: str,
+                 **kw) -> dict:
+    return ChaosRunner(sc, spool, **kw).run()

@@ -1,0 +1,248 @@
+"""Declarative, seeded chaos scenarios.
+
+A scenario is one JSON document (or dict) describing everything a
+chaos run needs — fleet shape, synthetic workload, and a TIMELINE of
+coordinated actions — so a fleet-wide failure storm is a committed
+file, not a shell script of sleeps and kills:
+
+    {
+      "name": "ci-smoke",
+      "seed": 42,
+      "duration_s": 60.0,          # hard wall for the whole run
+      "workers": 2,
+      "worker_kind": "stub",       # stub (jax-free, ms beams) | serve
+      "beam_s": 0.4,               # stub beam duration
+      "max_attempts": 3,
+      "gateway": true,             # submit through the HTTP edge
+      "tenants": {"surveyA": {"max_inflight": 2}},
+      "workload": {
+        "beams": 12, "interval_s": 0.25, "via": "gateway",
+        "tenant": "", "priority": null, "datafiles": null
+      },
+      "timeline": [
+        {"t": 1.5, "action": "kill_worker", "worker": "w0",
+         "signal": "KILL"},
+        {"t": 2.0, "action": "set_faults", "worker": "w1",
+         "until": 20.0,
+         "faults": "spool.io:unimplemented:count=2,errno=ENOSPC"},
+        {"t": 3.5, "action": "restart_gateway"},
+        {"t": 4.0, "action": "pause_janitor", "seconds": 2.0}
+      ],
+      "quiesce_timeout_s": 45.0
+    }
+
+Actions split into two transports:
+
+  * ``set_faults`` entries are compiled into the SCHEDULE FILE
+    (``<spool>/chaos/schedule.json``) that every process's
+    resilience.faults layer polls (TPULSAR_CHAOS_SCHEDULE /
+    TPULSAR_CHAOS_WORKER) — per-worker fault windows open and close
+    with no conductor involvement, which is what makes one spec drive
+    N processes deterministically;
+  * everything else (``kill_worker``, ``stop_worker``,
+    ``cont_worker``, ``restart_gateway``, ``pause_janitor``) is
+    executed by the conductor (runner.py) at its ``t``, and journaled
+    as a ``chaos_action`` event so the run's own violence is part of
+    the auditable record.
+
+Validation is LOUD (unknown keys/actions/signals raise at load): a
+typo'd scenario that silently does nothing would make a chaos run
+meaningless — the same contract the faults spec parser honours.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from tpulsar.resilience import faults
+
+CHAOS_DIR = "chaos"
+SCHEDULE_FILE = "schedule.json"
+RUN_FILE = "run.json"
+
+ACTIONS = ("kill_worker", "stop_worker", "cont_worker",
+           "restart_gateway", "pause_janitor", "set_faults")
+KILL_SIGNALS = ("KILL", "TERM")
+WORKER_KINDS = ("stub", "serve")
+SUBMIT_VIAS = ("spool", "gateway")
+
+
+def chaos_dir(spool: str) -> str:
+    return os.path.join(spool, CHAOS_DIR)
+
+
+def schedule_path(spool: str) -> str:
+    return os.path.join(chaos_dir(spool), SCHEDULE_FILE)
+
+
+def run_path(spool: str) -> str:
+    return os.path.join(chaos_dir(spool), RUN_FILE)
+
+
+@dataclasses.dataclass
+class Action:
+    t: float
+    action: str
+    worker: str = ""
+    signal: str = "KILL"
+    seconds: float = 5.0        # pause_janitor duration
+    until: float | None = None  # set_faults window close (None = open)
+    faults: str = ""
+
+
+@dataclasses.dataclass
+class Workload:
+    beams: int = 8
+    interval_s: float = 0.25
+    via: str = "spool"
+    tenant: str = ""
+    priority: object = None
+    datafiles: list | None = None   # None = synthetic stub inputs
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str = "chaos"
+    seed: int = 0
+    duration_s: float = 60.0
+    workers: int = 2
+    worker_kind: str = "stub"
+    beam_s: float = 0.2
+    max_attempts: int = 3
+    max_worker_restarts: int = 5
+    gateway: bool = False
+    tenants: dict = dataclasses.field(default_factory=dict)
+    workload: Workload = dataclasses.field(default_factory=Workload)
+    timeline: list[Action] = dataclasses.field(default_factory=list)
+    quiesce_timeout_s: float = 45.0
+    poll_s: float = 0.3             # controller supervision cadence
+
+    def fault_windows(self) -> list[Action]:
+        return [a for a in self.timeline if a.action == "set_faults"]
+
+    def conductor_actions(self) -> list[Action]:
+        return sorted((a for a in self.timeline
+                       if a.action != "set_faults"),
+                      key=lambda a: a.t)
+
+
+def _take(src: dict, cls, what: str, **overrides):
+    fields = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(src) - fields
+    if unknown:
+        raise ValueError(
+            f"{what}: unknown key(s) {sorted(unknown)} "
+            f"(known: {sorted(fields)})")
+    return cls(**{**src, **overrides})
+
+
+def from_dict(doc: dict) -> Scenario:
+    """Parse + validate one scenario document.  Raises ValueError on
+    anything unknown or inconsistent."""
+    if not isinstance(doc, dict):
+        raise ValueError("scenario must be a JSON object")
+    doc = dict(doc)
+    wl_doc = doc.pop("workload", {}) or {}
+    tl_doc = doc.pop("timeline", []) or []
+    wl = _take(dict(wl_doc), Workload, "workload")
+    if wl.via not in SUBMIT_VIAS:
+        raise ValueError(f"workload.via {wl.via!r} not in "
+                         f"{SUBMIT_VIAS}")
+    if wl.beams <= 0:
+        raise ValueError("workload.beams must be positive")
+    timeline = []
+    for i, a_doc in enumerate(tl_doc):
+        a = _take(dict(a_doc), Action, f"timeline[{i}]")
+        if a.action not in ACTIONS:
+            raise ValueError(
+                f"timeline[{i}]: unknown action {a.action!r} "
+                f"(known: {', '.join(ACTIONS)})")
+        if a.action in ("kill_worker", "stop_worker", "cont_worker") \
+                and not a.worker:
+            raise ValueError(f"timeline[{i}]: {a.action} needs a "
+                             f"worker id")
+        if a.action == "kill_worker" \
+                and a.signal.upper() not in KILL_SIGNALS:
+            raise ValueError(
+                f"timeline[{i}]: kill signal {a.signal!r} not in "
+                f"{KILL_SIGNALS}")
+        if a.action == "set_faults":
+            if not a.faults:
+                raise ValueError(f"timeline[{i}]: set_faults needs a "
+                                 f"faults spec")
+            faults.parse_spec(a.faults)     # validate NOW, loudly
+            if a.until is not None and a.until <= a.t:
+                raise ValueError(f"timeline[{i}]: until {a.until} "
+                                 f"<= t {a.t}")
+        timeline.append(a)
+    sc = _take(doc, Scenario, "scenario", workload=wl,
+               timeline=timeline)
+    if sc.worker_kind not in WORKER_KINDS:
+        raise ValueError(f"worker_kind {sc.worker_kind!r} not in "
+                         f"{WORKER_KINDS}")
+    if sc.workers < 1:
+        raise ValueError("workers must be >= 1")
+    if sc.gateway is False and wl.via == "gateway":
+        raise ValueError("workload.via=gateway needs gateway: true")
+    if sc.worker_kind == "serve" and wl.datafiles is None:
+        raise ValueError("worker_kind=serve needs workload.datafiles "
+                         "(real beams for real workers)")
+    if sc.tenants:
+        # validate the tenant table exactly as the claim path will
+        from tpulsar.frontdoor.tenancy import TenantPolicy
+        TenantPolicy(sc.tenants)
+    return sc
+
+
+def load(path: str) -> Scenario:
+    """Load a scenario file — an absolute/relative path, or the name
+    of a packaged scenario (``ci_smoke`` ->
+    tpulsar/chaos/scenarios/ci_smoke.json)."""
+    if not os.path.exists(path) and "/" not in path:
+        candidate = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scenarios",
+            path if path.endswith(".json") else path + ".json")
+        if os.path.exists(candidate):
+            path = candidate
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except OSError as e:
+        raise ValueError(f"cannot read scenario {path!r}: {e}") \
+            from None
+    except ValueError as e:
+        raise ValueError(f"scenario {path!r} is not valid JSON: {e}") \
+            from None
+    sc = from_dict(doc)
+    return sc
+
+
+def write_schedule(spool: str, sc: Scenario, t0: float,
+                   arm: bool = True) -> str:
+    """Compile the scenario's ``set_faults`` windows into the
+    schedule file the fleet's faults layers poll.  Written even when
+    empty: a worker pointed at the file must find it (a missing
+    schedule and a typo'd path look identical otherwise).
+    ``arm=False`` writes the file with NO entries — the conductor's
+    boot-time placeholder, so windows cannot open against a fleet
+    that is still booting (boot time is variable; the armed rewrite
+    re-anchors t0 at the workload start, which is what makes
+    same-seed runs the same storm)."""
+    os.makedirs(chaos_dir(spool), exist_ok=True)
+    entries = []
+    for a in (sc.fault_windows() if arm else ()):
+        entry = {"worker": a.worker or "*", "at": a.t,
+                 "faults": a.faults}
+        if a.until is not None:
+            entry["until"] = a.until
+        entries.append(entry)
+    path = schedule_path(spool)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"version": 1, "t0": t0, "seed": sc.seed,
+                   "scenario": sc.name, "entries": entries}, fh,
+                  indent=1)
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,177 @@
+"""A protocol-complete, jax-free chaos worker.
+
+Chaos scenarios need dozens of beams flowing through the REAL spool
+protocol in seconds, with every fault point armed — not real
+dedispersion.  This worker speaks the full serve contract as a
+first-class module the fleet controller spawns (``python -m
+tpulsar.chaos.worker``; it also replaced the test-local fleet stub,
+so the controller's tests and the chaos harness drive ONE protocol
+implementation), with the pieces a storm needs:
+
+  * exclusive two-rename claims through ``protocol.claim_next_ticket``
+    under the scenario's TenantPolicy (quota enforcement at the claim
+    — the invariant the verifier audits);
+  * per-worker heartbeats, ``search_start`` journal events with the
+    ticket's trace context, durable results stamped worker+attempts;
+  * the faults layer fully armed: ``TPULSAR_FAULTS`` baseline plus
+    the chaos schedule (TPULSAR_CHAOS_SCHEDULE/_WORKER env the
+    conductor injects), so ``spool.io``/``journal.append``/
+    ``serve.beam``/``fleet.worker`` windows fire in THIS process at
+    the scheduled instants;
+  * the same containment contract as the real server: transient
+    result-write failures retried, persistent ones exit the worker
+    with its claim in place for the janitor; ``fleet.worker`` is a
+    hard ``os._exit(70)`` mid-beam — crash footprint, no drain;
+  * SIGTERM graceful drain with attempt-neutral requeue;
+  * deterministic crash knobs for supervisor tests (``--crash-after``
+    = ``os._exit(70)`` right after claiming the N-th ticket — claim
+    in place, no result; ``--exit-rc`` = die at boot), so the fleet
+    controller's test suite drives THIS worker too — one stub, one
+    protocol, no drift.
+
+A beam is ``time.sleep(beam_s)`` (the ticket may carry its own
+``beam_s``); everything else is byte-for-byte the serving stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+from tpulsar.obs import journal
+from tpulsar.resilience import faults
+from tpulsar.serve import protocol
+
+
+def _policy():
+    import json as _json
+    raw = os.environ.get("TPULSAR_CHAOS_TENANTS", "")
+    from tpulsar.frontdoor.tenancy import TenantPolicy
+    if not raw:
+        return TenantPolicy()
+    return TenantPolicy(_json.loads(raw))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--spool", required=True)
+    p.add_argument("--worker-id", required=True)
+    p.add_argument("--beam-s", type=float, default=0.2)
+    p.add_argument("--depth", type=int, default=8)
+    p.add_argument("--poll-s", type=float, default=0.05)
+    p.add_argument("--heartbeat-s", type=float, default=1.0)
+    p.add_argument("--max-attempts", type=int,
+                   default=protocol.DEFAULT_MAX_ATTEMPTS)
+    p.add_argument("--once", action="store_true")
+    p.add_argument("--crash-after", type=int, default=0,
+                   help="os._exit(70) right after claiming the N-th "
+                        "ticket (0 = never): the fleet.worker crash "
+                        "footprint without arming the faults layer")
+    p.add_argument("--exit-rc", type=int, default=-1,
+                   help="exit immediately with this rc (spawn-crash "
+                        "simulation; -1 = serve normally)")
+    args = p.parse_args(argv)
+
+    if args.exit_rc >= 0:
+        return args.exit_rc
+
+    faults.configure()          # TPULSAR_FAULTS + chaos schedule env
+    policy = _policy()
+    spool, wid = args.spool, args.worker_id
+
+    draining = []
+    signal.signal(signal.SIGTERM, lambda *a: draining.append(1))
+    signal.signal(signal.SIGINT, lambda *a: draining.append(1))
+
+    last_beat = [0.0]
+
+    def beat(status: str = "running", force: bool = False) -> None:
+        now = time.time()
+        if not force and now - last_beat[0] < args.heartbeat_s:
+            return
+        try:
+            protocol.write_heartbeat(
+                spool, worker_id=wid, status=status,
+                queue_depth=protocol.pending_count(spool),
+                max_queue_depth=args.depth)
+            last_beat[0] = now
+        except OSError:
+            pass      # a spool.io window costs freshness, not the worker
+
+    # boot recovery, like the real server — guarded: a fault window
+    # open at boot must not kill the worker before its first claim
+    try:
+        protocol.requeue_stale_claims(spool, args.max_attempts)
+    except OSError:
+        pass
+    beat(force=True)
+
+    claims = 0
+    while not draining:
+        try:
+            rec = protocol.claim_next_ticket(spool, wid,
+                                             policy=policy)
+        except OSError:
+            beat()
+            time.sleep(args.poll_s)
+            continue
+        if rec is None:
+            if args.once and protocol.pending_count(spool) == 0 \
+                    and protocol.claimed_count(spool) == 0:
+                break
+            beat()
+            time.sleep(args.poll_s)
+            continue
+        claims += 1
+        if args.crash_after and claims >= args.crash_after:
+            os._exit(70)
+        tid = rec.get("ticket", "?")
+        att = int(rec.get("attempts", 0))
+        journal.record(spool, "search_start", ticket=tid, worker=wid,
+                       attempt=att, trace_id=rec.get("trace_id", ""))
+        # worker-crash injection: hard exit mid-beam, claim in place,
+        # no result, no drain — the footprint the janitor must heal
+        if faults.targets("fleet.worker"):
+            try:
+                faults.fire("fleet.worker",
+                            detail=f"ticket {tid} worker {wid}")
+            except BaseException:
+                os._exit(70)
+        status, err = "done", ""
+        try:
+            faults.fire("serve.beam", detail=f"ticket {tid}")
+            time.sleep(float(rec.get("beam_s", args.beam_s)))
+        except Exception as e:   # noqa: BLE001 — crash isolation:
+            status, err = "failed", str(e)[:500]   # this ticket only
+        for io_try in range(3):
+            try:
+                protocol.write_result(
+                    spool, tid, status, rc=0 if status == "done"
+                    else 1, error=err,
+                    beam_seconds=float(rec.get("beam_s",
+                                               args.beam_s)),
+                    warm=True, worker=wid, attempts=att,
+                    outdir=rec.get("outdir", ""),
+                    trace_id=rec.get("trace_id", ""))
+                break
+            except OSError:
+                if io_try == 2:
+                    # persistent spool failure: die with the claim in
+                    # place — the janitor reassigns, never loses it
+                    os._exit(74)
+                time.sleep(0.05 * (io_try + 1))
+        beat()
+    if draining:
+        try:
+            protocol.requeue_own_claims(spool)
+        except OSError:
+            pass
+    beat("stopped", force=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -391,7 +391,7 @@ def cmd_submit(args):
         rec = client.submit_beam(
             args.gateway, files, outdir=args.outdir,
             tenant=args.tenant, priority=args.priority,
-            job_id=args.job_id)
+            job_id=args.job_id, retries=args.retries)
     except client.ClientError as e:
         print(json.dumps({"code": e.code, **e.payload}),
               file=sys.stderr)
@@ -777,42 +777,118 @@ def cmd_obs(args):
         except KeyboardInterrupt:
             return 0
     if args.obs_cmd == "tail":
-        from tpulsar.obs.journal import journal_path
-        path = journal_path(spool)
-        try:
-            with open(path) as fh:
-                lines = fh.readlines()
-                offset = fh.tell()
-        except OSError:
-            lines, offset = [], 0
-        for ln in lines[-args.lines:]:
-            print(ln.rstrip())
+        # ride the journal's offset-tailed reader: the attach read
+        # replays history once, each poll then costs O(new bytes)
+        # (rotation handled inside read_events; torn appends are
+        # recovered or skipped by its tail-line contract)
+        import json as _json
+
+        def _tail_read(off):
+            # corruption is WARNED and skipped, never fatal: an
+            # operator's tail must keep following past a bad line
+            # (the chaos verifier is the strict reader), and raising
+            # here would stall the loop at the same offset forever
+            bad: list = []
+            try:
+                evs, off = journal.read_events(spool,
+                                               after_offset=off,
+                                               bad_lines=bad)
+            except OSError:
+                return [], off
+            for b in bad:
+                print(f"# journal corrupt line skipped: "
+                      f"{b['text'][:80]!r}", file=sys.stderr)
+            return evs, off
+
+        events, offset = _tail_read(0)
+        for ev in events[-args.lines:]:
+            print(_json.dumps(ev, sort_keys=True))
         if not args.follow:
-            return 0 if lines else 1
-        # follow by byte offset — re-reading a journal nearing its
-        # 64 MB rotation cap every half second would be O(file) per
-        # tick; a seek is O(new data).  A shrink (rotation) resets
-        # the offset to the start of the fresh generation.
-        buf = ""
+            return 0 if events else 1
         try:
             while True:
                 time.sleep(args.interval)
-                try:
-                    size = os.path.getsize(path)
-                    if size < offset:
-                        offset, buf = 0, ""
-                    with open(path) as fh:
-                        fh.seek(offset)
-                        buf += fh.read()
-                        offset = fh.tell()
-                except OSError:
-                    continue
-                *done, buf = buf.split("\n")
-                for ln in done:
-                    if ln:
-                        print(ln, flush=True)
+                new, offset = _tail_read(offset)
+                for ev in new:
+                    print(_json.dumps(ev, sort_keys=True),
+                          flush=True)
         except KeyboardInterrupt:
             return 0
+    return 2
+
+
+def cmd_chaos(args):
+    """The chaos harness (tpulsar/chaos/):
+
+      run    — execute a declarative, seeded scenario: stand up a
+               controller-supervised fleet (optionally behind the
+               HTTP gateway) on a spool, submit a synthetic beam
+               workload, run the failure timeline (worker kills,
+               fault windows via the shared schedule file, gateway
+               restarts), quiesce, and write the run manifest
+      verify — replay the journal + spool + result store and assert
+               the system invariants (exactly-once, no lost ticket,
+               attempts discipline, quotas, trace ids, side-files);
+               exit 1 on any violation; --tail audits live
+      report — the post-run digest: actions, per-status counts,
+               MTTR after each kill, and the invariant verdict
+
+    The verifier is deliberately scenario-independent: it audits any
+    spool a fleet has run on, chaos-conducted or not."""
+    import json as _json
+
+    from tpulsar.chaos import invariants, runner, scenario
+    from tpulsar.obs import telemetry
+
+    spool = args.spool
+    if not spool:
+        from tpulsar.config import settings
+        spool = _serve_spool(settings())
+    if args.chaos_cmd == "run":
+        sc = scenario.load(args.scenario)
+        print(f"chaos run: scenario {sc.name!r} (seed {sc.seed}, "
+              f"{sc.workers} {sc.worker_kind} worker(s)"
+              + (", gateway" if sc.gateway else "")
+              + f") on spool {spool}", flush=True)
+        manifest = runner.run_scenario(sc, spool)
+        print(_json.dumps({k: manifest[k] for k in
+                           ("scenario", "status", "quiesced",
+                            "wall_s", "tickets", "actions")},
+                          indent=1))
+        return 0 if manifest["quiesced"] else 1
+    from tpulsar.serve import protocol as _protocol
+    # the manifest is ALWAYS consulted for run facts (quiesced);
+    # --scenario only overrides the contract inputs (tenant table,
+    # attempts cap) — quiescence is a property of the run, not the
+    # scenario
+    manifest = _protocol._read_json(scenario.run_path(spool))
+    tenants = (manifest or {}).get("tenants") or {}
+    max_attempts = (manifest or {}).get("max_attempts",
+                                        args.max_attempts)
+    if args.scenario:
+        sc = scenario.load(args.scenario)
+        tenants, max_attempts = sc.tenants, sc.max_attempts
+    if args.chaos_cmd == "verify":
+        if args.tail:
+            report = invariants.tail_verify(
+                spool, tenants=tenants, max_attempts=max_attempts,
+                timeout_s=args.timeout)
+        else:
+            quiesced = not args.live and (
+                manifest is None or bool(manifest.get("quiesced",
+                                                      True)))
+            report = invariants.verify(
+                spool, tenants=tenants, max_attempts=max_attempts,
+                quiesced=quiesced)
+        print(invariants.render_verify(report))
+        for name, n in report["invariants"].items():
+            if n:
+                telemetry.chaos_violations_total().inc(
+                    n, invariant=name)
+        return 0 if report["ok"] else 1
+    if args.chaos_cmd == "report":
+        print(invariants.render_report(spool))
+        return 0
     return 2
 
 
@@ -1199,6 +1275,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "by its status")
     sp.add_argument("--timeout", type=float, default=600.0,
                     help="--wait timeout seconds")
+    sp.add_argument("--retries", type=int, default=0,
+                    help="resubmit after a retryable 429 refusal up "
+                         "to N times, sleeping the gateway's "
+                         "jittered Retry-After hint between tries")
     sp.set_defaults(fn=cmd_submit)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
@@ -1286,6 +1366,52 @@ def build_parser() -> argparse.ArgumentParser:
     op.add_argument("-f", "--follow", action="store_true")
     op.add_argument("--interval", type=float, default=0.5)
     op.set_defaults(fn=cmd_obs)
+
+    sp = sub.add_parser(
+        "chaos",
+        help="chaos harness: run a seeded fleet-wide failure "
+             "scenario (run), audit the journal/spool against the "
+             "system invariants (verify), or print the post-run "
+             "digest incl. MTTR (report)")
+    csub = sp.add_subparsers(dest="chaos_cmd", required=True)
+    cp = csub.add_parser(
+        "run", help="execute a scenario file against a fresh fleet "
+                    "on the spool")
+    cp.add_argument("--scenario", required=True,
+                    help="scenario JSON path, or a packaged name "
+                         "(e.g. ci_smoke)")
+    cp.add_argument("--spool", default=None,
+                    help="spool dir (default: the serve spool)")
+    cp.set_defaults(fn=cmd_chaos)
+    cp = csub.add_parser(
+        "verify", help="assert the system invariants over the "
+                       "spool's journal + state; exit 1 on any "
+                       "violation")
+    cp.add_argument("--spool", default=None)
+    cp.add_argument("--scenario", default=None,
+                    help="scenario providing the tenant table / "
+                         "attempts cap (default: the spool's run "
+                         "manifest)")
+    cp.add_argument("--max-attempts", type=int, default=3)
+    cp.add_argument("--tail", action="store_true",
+                    help="follow the journal live (offset-tailed) "
+                         "and report violations as evidence lands; "
+                         "final full audit on chaos_run_end")
+    cp.add_argument("--timeout", type=float, default=0.0,
+                    help="--tail gives up after this many seconds "
+                         "(0 = until run end / Ctrl-C)")
+    cp.add_argument("--live", action="store_true",
+                    help="audit a still-running fleet: skip the "
+                         "quiesce-only judgments (lost tickets, "
+                         "leftover side-files)")
+    cp.set_defaults(fn=cmd_chaos)
+    cp = csub.add_parser(
+        "report", help="post-run digest: actions, statuses, MTTR "
+                       "per kill, invariant verdict")
+    cp.add_argument("--spool", default=None)
+    cp.add_argument("--scenario", default=None)
+    cp.add_argument("--max-attempts", type=int, default=3)
+    cp.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser(
         "trace",

@@ -137,6 +137,10 @@ class FleetController:
         self.sleeper = sleeper
         self.workers = [_Worker(f"w{i}") for i in range(workers)]
         self._cycling: _Worker | None = None
+        #: chaos-harness hook: while set in the future, the janitor
+        #: skips its recovery scan — models a slow/partitioned
+        #: janitor so takeover latency becomes a scenario variable
+        self._janitor_paused_until = 0.0
         self._drain = threading.Event()
         self._quarantined_seen: set[str] = set()
         #: merged-fleet.prom cadence: aggregation re-reads the ticket
@@ -209,8 +213,12 @@ class FleetController:
         if hb is not None and hb.get("pid") == w.pid \
                 and hb.get("status") != "stopped":
             hb["status"] = "stopped"
-            protocol._atomic_write_json(
-                protocol.heartbeat_path(self.spool, w.worker_id), hb)
+            try:
+                protocol._atomic_write_json(
+                    protocol.heartbeat_path(self.spool, w.worker_id),
+                    hb)
+            except OSError:
+                pass     # the heartbeat ages out on its own
 
     def _reap(self) -> None:
         for w in self.workers:
@@ -262,11 +270,27 @@ class FleetController:
 
     # ------------------------------------------------------------ janitor
 
+    def pause_janitor(self, seconds: float) -> None:
+        """Suspend claim recovery for ``seconds`` (chaos scenarios:
+        a janitor that lags is a recovery-latency experiment, not a
+        correctness one — nothing else about supervision pauses)."""
+        self._janitor_paused_until = time.time() + max(0.0, seconds)
+
     def _janitor(self) -> None:
         """Reclaim dead workers' orphaned claims (work stealing) and
         account newly quarantined beams."""
-        requeued = protocol.requeue_stale_claims(
-            self.spool, self.ticket_max_attempts)
+        if time.time() < self._janitor_paused_until:
+            return
+        try:
+            requeued = protocol.requeue_stale_claims(
+                self.spool, self.ticket_max_attempts)
+        except OSError as e:
+            # a failing spool (ENOSPC burst, injected spool.io) must
+            # not take the CONTROLLER down mid-loop: skip this beat,
+            # the next one retries — recovery is delayed, never lost
+            self.log.warning("janitor pass failed (%s); retrying "
+                             "next loop", e)
+            return
         if requeued:
             telemetry.fleet_requeued_total().inc(len(requeued))
             self.log.warning(

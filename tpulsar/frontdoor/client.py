@@ -54,7 +54,15 @@ def _request(method: str, url: str, payload: dict | None = None,
 def submit_beam(base_url: str, datafiles: list[str],
                 outdir: str | None = None, tenant: str = "",
                 priority=None, job_id: int | None = None,
-                timeout: float = DEFAULT_TIMEOUT_S) -> dict:
+                timeout: float = DEFAULT_TIMEOUT_S,
+                retries: int = 0, sleep=time.sleep) -> dict:
+    """Submit a beam.  ``retries`` > 0 makes a 429 refusal
+    (quota/backpressure — the RETRYABLE class) sleep for the
+    gateway's jittered ``retry_after_s`` hint and resubmit, up to
+    that many extra attempts; honoring the hint is what keeps a
+    thousand refused submitters from herding back in lock-step.  503
+    (load-shed) and 4xx validation errors never retry — this host
+    told us to go elsewhere / the request is wrong."""
     payload: dict = {"datafiles": list(datafiles)}
     if outdir:
         payload["outdir"] = outdir
@@ -64,8 +72,17 @@ def submit_beam(base_url: str, datafiles: list[str],
         payload["priority"] = priority
     if job_id is not None:
         payload["job_id"] = job_id
-    return _request("POST", base_url.rstrip("/") + "/v1/beams",
-                    payload, timeout)
+    attempt = 0
+    while True:
+        try:
+            return _request("POST",
+                            base_url.rstrip("/") + "/v1/beams",
+                            payload, timeout)
+        except ClientError as e:
+            if e.code != 429 or attempt >= retries:
+                raise
+            attempt += 1
+            sleep(e.retry_after_s or 1.0)
 
 
 def ticket_status(base_url: str, ticket: str,

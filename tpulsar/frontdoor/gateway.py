@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 import urllib.parse
@@ -89,7 +90,8 @@ class GatewayServer:
                  outdir_base: str | None = None,
                  max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S,
                  default_depth: int = 8,
-                 query_limit: int = 200, logger=None):
+                 query_limit: int = 200,
+                 retry_jitter_seed: int = 0, logger=None):
         if (queue is None) == (router is None):
             raise ValueError(
                 "exactly one of queue= (gateway mode) or router= "
@@ -107,6 +109,12 @@ class GatewayServer:
         self.log = logger
         self._seq = 0
         self._seq_lock = threading.Lock()
+        #: deterministic-seeded Retry-After jitter: N clients refused
+        #: in one backpressure burst get ±25%-spread retry hints, so
+        #: their resubmits don't land as one synchronized herd on the
+        #: admission lock — seeded, so a chaos reproduction sees the
+        #: same spread every run
+        self._retry_rng = random.Random(retry_jitter_seed)
         #: serializes admission-check + ticket write: handler threads
         #: racing the same pending_by_tenant()/capacity() snapshot
         #: would otherwise all pass a quota with one slot left (the
@@ -161,6 +169,13 @@ class GatewayServer:
         return (f"gw-{os.getpid()}-{seq}-"
                 f"{int(time.time() * 1000) % 100000}")
 
+    def _retry_after(self, base: float = 5.0) -> float:
+        """The 429 retry hint with ±25% seeded jitter (see
+        ``_retry_rng``)."""
+        with self._seq_lock:
+            u = self._retry_rng.random()
+        return round(base * (1.0 + (u - 0.5) * 0.5), 2)
+
     # -------------------------------------------------------------- routes
 
     def handle_submit(self, payload: dict) -> tuple[int, dict]:
@@ -196,8 +211,9 @@ class GatewayServer:
                     tenant, self.queue.pending_by_tenant())
                 if not ok:
                     self._count_submission(payload, "quota")
-                    raise GatewayError(429, reason,
-                                       retry_after_s=5.0)
+                    raise GatewayError(
+                        429, reason,
+                        retry_after_s=self._retry_after())
             cap = self.queue.capacity(self.max_age_s,
                                       self.default_depth)
             if cap is None:
@@ -212,7 +228,7 @@ class GatewayServer:
                 raise GatewayError(
                     429, "backpressure: the fleet queue is full; "
                          "retry",
-                    capacity=0, retry_after_s=5.0)
+                    capacity=0, retry_after_s=self._retry_after())
             # the trace id is minted HERE — the network edge is the
             # start of the beam's observable life, and the
             # 'received' event is journaled before the ticket exists
@@ -244,7 +260,8 @@ class GatewayServer:
         except federation.AllSaturated as e:
             self._count_submission({"tenant": tenant},
                                    "backpressure")
-            raise GatewayError(429, str(e), retry_after_s=5.0)
+            raise GatewayError(429, str(e),
+                               retry_after_s=self._retry_after())
         except federation.AllShedding as e:
             self._count_submission({"tenant": tenant}, "load_shed")
             raise GatewayError(503, str(e))
@@ -263,7 +280,7 @@ class GatewayServer:
                        503: "load_shed"}.get(e.code, "error")
             self._count_submission({"tenant": tenant}, outcome)
             if e.code == 429:
-                body.setdefault("retry_after_s", 5.0)
+                body.setdefault("retry_after_s", self._retry_after())
             raise GatewayError(e.code,
                                body.get("error", str(e)), **{
                                    k: v for k, v in body.items()
@@ -312,21 +329,23 @@ class GatewayServer:
 
     def iter_events_follow(self, ticket: str, timeout_s: float):
         """Yield journal events for one ticket as they land, ending
-        after the terminal event (or the timeout).  Re-reads the
-        journal per poll — fine for the handful of live streams a
-        host serves; a busier deployment would tail by offset."""
+        after the terminal event (or the timeout).  Tails by saved
+        offset: the attach read (offset 0) replays history once, then
+        each poll costs O(new journal bytes) — N live streams no
+        longer multiply into N full-journal re-reads every quarter
+        second as the journal grows."""
         self._require_queue()
-        seen = 0
+        offset = 0
+        done = False
         deadline = time.time() + timeout_s
         while True:
-            events = self.queue.read_events(ticket=ticket)
-            for ev in events[seen:]:
+            events, offset = self.queue.read_events_after(
+                offset, ticket=ticket)
+            for ev in events:
                 yield ev
-            seen = len(events)
-            if any(e.get("event") == journal_mod.TERMINAL_EVENT
-                   for e in events):
-                return
-            if time.time() >= deadline:
+                if ev.get("event") == journal_mod.TERMINAL_EVENT:
+                    done = True
+            if done or time.time() >= deadline:
                 return
             time.sleep(STREAM_POLL_S)
 
@@ -422,8 +441,11 @@ def _make_handler(gw: GatewayServer):
             except GatewayError as e:
                 code, payload = e.code, e.payload
                 if "retry_after_s" in e.payload:
-                    headers["Retry-After"] = str(int(
-                        e.payload["retry_after_s"]) or 1)
+                    # the header is integer-valued by spec; keep the
+                    # jittered float in the JSON payload (what the
+                    # client library sleeps on) and round here
+                    headers["Retry-After"] = str(max(1, round(
+                        float(e.payload["retry_after_s"]))))
             except Exception as e:        # noqa: BLE001 — one bad
                 # request must never take the gateway down
                 gw.log.exception("gateway %s failed", route)

@@ -144,6 +144,17 @@ class TicketQueue:
     def read_events(self, ticket: str | None = None) -> list[dict]:
         raise NotImplementedError
 
+    def read_events_after(self, after_offset: int = 0,
+                          ticket: str | None = None
+                          ) -> tuple[list[dict], int]:
+        """Offset-tailed event read: ``(events past after_offset,
+        next_offset)``.  Offset 0 attaches (full history once); a
+        poller then passes each returned offset back, so following a
+        ticket costs O(new events) per poll instead of re-reading the
+        whole journal (the gateway's ``?follow=1`` stream and
+        ``chaos verify --tail`` both ride this)."""
+        raise NotImplementedError
+
 
 # --------------------------------------------------------------------
 # filesystem backend (the reference implementation)
@@ -239,7 +250,17 @@ class FilesystemSpoolQueue(TicketQueue):
         journal.record(self.spool, event, **fields)
 
     def read_events(self, ticket=None):
-        return journal.read_events(self.spool, ticket=ticket)
+        # tolerant read: the gateway is a SERVING surface — status
+        # queries and follow streams must outlive a corrupt journal
+        # line (the chaos verifier is the strict reader that reports
+        # it)
+        return journal.read_events(self.spool, ticket=ticket,
+                                   bad_lines=[])
+
+    def read_events_after(self, after_offset=0, ticket=None):
+        return journal.read_events(self.spool, ticket=ticket,
+                                   after_offset=after_offset,
+                                   bad_lines=[])
 
 
 # --------------------------------------------------------------------
@@ -276,7 +297,8 @@ class MemoryTicketQueue(TicketQueue):
                "submitted_at": time.time(), "attempts": 0, **extra}
         rec.setdefault("trace_id", uuid.uuid4().hex[:16])
         self.record_event("submitted", ticket=ticket_id, attempt=0,
-                          trace_id=rec["trace_id"], outdir=outdir)
+                          trace_id=rec["trace_id"], outdir=outdir,
+                          tenant=rec.get("tenant", ""))
         with self._lock:
             self._states["incoming"][ticket_id] = rec
         return ticket_id
@@ -322,7 +344,8 @@ class MemoryTicketQueue(TicketQueue):
                     queue_wait_s=round(
                         rec["claimed_at"]
                         - rec.get("submitted_at", rec["claimed_at"]),
-                        3))
+                        3),
+                    tenant=rec.get("tenant", ""))
                 return rec
             return None
 
@@ -536,6 +559,17 @@ class MemoryTicketQueue(TicketQueue):
                    if ticket is None or e.get("ticket") == ticket]
         evs.sort(key=lambda r: r.get("t", 0.0))
         return evs
+
+    def read_events_after(self, after_offset=0, ticket=None):
+        # the "offset" here is simply an index into the in-memory
+        # event list — same contract, no bytes involved
+        with self._lock:
+            start = max(0, min(int(after_offset), len(self._events)))
+            evs = [dict(e) for e in self._events[start:]
+                   if ticket is None or e.get("ticket") == ticket]
+            next_offset = len(self._events)
+        evs.sort(key=lambda r: r.get("t", 0.0))
+        return evs, next_offset
 
 
 # --------------------------------------------------------------------

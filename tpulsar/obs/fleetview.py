@@ -311,7 +311,7 @@ def stitch(spool: str, ticket: str) -> dict:
     unix clock, so spans recorded by DIFFERENT workers (a claim on
     w0, the finish on w1 after a steal) land on one consistent time
     axis."""
-    events = journal.read_events(spool, ticket=ticket)
+    events = journal.read_events(spool, ticket=ticket, bad_lines=[])
     if not events:
         raise FileNotFoundError(
             f"no journal events for ticket {ticket!r} in {spool}")

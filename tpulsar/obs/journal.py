@@ -15,6 +15,10 @@ appends ONE stamped event per transition to
                      the gateway hop, not just the spool write
     submitted        client wrote the ticket (trace id minted here
                      unless a gateway minted it at the edge)
+    submit_failed    the incoming/ write behind 'submitted' failed
+                     (full disk / injected spool.io): the submission
+                     was cleanly REFUSED, the chain ends here — how
+                     the auditor tells a refused beam from a lost one
     claimed          a worker won the claim rename (worker, pid,
                      attempt, queue_wait_s)
     stagein_done /   the prefetch thread staged the beam's inputs
@@ -57,9 +61,21 @@ import json
 import os
 
 from tpulsar.obs import telemetry
+from tpulsar.resilience import faults
 
 EVENTS_DIR = "events"
 JOURNAL_FILE = "journal.jsonl"
+
+
+class JournalCorrupt(OSError):
+    """A MID-FILE journal line is unparseable (and not a recoverable
+    torn-append prefix).  Exactly one TRAILING partial line per
+    generation is expected wreckage — a writer crashed mid-append —
+    and silently skipped; anything else is evidence of real
+    corruption and must surface, not vanish.  OSError-shaped on
+    purpose: every existing journal-tolerant guard (the controller's
+    aggregation loop, record()'s callers) already contains OSError,
+    while the chaos verifier catches this class by name."""
 
 #: one rotation generation (journal.jsonl.1) is kept, like the
 #: daemons' metrics JSONL: a fleet appending for months must not fill
@@ -96,6 +112,11 @@ def record(spool: str, event: str, ticket: str = "",
     line = (json.dumps(rec, separators=(",", ":"), sort_keys=True)
             + "\n").encode()
     try:
+        # deterministic append-failure injection (chaos): the journal
+        # is observational, so the fault costs this EVENT, never the
+        # transition — shaped as the OSError a failing spool raises
+        faults.fire("journal.append", make_exc=faults.io_error,
+                    detail=event)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         try:
             if os.path.getsize(path) >= MAX_BYTES:
@@ -125,35 +146,161 @@ def record(spool: str, event: str, ticket: str = "",
     return rec
 
 
-def read_events(spool: str, ticket: str | None = None) -> list[dict]:
-    """Every journal event (rotated generation first), oldest first;
-    torn trailing lines are skipped.  ``ticket`` filters to one
-    beam's lifecycle."""
-    import glob as _glob
-    out: list[dict] = []
-    path = journal_path(spool)
-    paths = [path + ".1",
-             *sorted(_glob.glob(path + ".rotating.*")),  # dead rotator
-             path]
-    for p in paths:
+def _parse_line(line: str) -> dict | None:
+    """json.loads with torn-append recovery.  A writer that died (or
+    hit ENOSPC) mid-append leaves a partial prefix with no newline;
+    the NEXT O_APPEND writer's complete record then lands on the SAME
+    physical line.  The trailing complete object on such a merged
+    line WAS durably written — recover it instead of losing a real
+    event to someone else's wreckage."""
+    try:
+        rec = json.loads(line)
+        return rec if isinstance(rec, dict) else None
+    except ValueError:
+        pass
+    idx = line.find("{", 1)
+    while idx != -1:
         try:
-            with open(p) as fh:
-                lines = fh.readlines()
-        except OSError:
+            rec = json.loads(line[idx:])
+            return rec if isinstance(rec, dict) else None
+        except ValueError:
+            idx = line.find("{", idx + 1)
+    return None
+
+
+def _generation_paths(spool: str) -> list[str]:
+    import glob as _glob
+    path = journal_path(spool)
+    return [path + ".1",
+            *sorted(_glob.glob(path + ".rotating.*")),  # dead rotator
+            path]
+
+
+def _parse_file(p: str, out: list[dict], ticket: str | None,
+                bad_lines: list | None) -> None:
+    """Parse one journal generation into ``out``.  Exactly ONE
+    trailing partial line is tolerated (a writer crashed mid-append:
+    expected wreckage); an unparseable line anywhere ELSE is real
+    corruption — appended to ``bad_lines`` when the caller collects
+    them (the chaos verifier), raised as JournalCorrupt otherwise."""
+    try:
+        with open(p) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return
+    last = -1
+    for i in range(len(lines) - 1, -1, -1):
+        if lines[i].strip():
+            last = i
+            break
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
             continue
-        for line in lines:
-            line = line.strip()
-            if not line:
+        rec = _parse_line(line)
+        if rec is None:
+            if i == last:
+                continue          # the one tolerated torn tail
+            if bad_lines is not None:
+                bad_lines.append({"path": p, "line": i + 1,
+                                  "text": line[:200]})
                 continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue          # a writer died mid-append
-            if ticket is not None and rec.get("ticket") != ticket:
-                continue
-            out.append(rec)
+            raise JournalCorrupt(
+                f"journal corrupt mid-file: {p} line {i + 1}: "
+                f"{line[:120]!r}")
+        if ticket is not None and rec.get("ticket") != ticket:
+            continue
+        out.append(rec)
+
+
+def read_events(spool: str, ticket: str | None = None, *,
+                after_offset: int | None = None,
+                bad_lines: list | None = None):
+    """Journal events, oldest first.  ``ticket`` filters to one
+    beam's lifecycle.
+
+    Torn-tail contract: exactly one TRAILING partial line per
+    generation is skipped (a writer died mid-append); a merged
+    torn-prefix + complete-record line recovers the complete record;
+    any other unparseable line raises ``JournalCorrupt`` — or is
+    collected into ``bad_lines`` when a list is passed (the chaos
+    verifier reports them instead of aborting the audit).
+
+    ``after_offset=None`` (default): every generation merged, a
+    plain list — the historical behaviour.
+
+    ``after_offset=N``: tail mode for pollers — returns ``(events,
+    next_offset)`` with only the events appended past byte N of the
+    CURRENT generation; ``next_offset`` never advances past an
+    incomplete trailing line, so a torn append is simply re-examined
+    (and recovered or skipped) once the next writer completes the
+    line.  ``after_offset=0`` is the attach point: it includes the
+    rotated generations once, then hands back a byte offset to tail
+    from.  If the journal rotated between polls (current file shrank
+    below the offset), the missed tail is read from the ``.1``
+    generation — a tailer more than one full generation behind loses
+    the gap, which 64 MB of slack makes a non-event in practice."""
+    out: list[dict] = []
+    if after_offset is None:
+        for p in _generation_paths(spool):
+            _parse_file(p, out, ticket, bad_lines)
+        out.sort(key=lambda r: r.get("t", 0.0))
+        return out
+
+    path = journal_path(spool)
+    offset = max(0, int(after_offset))
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = 0
+    if offset == 0:
+        # attach: the rotated generations are history, read whole
+        for p in _generation_paths(spool)[:-1]:
+            _parse_file(p, out, ticket, bad_lines)
+    elif size < offset:
+        # rotated under us: our unread tail now ends the .1 file
+        _parse_tail(path + ".1", offset, out, ticket, bad_lines)
+        offset = 0
+    next_offset = offset + _parse_tail(path, offset, out, ticket,
+                                       bad_lines)
     out.sort(key=lambda r: r.get("t", 0.0))
-    return out
+    return out, next_offset
+
+
+def _parse_tail(p: str, offset: int, out: list[dict],
+                ticket: str | None, bad_lines: list | None) -> int:
+    """Parse complete lines of ``p`` past byte ``offset`` into
+    ``out``; returns the number of bytes CONSUMED (up to and
+    including the last newline — a trailing partial line stays
+    unconsumed for the next poll)."""
+    try:
+        with open(p, "rb") as fh:
+            fh.seek(offset)
+            data = fh.read()
+    except OSError:
+        return 0
+    cut = data.rfind(b"\n")
+    if cut < 0:
+        return 0
+    for raw in data[:cut].split(b"\n"):
+        line = raw.decode("utf-8", errors="replace").strip()
+        if not line:
+            continue
+        rec = _parse_line(line)
+        if rec is None:
+            # every line here ENDS with a newline (complete), so an
+            # unrecoverable one is mid-file corruption by definition
+            if bad_lines is not None:
+                bad_lines.append({"path": p, "line": -1,
+                                  "text": line[:200]})
+                continue
+            raise JournalCorrupt(
+                f"journal corrupt mid-file: {p} (tail read): "
+                f"{line[:120]!r}")
+        if ticket is not None and rec.get("ticket") != ticket:
+            continue
+        out.append(rec)
+    return cut + 1
 
 
 def iter_tickets(events: list[dict]) -> dict[str, list[dict]]:
@@ -193,6 +340,16 @@ def validate_chain(events: list[dict]) -> list[str]:
         problems.append(
             f"first event is {head!r}, not 'submitted' (or a "
             f"gateway 'received' head)")
+    if any(ev.get("event") == "submit_failed" for ev in events):
+        # a cleanly-refused submission (the incoming/ write failed):
+        # the chain ends right there — no claim, no terminal
+        if events[-1].get("event") != "submit_failed":
+            tail = [e.get("event") for e in events
+                    if e.get("event") not in ("received", "submitted",
+                                              "submit_failed")]
+            problems.append(
+                f"events after a failed submission: {tail}")
+        return problems
     terminals = [i for i, ev in enumerate(events)
                  if ev.get("event") == TERMINAL_EVENT]
     if len(terminals) != 1:
@@ -279,7 +436,9 @@ def summarize(spool: str) -> dict:
     """Spool-wide journal digest: per-ticket chains + fleet counts —
     the input both the fleet metrics aggregator (obs/fleetview.py)
     and ``tools/trace_summarize.py --spool`` read."""
-    events = read_events(spool)
+    # tolerant read: the fleet aggregator and ops console must keep
+    # rendering past a corrupt line (chaos verify reports it)
+    events = read_events(spool, bad_lines=[])
     per = iter_tickets(events)
     tickets = {tid: chain_summary(evs) for tid, evs in per.items()}
     statuses: dict[str, int] = {}
@@ -302,7 +461,7 @@ def render_timeline(spool: str, ticket: str) -> str:
     """The ops-console timeline: one beam's full lifecycle across
     every worker that touched it, with the duration between
     transitions — `tpulsar obs timeline <ticket>`."""
-    events = read_events(spool, ticket=ticket)
+    events = read_events(spool, ticket=ticket, bad_lines=[])
     if not events:
         return f"no journal events for ticket {ticket!r} in {spool}"
     digest = chain_summary(events)

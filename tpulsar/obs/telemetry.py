@@ -371,6 +371,24 @@ def frontdoor_routed_total() -> metrics.Counter:
         labelnames=("host", "outcome"))
 
 
+def chaos_actions_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_chaos_actions_total",
+        "chaos-conductor timeline actions executed (kill_worker | "
+        "stop_worker | cont_worker | restart_gateway | "
+        "pause_janitor | submit_refused)",
+        labelnames=("action",))
+
+
+def chaos_violations_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_chaos_violations_total",
+        "invariant violations reported by the chaos verifier, by "
+        "invariant name — nonzero means the serving contract BROKE "
+        "under the scenario, alert at any value",
+        labelnames=("invariant",))
+
+
 # --------------------------------------------------------------------
 # the shared heartbeat/progress event shape
 # --------------------------------------------------------------------

@@ -19,13 +19,20 @@ Spec grammar (``;``-separated specs, ``,``-separated options)::
            | hang            sleep `seconds`, then proceed (a hung
                              dispatch — policy.run_with_deadline
                              converts it into a classified failure)
+           | delay           sleep `seconds` (default 0.25), then
+                             proceed: SLOW I/O, not a stall — models
+                             a congested spool volume or network
+                             mount without tripping any watchdog
            | poison          raise AND poison the session: every
                              later fire() at any point raises too
     keys  := rate=<0..1>     trigger probability per call (default 1)
              seed=<int>      RNG seed for the rate draw (default 0)
              after=<int>     first N calls never trigger (default 0)
              count=<int>     trigger at most N times (default 0 = inf)
-             seconds=<float> hang duration (default 30)
+             seconds=<float> hang/delay duration (default 30 / 0.25)
+             errno=<NAME>    shape the raised error as OSError with
+                             this errno (ENOSPC, EIO, ...) — the
+                             spool I/O points default to EIO
 
 Determinism: each fault point keeps its own call counter and its own
 ``random.Random(seed)`` stream, so the same spec over the same call
@@ -34,11 +41,34 @@ command line, not a lucky hardware flake.
 
 Unknown points or modes raise at configure time: a typo'd spec that
 silently never fired would make a reproduction run meaningless.
+
+Fleet-wide coordination (the chaos harness, tpulsar/chaos/): besides
+the process-local TPULSAR_FAULTS baseline, this layer can poll a
+SCHEDULE FILE shared by every process of a serving fleet
+(``TPULSAR_CHAOS_SCHEDULE=<path>`` + ``TPULSAR_CHAOS_WORKER=<id>``,
+or ``configure_schedule()``).  The schedule is a timeline of fault
+windows written once by the chaos conductor::
+
+    {"t0": <unix>, "entries": [
+       {"worker": "w0", "at": 5.0, "until": 20.0,
+        "faults": "spool.io:unimplemented:count=2,errno=ENOSPC"},
+       {"worker": "*", "at": 10.0,
+        "faults": "journal.append:unimplemented:rate=0.5,seed=7"}]}
+
+Each process activates the entries addressed to its worker id (``*``
+matches everyone) while ``t0+at <= now < t0+until`` — so ONE file
+drives a deterministic, coordinated failure storm across N processes
+that share nothing but the spool.  Scheduled specs layer OVER the
+baseline (a scheduled point shadows the env spec for that point while
+its window is open) and keep their trigger counters across polls, so
+``count=`` limits hold for the whole window.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import errno as errno_mod
+import json
 import os
 import random
 import threading
@@ -57,9 +87,17 @@ FAULT_POINTS = (
     "fleet.worker",         # fleet worker-crash injection: the server
     #                         hard-exits (os._exit) mid-beam — claim
     #                         left in place, no result, no drain
+    "spool.io",             # serve/protocol.py ticket/result/heartbeat
+    #                         writes: EIO/ENOSPC on the tmp-write +
+    #                         rename path (the transition must fail
+    #                         cleanly, never leave a torn .json)
+    "journal.append",       # obs/journal.py event append: the journal
+    #                         is observational, so an injected failure
+    #                         here must cost evidence, never the
+    #                         transition the event describes
 )
 
-MODES = ("unimplemented", "hang", "poison")
+MODES = ("unimplemented", "hang", "delay", "poison")
 
 
 @dataclasses.dataclass
@@ -71,6 +109,8 @@ class FaultSpec:
     after: int = 0
     count: int = 0          # 0 = unlimited
     seconds: float = 30.0
+    errno_name: str = ""    # raise OSError(<errno>) instead of the
+    #                         refusal-shaped default (spool I/O specs)
 
     # runtime state (not part of the parsed spec)
     calls: int = 0
@@ -87,10 +127,32 @@ _LOCK = threading.Lock()
 _SPECS: dict[str, FaultSpec] | None = None   # None = env not read yet
 _POISONED: str = ""                          # point that poisoned us
 
+#: chaos-schedule state (see module docstring).  _SCHED_PATH: None =
+#: env not read yet, "" = disabled, else the schedule file to poll.
+SCHEDULE_POLL_S = 0.25
+_SCHED_PATH: str | None = None
+_SCHED_WORKER: str = ""
+_SCHED_NEXT_POLL: float = 0.0
+_SCHED_MTIME: float = -1.0
+_SCHED_DOC: dict | None = None
+#: entry index -> parsed specs (spec OBJECTS persist across polls
+#: while their window stays open, so counters/count= limits hold)
+_SCHED_ACTIVE: dict[int, dict[str, FaultSpec]] = {}
+#: the merged point -> spec view fire() consults (later entries win)
+_SCHED_MERGED: dict[str, FaultSpec] = {}
+
 
 class SessionPoisoned(RuntimeError):
     """A `poison` fault fired earlier: the simulated session refuses
     everything from here on (the wedged-chip failure mode)."""
+
+
+def io_error(msg: str) -> OSError:
+    """EIO-shaped default for the spool I/O fault points — sites pass
+    this as make_exc so an armed ``spool.io``/``journal.append`` spec
+    without an ``errno=`` option still raises what a failing disk
+    would (a spec errno, e.g. ENOSPC, overrides it)."""
+    return OSError(errno_mod.EIO, msg)
 
 
 def parse_spec(text: str) -> dict[str, FaultSpec]:
@@ -115,6 +177,8 @@ def parse_spec(text: str) -> dict[str, FaultSpec]:
                 f"unknown fault mode {mode!r} (modes: "
                 f"{', '.join(MODES)})")
         spec = FaultSpec(point=point, mode=mode)
+        if mode == "delay":
+            spec.seconds = 0.25   # slow I/O, not a watchdog stall
         if len(fields) == 3 and fields[2].strip():
             for opt in fields[2].split(","):
                 if "=" not in opt:
@@ -133,6 +197,13 @@ def parse_spec(text: str) -> dict[str, FaultSpec]:
                     spec.count = int(val)
                 elif key == "seconds":
                     spec.seconds = float(val)
+                elif key == "errno":
+                    name = val.strip().upper()
+                    if not isinstance(getattr(errno_mod, name, None),
+                                      int):
+                        raise ValueError(
+                            f"unknown errno name {val!r}")
+                    spec.errno_name = name
                 else:
                     raise ValueError(f"unknown fault option {key!r}")
         if point in specs:
@@ -143,22 +214,109 @@ def parse_spec(text: str) -> dict[str, FaultSpec]:
 
 def configure(text: str | None = None) -> None:
     """Arm the layer from a spec string (tests) or from the
-    TPULSAR_FAULTS env (text=None).  Clears poisoned state."""
-    global _SPECS, _POISONED
+    TPULSAR_FAULTS env (text=None).  Clears poisoned state and
+    re-reads the chaos-schedule env (TPULSAR_CHAOS_SCHEDULE)."""
+    global _SPECS, _POISONED, _SCHED_PATH
     with _LOCK:
         if text is None:
             text = os.environ.get("TPULSAR_FAULTS", "")
         _SPECS = parse_spec(text)
         _POISONED = ""
+        _SCHED_PATH = None       # re-read env on next use
+        _clear_schedule_state()
 
 
 def reset() -> None:
-    """Disarm everything (including the env spec — tests call this in
-    teardown so one test's faults never leak into the next)."""
-    global _SPECS, _POISONED
+    """Disarm everything (including the env spec and any chaos
+    schedule — tests call this in teardown so one test's faults never
+    leak into the next)."""
+    global _SPECS, _POISONED, _SCHED_PATH
     with _LOCK:
         _SPECS = {}
         _POISONED = ""
+        _SCHED_PATH = ""
+        _clear_schedule_state()
+
+
+def configure_schedule(path: str | None, worker: str = "") -> None:
+    """Point this process at a chaos schedule file (the conductor's
+    in-process components call this; workers inherit the env vars).
+    ``path`` None/"" disables polling."""
+    global _SCHED_PATH, _SCHED_WORKER
+    with _LOCK:
+        _SCHED_PATH = path or ""
+        _SCHED_WORKER = worker or ""
+        _clear_schedule_state()
+
+
+def _clear_schedule_state() -> None:
+    global _SCHED_NEXT_POLL, _SCHED_MTIME, _SCHED_DOC
+    _SCHED_NEXT_POLL = 0.0
+    _SCHED_MTIME = -1.0
+    _SCHED_DOC = None
+    _SCHED_ACTIVE.clear()
+    _SCHED_MERGED.clear()
+
+
+def _sched_poll() -> None:
+    """Refresh the scheduled-fault view (call sites hold no lock;
+    this takes it).  Cheap when nothing changed: one time comparison,
+    one stat every SCHEDULE_POLL_S, a rebuild only when a window
+    opens/closes or the file is rewritten."""
+    global _SCHED_PATH, _SCHED_WORKER, _SCHED_NEXT_POLL, \
+        _SCHED_MTIME, _SCHED_DOC
+    with _LOCK:
+        if _SCHED_PATH is None:
+            _SCHED_PATH = os.environ.get("TPULSAR_CHAOS_SCHEDULE", "")
+            _SCHED_WORKER = os.environ.get("TPULSAR_CHAOS_WORKER", "")
+        if not _SCHED_PATH:
+            return
+        now = time.time()
+        if now < _SCHED_NEXT_POLL:
+            return
+        _SCHED_NEXT_POLL = now + SCHEDULE_POLL_S
+        try:
+            mtime = os.stat(_SCHED_PATH).st_mtime
+        except OSError:
+            if _SCHED_DOC is not None:
+                _SCHED_DOC = None
+                _SCHED_ACTIVE.clear()
+                _SCHED_MERGED.clear()
+            return
+        if mtime != _SCHED_MTIME or _SCHED_DOC is None:
+            _SCHED_MTIME = mtime
+            try:
+                with open(_SCHED_PATH) as fh:
+                    _SCHED_DOC = json.load(fh)
+            except (OSError, ValueError):
+                return           # mid-write; next poll retries
+            _SCHED_ACTIVE.clear()   # entry indices may have moved
+        doc = _SCHED_DOC or {}
+        t0 = float(doc.get("t0", 0.0))
+        live: set[int] = set()
+        for idx, entry in enumerate(doc.get("entries", ())):
+            who = str(entry.get("worker", "*"))
+            if who not in ("*", _SCHED_WORKER):
+                continue
+            at = t0 + float(entry.get("at", 0.0))
+            until = entry.get("until")
+            if now < at or (until is not None
+                            and now >= t0 + float(until)):
+                continue
+            live.add(idx)
+            if idx not in _SCHED_ACTIVE:
+                try:
+                    _SCHED_ACTIVE[idx] = parse_spec(
+                        str(entry.get("faults", "")))
+                except ValueError:
+                    # a bad entry must be loud, not silent — but a
+                    # worker mid-beam cannot crash over it either
+                    _SCHED_ACTIVE[idx] = {}
+        for idx in [i for i in _SCHED_ACTIVE if i not in live]:
+            del _SCHED_ACTIVE[idx]
+        _SCHED_MERGED.clear()
+        for idx in sorted(_SCHED_ACTIVE):
+            _SCHED_MERGED.update(_SCHED_ACTIVE[idx])
 
 
 def _specs() -> dict[str, FaultSpec]:
@@ -169,23 +327,28 @@ def _specs() -> dict[str, FaultSpec]:
 
 
 def active() -> bool:
-    return bool(_specs())
+    _sched_poll()
+    return bool(_specs()) or bool(_SCHED_MERGED)
 
 
 def targets(point: str) -> bool:
-    """Is this exact point armed?  Used by path gates: a spec naming
-    accel.row_dispatch pins the per-DM path so the fault actually
-    fires (the batched/native paths never dispatch rows)."""
-    return point in _specs()
+    """Is this exact point armed (env spec or an open schedule
+    window)?  Used by path gates: a spec naming accel.row_dispatch
+    pins the per-DM path so the fault actually fires (the
+    batched/native paths never dispatch rows)."""
+    _sched_poll()
+    return point in _specs() or point in _SCHED_MERGED
 
 
 def targets_prefix(prefix: str) -> bool:
-    return any(p.startswith(prefix) for p in _specs())
+    _sched_poll()
+    return any(p.startswith(prefix) for p in _specs()) \
+        or any(p.startswith(prefix) for p in _SCHED_MERGED)
 
 
 def fired(point: str) -> int:
     """How many times this point's fault has triggered (tests)."""
-    spec = _specs().get(point)
+    spec = _SCHED_MERGED.get(point) or _specs().get(point)
     return spec.fired if spec else 0
 
 
@@ -212,8 +375,9 @@ def fire(point: str, make_exc=None, detail: str = "") -> None:
     dispatch loops.
     """
     global _POISONED
+    _sched_poll()
     specs = _specs()
-    if not specs and not _POISONED:
+    if not specs and not _SCHED_MERGED and not _POISONED:
         return
     with _LOCK:
         if _POISONED:
@@ -228,7 +392,11 @@ def fire(point: str, make_exc=None, detail: str = "") -> None:
                     + (f" ({detail})" if detail else ""))
             raise make_exc(pmsg) if make_exc is not None \
                 else SessionPoisoned(pmsg)
-        spec = specs.get(point)
+        # an open schedule window shadows the env baseline for its
+        # point: the conductor's storm is authoritative while it lasts
+        spec = _SCHED_MERGED.get(point)
+        if spec is None:
+            spec = specs.get(point)
         if spec is None:
             return
         spec.calls += 1
@@ -245,17 +413,29 @@ def fire(point: str, make_exc=None, detail: str = "") -> None:
     msg = (f"UNIMPLEMENTED: injected fault at {point} "
            f"(trigger #{n}, mode={spec.mode}"
            + (f", {detail}" if detail else "") + ")")
-    if spec.mode == "hang":
-        # a hung dispatch: sleep past the watchdog deadline, then
-        # proceed — policy.run_with_deadline converts the stall into
-        # a classified DeadlineExceeded instead of an unbounded hang
+    if spec.mode in ("hang", "delay"):
+        # hang: sleep past the watchdog deadline, then proceed —
+        # policy.run_with_deadline converts the stall into a
+        # classified DeadlineExceeded instead of an unbounded hang.
+        # delay: the same sleep at slow-I/O magnitude (default
+        # 0.25 s) — latency the caller must absorb, not a failure.
         time.sleep(spec.seconds)
         return
+    if spec.errno_name:
+        # operator-shaped error wins over the site's taxonomy: an
+        # errno= spec exists to exercise exactly that OSError path
+        raise OSError(getattr(errno_mod, spec.errno_name), msg)
     raise make_exc(msg) if make_exc is not None else _default_exc(msg)
 
 
 def snapshot() -> dict[str, dict]:
-    """Armed specs + trigger counts (doctor/debug output)."""
-    return {p: {"mode": s.mode, "rate": s.rate, "calls": s.calls,
-                "fired": s.fired}
-            for p, s in _specs().items()}
+    """Armed specs + trigger counts (doctor/debug output).  Scheduled
+    specs (open chaos windows) are included and marked."""
+    _sched_poll()
+    out = {p: {"mode": s.mode, "rate": s.rate, "calls": s.calls,
+               "fired": s.fired}
+           for p, s in _specs().items()}
+    for p, s in _SCHED_MERGED.items():
+        out[p] = {"mode": s.mode, "rate": s.rate, "calls": s.calls,
+                  "fired": s.fired, "scheduled": True}
+    return out

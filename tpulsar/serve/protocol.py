@@ -62,6 +62,7 @@ import time
 import uuid
 
 from tpulsar.obs import journal
+from tpulsar.resilience import faults
 
 #: heartbeats older than this are stale: the worker is gone (crashed,
 #: drained, or never started); with zero fresh workers clients must
@@ -100,9 +101,25 @@ def _atomic_write_json(path: str, rec: dict) -> None:
     # makes the warm backend abandon live tickets
     import threading
     tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-    with open(tmp, "w") as fh:
-        json.dump(rec, fh, indent=1)
-    os.replace(tmp, path)
+    # the spool I/O fault point (EIO/ENOSPC on protocol writes):
+    # every ticket/result/heartbeat write funnels through here, so
+    # one spec exercises the whole containment story
+    faults.fire("spool.io", make_exc=faults.io_error, detail=path)
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(rec, fh, indent=1)
+        os.replace(tmp, path)
+    except BaseException:
+        # ENOSPC mid-dump (or a kill) must not leave the partial tmp
+        # behind: claimers already ignore .tmp names, but an orphaned
+        # tmp would read as un-quiesced work to the chaos auditor —
+        # and the FAILED write must fail the transition cleanly with
+        # nothing half-visible at the destination path
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _read_json(path: str) -> dict | None:
@@ -146,8 +163,21 @@ def write_ticket(spool: str, ticket_id: str, datafiles: list[str],
     # honest, and harmless to every consumer.
     journal.record(spool, "submitted", ticket=ticket_id,
                    attempt=0, trace_id=rec["trace_id"],
-                   outdir=outdir)
-    _atomic_write_json(ticket_path(spool, ticket_id, "incoming"), rec)
+                   outdir=outdir,
+                   **({"tenant": rec["tenant"]} if rec.get("tenant")
+                      else {}))
+    try:
+        _atomic_write_json(ticket_path(spool, ticket_id, "incoming"),
+                           rec)
+    except OSError as e:
+        # the incoming/ write failed (full disk, injected spool.io):
+        # the submission FAILED — compensate the already-journaled
+        # 'submitted' head so the auditor can tell a cleanly-refused
+        # beam from a lost one, then surface the error to the caller
+        journal.record(spool, "submit_failed", ticket=ticket_id,
+                       attempt=0, trace_id=rec["trace_id"],
+                       error=str(e)[:200])
+        raise
     _invalidate_capacity(spool)
     return ticket_id
 
@@ -298,7 +328,12 @@ def claim_next_ticket(spool: str, worker_id: str = "",
             trace_id=rec.get("trace_id", ""),
             queue_wait_s=round(
                 rec["claimed_at"] - rec.get("submitted_at",
-                                            rec["claimed_at"]), 3))
+                                            rec["claimed_at"]), 3),
+            # the tenant rides the claim event so per-tenant inflight
+            # can be reconstructed from the journal alone (the chaos
+            # verifier's quota invariant)
+            **({"tenant": rec["tenant"]} if rec.get("tenant")
+               else {}))
 
     if policy is None or getattr(policy, "is_trivial", False):
         # a trivial policy (no tenants configured) IS FIFO: skip the
@@ -336,7 +371,18 @@ def claim_next_ticket(spool: str, worker_id: str = "",
         rec["claimed_by"] = os.getpid()
         if worker_id:
             rec["claimed_by_worker"] = worker_id
-        _atomic_write_json(staging, rec)
+        try:
+            _atomic_write_json(staging, rec)
+        except OSError:
+            # the stamp write failed (ENOSPC, injected spool.io):
+            # withdraw the claim CLEANLY — the ticket goes straight
+            # back to incoming instead of idling in its .claiming
+            # side-file until the grace-window recovery notices it
+            try:
+                os.rename(staging, src)
+            except OSError:
+                pass         # stolen meanwhile: the ticket is safe
+            raise
         # the replace above refreshed the staging mtime, so from here
         # we hold a fresh full grace window — but if we stalled BEFORE
         # it, the write may have re-created a path a janitor already

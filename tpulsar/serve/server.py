@@ -222,7 +222,13 @@ class SearchServer:
         self.pipeline.start()
         try:
             while not self.draining:
-                self._heartbeat("running")
+                try:
+                    self._heartbeat("running")
+                except OSError:
+                    # a failed heartbeat write (spool I/O fault) costs
+                    # freshness, not the worker: the background loop
+                    # retries within heartbeat_interval_s
+                    pass
                 prepared = self.pipeline.next(timeout=self.poll_s)
                 if prepared is not None:
                     self._process(prepared)
@@ -245,13 +251,24 @@ class SearchServer:
         # pid still owns, attempt-neutral (a drain is not a crash; the
         # returned beams are not suspects)
         leftovers = self.pipeline.stop()
-        requeued = protocol.requeue_own_claims(self.spool)
+        try:
+            requeued = protocol.requeue_own_claims(self.spool)
+        except OSError as e:
+            # a failing spool during drain: the claims stay put and
+            # the janitor recovers them once this pid is gone — the
+            # drain must still stamp its heartbeat and exit
+            self.log.error("drain requeue failed (%s); leaving "
+                           "claims for the janitor", e)
+            requeued = []
         if requeued:
             self.log.info(
                 "drain requeued %d unstarted ticket(s) (%d of them "
                 "already staged): %s", len(requeued), len(leftovers),
                 ", ".join(requeued))
-        self._heartbeat("stopped", force=True)
+        try:
+            self._heartbeat("stopped", force=True)
+        except OSError:
+            pass
         dt = time.time() - t0
         telemetry.serve_drain_seconds().observe(dt)
         self.log.info(
@@ -370,17 +387,39 @@ class SearchServer:
         # by their measured compile traffic too — a deadline kill
         # during a compile is a cold failure)
         warm = extra.get("compile_misses", 0) == 0
-        protocol.write_result(
-            self.spool, tid, status,
-            rc=0 if status in ("done", "skipped") else 1,
-            error=error, beam_seconds=dt, warm=warm,
-            outdir=outdir, worker=self.worker_id, **extra)
+        # a TRANSIENT spool I/O failure (EIO burst, momentary ENOSPC)
+        # must not cost a finished beam its result — retry briefly.
+        # A PERSISTENT one must surface: the raise unwinds the serve
+        # loop into _shutdown, the claim stays in place, and after
+        # this worker dies the janitor reassigns the beam — degraded
+        # but never lost, never double-recorded.
+        for io_try in range(3):
+            try:
+                protocol.write_result(
+                    self.spool, tid, status,
+                    rc=0 if status in ("done", "skipped") else 1,
+                    error=error, beam_seconds=dt, warm=warm,
+                    outdir=outdir, worker=self.worker_id, **extra)
+                break
+            except OSError as e:
+                if io_try == 2:
+                    self.log.error(
+                        "ticket %s: result write failed 3x (%s) — "
+                        "leaving the claim for the janitor", tid, e)
+                    raise
+                self.log.warning(
+                    "ticket %s: result write failed (%s); retrying",
+                    tid, e)
+                time.sleep(0.05 * (io_try + 1))
         self.beams[status] = self.beams.get(status, 0) + 1
         telemetry.serve_beams_total().inc(outcome=status)
         if status != "skipped":
             telemetry.serve_beam_seconds().observe(
                 dt, mode="warm" if warm else "cold")
         telemetry.trace.set_trace_id("")     # the beam's context ends
-        self._heartbeat("running", force=True)
+        try:
+            self._heartbeat("running", force=True)
+        except OSError:
+            pass      # the result IS durable; freshness catches up
         self.log.info("ticket %s -> %s in %.2f s (%s)", tid, status,
                       dt, "warm" if warm else "cold")
