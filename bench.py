@@ -1519,6 +1519,112 @@ def run_chaos() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_resume() -> None:
+    """``bench.py --resume``: the recovery-cost contrast the
+    checkpoint layer (tpulsar/checkpoint/) exists to win.  The SAME
+    seeded kill-mid-beam scenario — multi-pass stub beams through a
+    2-worker fleet, w0 SIGKILLed mid-beam — runs twice: once with
+    pass-level checkpointing (the default) and once with
+    ``--no-checkpoint`` workers (the from-zero control that models
+    every release before this one).  The journal-derived
+    ``wasted_compute_s`` (kill-destroyed compute minus what the
+    resumed attempt salvaged from the manifest — see
+    invariants.recovery_stats) is the headline: checkpointed recovery
+    must waste only the in-flight pass, not the whole beam.  The
+    invariant verifier (including the new ``resume_consistent`` /
+    ``no_pass_rerun`` invariants) runs over BOTH spools and its
+    violation count is part of the record — the only acceptable
+    value is 0.  Emits one bench/v2 record with an additive
+    ``resume`` key.  Knobs: TPULSAR_RESUME_NBEAMS/PASSES/PASS_S
+    (default 3/8/0.15), TPULSAR_RESUME_KEEP=1 keeps the spools."""
+    import shutil
+    import tempfile
+
+    from tpulsar.chaos import invariants, runner, scenario
+    from tpulsar.obs import journal
+
+    nbeams = int(os.environ.get("TPULSAR_RESUME_NBEAMS", "3"))
+    passes = int(os.environ.get("TPULSAR_RESUME_PASSES", "8"))
+    pass_s = float(os.environ.get("TPULSAR_RESUME_PASS_S", "0.15"))
+    base = tempfile.mkdtemp(prefix="tpulsar_resumebench_")
+    # the kill lands mid-first-beam, several passes in: late enough
+    # that the checkpoint store holds real salvage, early enough that
+    # the control run still has most of the beam left to waste
+    kill_t = round(passes * pass_s * 0.6, 2)
+
+    def one(tag: str, extra_args: tuple) -> dict:
+        spool = os.path.join(base, f"spool_{tag}")
+        sc = scenario.from_dict({
+            "name": f"resume-{tag}", "seed": 11, "duration_s": 120.0,
+            "workers": 2, "worker_kind": "stub", "max_attempts": 3,
+            "workload": {"beams": nbeams, "interval_s": 0.1,
+                         "passes": passes, "pass_s": pass_s},
+            "timeline": [{"t": kill_t, "action": "kill_worker",
+                          "worker": "w0", "signal": "KILL"}],
+            "quiesce_timeout_s": 90.0,
+        })
+        _log(f"resume bench [{tag}]: {nbeams} beams x {passes} "
+             f"passes x {pass_s:g} s, w0 killed at t+{kill_t:g} s"
+             + (f" ({' '.join(extra_args)})" if extra_args else ""))
+        manifest = runner.run_scenario(sc, spool,
+                                       worker_extra_args=extra_args)
+        events = journal.read_events(spool)
+        report = invariants.verify(spool,
+                                   quiesced=manifest["quiesced"])
+        stats = invariants.recovery_stats(events)
+        names = [e.get("event") for e in events]
+        return {
+            "quiesced": manifest["quiesced"],
+            "wasted_compute_s": stats["wasted_compute_s"],
+            "mttr_s": stats["mttr_s"],
+            "resumes": names.count("resume"),
+            "pass_completes": names.count("pass_complete"),
+            "invariant_violations": len(report["violations"]),
+            "violations": report["violations"][:10],
+        }
+
+    ck = one("ckpt", ())
+    ctrl = one("control", ("--no-checkpoint",))
+    w_ck = ck["wasted_compute_s"]
+    w_ctrl = ctrl["wasted_compute_s"]
+    reduction = (round(1.0 - w_ck / w_ctrl, 3)
+                 if w_ck is not None and w_ctrl else -1.0)
+    _log(f"wasted compute: checkpointed {w_ck} s vs control "
+         f"{w_ctrl} s ({reduction if reduction >= 0 else '?'} "
+         f"reduction); violations "
+         f"{ck['invariant_violations']}+{ctrl['invariant_violations']}")
+    result = {
+        "metric": "resume_wasted_compute",
+        "value": w_ck if w_ck is not None else -1.0,
+        "unit": "s",
+        "resume": {
+            "nbeams": nbeams, "passes": passes, "pass_s": pass_s,
+            "kill_t_s": kill_t,
+            "wasted_compute_s": (w_ck if w_ck is not None else -1.0),
+            "wasted_compute_control_s": (
+                w_ctrl if w_ctrl is not None else -1.0),
+            # fraction of the control run's waste the checkpoint
+            # layer eliminated — the acceptance floor is 0.5
+            "wasted_reduction": reduction,
+            "mttr_s": (ck["mttr_s"] if ck["mttr_s"] is not None
+                       else -1.0),
+            "resumes": ck["resumes"],
+            "pass_completes": ck["pass_completes"],
+            "quiesced": ck["quiesced"] and ctrl["quiesced"],
+            # the correctness row: MUST be 0 (CI asserts it
+            # explicitly — the gate skips zero-valued keys)
+            "invariant_violations": (ck["invariant_violations"]
+                                     + ctrl["invariant_violations"]),
+        },
+    }
+    if ck["violations"] or ctrl["violations"]:
+        result["resume"]["violation_sample"] = (
+            ck["violations"] + ctrl["violations"])[:10]
+    _emit(result)
+    if os.environ.get("TPULSAR_RESUME_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _usable_cpus() -> list:
     """The CPU ids this process may actually run on, for taskset
     pinning (a cgroup cpuset need not start at 0 or be contiguous)."""
@@ -1838,6 +1944,9 @@ def main() -> None:
         return
     if "--chaos" in sys.argv:
         run_chaos()
+        return
+    if "--resume" in sys.argv:
+        run_resume()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

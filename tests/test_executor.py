@@ -226,17 +226,16 @@ def test_checkpoint_config_mismatch_wipes(tmp_path):
                                make_plots=False, sp_threshold=9.0)
     executor.search_block(data, freqs, 65e-6, plan, p2,
                           checkpoint_dir=ck)
+    from tpulsar import checkpoint as ckpt
     path2 = g.glob(os.path.join(ck, "pass_*.npz"))[0]
     assert os.path.getmtime(path2) >= mtime
-    with open(os.path.join(ck, "manifest.txt")) as fh:
-        fp2 = fh.read()
-    # same config -> resumed (manifest unchanged, dump not rewritten)
+    fp2 = ckpt.read_manifest(ck)["fingerprint"]
+    # same config -> resumed (fingerprint unchanged, dump not rewritten)
     mtime2 = os.path.getmtime(path2)
     executor.search_block(data, freqs, 65e-6, plan, p2,
                           checkpoint_dir=ck)
     assert os.path.getmtime(path2) == mtime2
-    with open(os.path.join(ck, "manifest.txt")) as fh:
-        assert fh.read() == fp2
+    assert ckpt.read_manifest(ck)["fingerprint"] == fp2
 
 
 def test_checkpoint_beam_mismatch_wipes(tmp_path):
@@ -252,14 +251,13 @@ def test_checkpoint_beam_mismatch_wipes(tmp_path):
     ck = str(tmp_path / "ck")
     p = executor.SearchParams(run_hi_accel=False, max_cands_to_fold=0,
                               make_plots=False)
+    from tpulsar import checkpoint as ckpt
     executor.search_block(data, freqs, 65e-6, plan, p,
                           checkpoint_dir=ck, data_id="beamA")
-    with open(os.path.join(ck, "manifest.txt")) as fh:
-        fp_a = fh.read()
+    fp_a = ckpt.read_manifest(ck)["fingerprint"]
     executor.search_block(data, freqs, 65e-6, plan, p,
                           checkpoint_dir=ck, data_id="beamB")
-    with open(os.path.join(ck, "manifest.txt")) as fh:
-        assert fh.read() != fp_a
+    assert ckpt.read_manifest(ck)["fingerprint"] != fp_a
 
 
 def test_low_T_guard(tmp_path):

@@ -67,6 +67,9 @@ DEFAULT_KEYS = (
     ("chaos.takeover_latency_s", "lower"),
     ("chaos.e2e_p95_chaos_s", "lower"),
     ("chaos.e2e_p95_clean_s", "lower"),
+    ("resume.wasted_compute_s", "lower"),
+    ("resume.wasted_reduction", "higher"),
+    ("resume.mttr_s", "lower"),
 )
 
 

@@ -51,6 +51,20 @@ The invariants (violation ``invariant`` field -> meaning):
                           state implied by it exists (a done record
                           without its terminal event is a lost
                           append)
+  resume_consistent       a beam that rode checkpoint resume finishes
+                          with candidates BYTE-IDENTICAL to an
+                          uninterrupted run: the stub worker's
+                          per-pass payloads are a pure function of
+                          (ticket, pass), so the terminal record's
+                          candidates_digest is recomputed here and
+                          compared exactly
+  no_pass_rerun           a journaled ``pass_complete`` (the artifact
+                          is durable + manifested) is never executed
+                          again after a resume — unless that
+                          checkpoint was journaled invalid
+                          (``checkpoint_invalid``) or checkpointing
+                          was disabled (``checkpoint_disabled``),
+                          the only legitimate recompute reasons
 
 ``verify()`` is the one entry point; ``tail_verify()`` runs the
 online subset while a run is still in flight (riding
@@ -98,6 +112,12 @@ INVARIANTS = {
         "a kill between durable result and journal append is a "
         "counted gap, not a violation) and chains start at "
         "submission",
+    "resume_consistent":
+        "a resumed beam's terminal candidates_digest equals the "
+        "uninterrupted golden run's (byte-identical science)",
+    "no_pass_rerun":
+        "journaled pass completions are never re-executed after "
+        "resume (checkpoint_invalid/_disabled are the only excuses)",
 }
 
 #: events that RELEASE a claim (close an inflight interval)
@@ -128,11 +148,16 @@ def _spool_presence(spool: str, tid: str) -> dict:
 
 
 def _audit_chain(tid: str, events: list[dict], presence: dict,
-                 max_attempts: int, quiesced: bool) -> list[dict]:
+                 max_attempts: int, quiesced: bool,
+                 done_rec: dict | None = None) -> list[dict]:
     """The per-ticket audits (everything except the cross-ticket
-    quota/trace/sidefile/capacity sweeps)."""
+    quota/trace/sidefile/capacity sweeps).  ``done_rec`` (the durable
+    result record, when the caller has it) enables the
+    resume_consistent digest check; the live tail passes None and
+    leaves that to the final full verify."""
     out: list[dict] = []
     names = [e.get("event") for e in events]
+    out.extend(_audit_checkpoints(tid, events, done_rec))
 
     if "submit_failed" in names:
         extra = [n for n in names if n not in
@@ -226,6 +251,99 @@ def _audit_chain(tid: str, events: list[dict], presence: dict,
             out.append(_v("attempts_monotone", tid,
                           f"terminal attempt {term_att} != expected "
                           f"{expect}"))
+    return out
+
+
+def _audit_checkpoints(tid: str, events: list[dict],
+                       done_rec: dict | None) -> list[dict]:
+    """The checkpoint-resume discipline of one chain.
+
+    no_pass_rerun: replay the chain tracking which passes are
+    journaled durable; a second ``pass_complete`` for the same pass
+    is a violation unless its checkpoint was journaled invalid in
+    between (``checkpoint_invalid`` scope=entry names the pass;
+    scope=manifest wipes everything) or checkpointing was disabled
+    for a later attempt (``checkpoint_disabled`` — from-zero re-runs
+    are then expected, not a bug).
+
+    resume_consistent: the stub worker's science is a pure function
+    of (ticket, pass index), so the uninterrupted golden digest is
+    recomputable right here — a terminal ``done`` record carrying
+    ``candidates_digest`` + ``passes`` must match it whether or not
+    the beam was ever interrupted."""
+    out: list[dict] = []
+    completed: set[int] = set()
+    excused = False
+    for ev in events:
+        name = ev.get("event")
+        if name == "checkpoint_disabled":
+            excused = True
+        elif name == "checkpoint_invalid":
+            if ev.get("scope") == "manifest":
+                completed.clear()
+            else:
+                key = str(ev.get("key", ""))
+                if key.startswith("pass_"):
+                    try:
+                        completed.discard(int(key[len("pass_"):]))
+                    except ValueError:
+                        pass
+        elif name == "pass_complete":
+            k = int(ev.get("pass_idx", -1))
+            if k in completed and not excused:
+                out.append(_v(
+                    "no_pass_rerun", tid,
+                    f"pass {k} journaled complete twice with no "
+                    f"checkpoint_invalid between (worker "
+                    f"{ev.get('worker', '?')}, attempt "
+                    f"{ev.get('attempt', 0)})"))
+            completed.add(k)
+    if done_rec and done_rec.get("status") == "done":
+        digest = done_rec.get("candidates_digest")
+        npasses = done_rec.get("passes")
+        if digest and npasses:
+            from tpulsar.chaos import worker as chaos_worker
+            want = chaos_worker.expected_digest(tid, int(npasses))
+            if digest != want:
+                resumed = any(e.get("event") == "resume"
+                              for e in events)
+                out.append(_v(
+                    "resume_consistent", tid,
+                    f"terminal candidates_digest {digest[:12]} != "
+                    f"uninterrupted golden {want[:12]}"
+                    + (" (chain resumed from checkpoints)"
+                       if resumed else "")))
+    return out
+
+
+def _checkpoint_litter_sweep(per_ticket: dict[str, list[dict]]
+                             ) -> list[dict]:
+    """Extend no_orphan_sidefiles over checkpoint/stage-in temp
+    files: a kill during ``checkpoint.write`` leaves ``*.tmp`` inside
+    a beam's ``.checkpoint`` dir — the next resume sweeps it,
+    quarantine removes the dir, completion cleans it, so whatever
+    remains at quiesce leaked past every janitor.  Outdirs are
+    learned from the journal's submitted events (no side channel)."""
+    from tpulsar import checkpoint as ckpt
+
+    out: list[dict] = []
+    seen: set[str] = set()
+    for tid, evs in sorted(per_ticket.items()):
+        outdir = next((e.get("outdir") for e in evs
+                       if e.get("outdir")), "")
+        if not outdir or outdir in seen:
+            continue
+        seen.add(outdir)
+        for d in (ckpt.default_root(outdir), outdir):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if name.endswith(".tmp"):
+                    out.append(_v(
+                        "no_orphan_sidefiles", tid,
+                        f"{os.path.join(d, name)} survived quiesce"))
     return out
 
 
@@ -347,11 +465,12 @@ def verify(spool: str, *, tenants: dict | None = None,
     counts = {"tickets": len(per_ticket), "events": len(events),
               "terminal": 0, "pending_at_quiesce": 0,
               "submit_failed": 0, "takeovers": 0, "quarantined": 0,
-              "journal_gaps": 0}
+              "resumes": 0, "journal_gaps": 0}
     for tid, evs in sorted(per_ticket.items()):
         presence = _spool_presence(spool, tid)
         violations.extend(_audit_chain(tid, evs, presence,
-                                       max_attempts, quiesced))
+                                       max_attempts, quiesced,
+                                       done_rec=done_recs.get(tid)))
         names = [e.get("event") for e in evs]
         if journal.TERMINAL_EVENT in names:
             counts["terminal"] += 1
@@ -367,6 +486,7 @@ def verify(spool: str, *, tenants: dict | None = None,
             counts["pending_at_quiesce"] += 1
         counts["takeovers"] += names.count("takeover")
         counts["quarantined"] += names.count("quarantined")
+        counts["resumes"] += names.count("resume")
         ids = {e["trace_id"] for e in evs if e.get("trace_id")}
         if len(ids) > 1:
             violations.append(_v(
@@ -387,6 +507,7 @@ def verify(spool: str, *, tenants: dict | None = None,
     violations.extend(_quota_sweep(per_ticket, done_recs, tenants))
     if quiesced:
         violations.extend(_sidefile_sweep(spool))
+        violations.extend(_checkpoint_litter_sweep(per_ticket))
     violations.extend(_capacity_check(spool))
 
     by_inv = {name: 0 for name in INVARIANTS}
@@ -480,17 +601,31 @@ def recovery_stats(events: list[dict]) -> dict:
     """Recovery timing extracted from the journal alone: for every
     conductor-journaled worker kill, the victims are the tickets that
     worker held at the kill instant — MTTR is kill -> their terminal
-    event (takeover latency is the janitor's share of it)."""
+    event (takeover latency is the janitor's share of it).
+
+    ``wasted_compute_s`` is the checkpoint layer's headline: per
+    victim, the compute the kill destroyed — (kill instant - that
+    attempt's ``search_start``) minus what the NEXT attempt's
+    ``resume`` salvaged.  Salvage is measured in WALL TIME from the
+    victim attempt's own journaled ``pass_complete`` instants (the
+    n-th durable pass, n = the resume event's ``passes_done``): the
+    resumed attempt skips the dead worker's compute AND its
+    checkpoint-write overhead, so both count as saved.  Falls back to
+    the resume event's nominal ``salvaged_s`` when the chain carries
+    no pass timestamps.  A from-zero control run journals no resume,
+    so its whole spent interval is waste.  Summed across victims and
+    kills; the bench/v2 ``resume`` key reads it."""
     per_ticket = journal.iter_tickets(events)
     kills = [e for e in events
              if e.get("event") == "chaos_action"
              and e.get("action") == "kill_worker"]
-    out = {"kills": [], "mttr_s": None, "takeover_latency_s": None}
+    out = {"kills": [], "mttr_s": None, "takeover_latency_s": None,
+           "wasted_compute_s": None}
     for kill in kills:
         w, t_kill = kill.get("worker", ""), kill.get("t", 0.0)
         victims = []
         for tid, evs in per_ticket.items():
-            holder, held_since = None, None
+            holder, held_since, started = None, None, None
             for ev in evs:
                 if ev.get("t", 0.0) > t_kill:
                     break
@@ -498,8 +633,12 @@ def recovery_stats(events: list[dict]) -> dict:
                 if name == "claimed":
                     holder = ev.get("worker", "")
                     held_since = ev.get("t")
+                    started = None
+                elif name == "search_start":
+                    started = ev.get("t")
                 elif name in _RELEASES:
                     holder = None
+                    started = None
             if holder != w:
                 continue
             term = next((e for e in evs
@@ -508,28 +647,57 @@ def recovery_stats(events: list[dict]) -> dict:
             steal = next((e for e in evs
                           if e.get("event") == "takeover"
                           and e.get("t", 0.0) >= t_kill), None)
+            resume = next((e for e in evs
+                           if e.get("event") == "resume"
+                           and e.get("t", 0.0) >= t_kill), None)
+            spent = (round(t_kill - started, 3)
+                     if started is not None else None)
+            salvaged = 0.0
+            if resume is not None:
+                n = int(resume.get("passes_done", 0))
+                pcs = [e.get("t", 0.0) for e in evs
+                       if e.get("event") == "pass_complete"
+                       and started is not None
+                       and started <= e.get("t", 0.0) <= t_kill]
+                if pcs and n:
+                    salvaged = pcs[min(n, len(pcs)) - 1] - started
+                else:
+                    salvaged = float(resume.get("salvaged_s", 0.0))
             victims.append({
                 "ticket": tid, "held_since": held_since,
                 "takeover_s": (round(steal["t"] - t_kill, 3)
                                if steal else None),
                 "recovered_s": (round(term["t"] - t_kill, 3)
-                                if term else None)})
+                                if term else None),
+                "spent_s": spent,
+                "salvaged_s": round(salvaged, 3),
+                "wasted_compute_s": (
+                    round(max(0.0, spent - salvaged), 3)
+                    if spent is not None else None)})
         rec = {"worker": w, "t": t_kill, "victims": victims}
         done = [v["recovered_s"] for v in victims
                 if v["recovered_s"] is not None]
         steals = [v["takeover_s"] for v in victims
                   if v["takeover_s"] is not None]
+        wastes = [v["wasted_compute_s"] for v in victims
+                  if v["wasted_compute_s"] is not None]
         rec["mttr_s"] = max(done) if done else None
         rec["takeover_latency_s"] = min(steals) if steals else None
+        rec["wasted_compute_s"] = (round(sum(wastes), 3)
+                                   if wastes else None)
         out["kills"].append(rec)
     mttrs = [k["mttr_s"] for k in out["kills"]
              if k["mttr_s"] is not None]
     lats = [k["takeover_latency_s"] for k in out["kills"]
             if k["takeover_latency_s"] is not None]
+    wastes = [k["wasted_compute_s"] for k in out["kills"]
+              if k["wasted_compute_s"] is not None]
     if mttrs:
         out["mttr_s"] = max(mttrs)
     if lats:
         out["takeover_latency_s"] = max(lats)
+    if wastes:
+        out["wasted_compute_s"] = round(sum(wastes), 3)
     return out
 
 
@@ -542,7 +710,8 @@ def render_verify(report: dict) -> str:
         f"{c['terminal']} terminal, {c['pending_at_quiesce']} "
         f"pending, {c['submit_failed']} submit-failed, "
         f"{c['takeovers']} takeover(s), {c['quarantined']} "
-        f"quarantined, {c['journal_gaps']} journal gap(s)")
+        f"quarantined, {c.get('resumes', 0)} checkpoint resume(s), "
+        f"{c['journal_gaps']} journal gap(s)")
     width = max(len(n) for n in INVARIANTS)
     for name in INVARIANTS:
         n = report["invariants"].get(name, 0)
@@ -589,7 +758,9 @@ def render_report(spool: str) -> str:
             f"  kill {k['worker']}: {len(k['victims'])} victim "
             f"beam(s), takeover latency "
             f"{k['takeover_latency_s'] if k['takeover_latency_s'] is not None else '-'} s, "
-            f"mttr {k['mttr_s'] if k['mttr_s'] is not None else '-'} s")
+            f"mttr {k['mttr_s'] if k['mttr_s'] is not None else '-'} s, "
+            f"wasted compute "
+            f"{k.get('wasted_compute_s') if k.get('wasted_compute_s') is not None else '-'} s")
     tenants = (manifest or {}).get("tenants") or {}
     report = verify(spool, tenants=tenants,
                     quiesced=bool((manifest or {}).get("quiesced",

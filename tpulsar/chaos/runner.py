@@ -213,6 +213,9 @@ class ChaosRunner:
             return
         tid = f"{self.sc.name}-{i:03d}"
         extra = {"beam_s": self.sc.beam_s}
+        if wl.passes:
+            extra["passes"] = wl.passes
+            extra["pass_s"] = wl.pass_s
         if wl.tenant:
             extra["tenant"] = wl.tenant
         if wl.priority not in (None, ""):

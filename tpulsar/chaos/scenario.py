@@ -100,6 +100,12 @@ class Workload:
     tenant: str = ""
     priority: object = None
     datafiles: list | None = None   # None = synthetic stub inputs
+    #: passes > 0 turns each stub beam into a MULTI-PASS checkpointed
+    #: beam (chaos/worker.py _run_pass_beam): `passes` units of
+    #: `pass_s` seconds each, dumped through the real checkpoint
+    #: store so kill-mid-beam scenarios exercise pass-level resume
+    passes: int = 0
+    pass_s: float = 0.05
 
 
 @dataclasses.dataclass
@@ -152,6 +158,14 @@ def from_dict(doc: dict) -> Scenario:
                          f"{SUBMIT_VIAS}")
     if wl.beams <= 0:
         raise ValueError("workload.beams must be positive")
+    if wl.passes < 0 or (wl.passes and wl.pass_s <= 0):
+        raise ValueError("workload.passes must be >= 0 with a "
+                         "positive pass_s")
+    if wl.passes and wl.via != "spool":
+        # the gateway client does not plumb the pass-beam extras —
+        # refuse loudly rather than run a storm whose beams silently
+        # never checkpoint
+        raise ValueError("workload.passes needs via=spool")
     timeline = []
     for i, a_doc in enumerate(tl_doc):
         a = _take(dict(a_doc), Action, f"timeline[{i}]")

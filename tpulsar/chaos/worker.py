@@ -31,11 +31,29 @@ implementation), with the pieces a storm needs:
 
 A beam is ``time.sleep(beam_s)`` (the ticket may carry its own
 ``beam_s``); everything else is byte-for-byte the serving stack.
+
+Multi-pass beams (checkpoint resume under chaos): a ticket carrying
+``passes``/``pass_s`` runs as ``passes`` sequential units through the
+REAL checkpoint layer (tpulsar/checkpoint/): each pass sleeps
+``pass_s`` then dumps a deterministic artifact into the ticket
+outdir's ``.checkpoint`` store (``pass_complete`` journaled once
+durable), and a reclaimed beam verifies the manifest and recomputes
+only the missing tail (``resume`` journaled with ``salvaged_s``).
+The per-pass payload is a PURE FUNCTION of (ticket, pass index) —
+:func:`pass_payload` — so the terminal result's
+``candidates_digest`` is recomputable by the invariant verifier from
+the journal alone, and "resumed candidates identical to an
+uninterrupted run" (``resume_consistent``) is a byte-exact check,
+not a heuristic.  ``--no-checkpoint`` is the from-zero control the
+resume bench contrasts against; ``--crash-after-pass N`` =
+``os._exit(70)`` right after computing (not resuming) a beam's N-th
+pass — the deterministic kill-mid-beam footprint.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import os
 import signal
 import sys
@@ -44,6 +62,79 @@ import time
 from tpulsar.obs import journal
 from tpulsar.resilience import faults
 from tpulsar.serve import protocol
+
+
+def pass_payload(ticket: str, k: int) -> bytes:
+    """Deterministic per-pass 'science': independent of worker,
+    attempt, and wall clock, so any combination of crashes and
+    resumes that computes every pass exactly once (or recomputes a
+    discarded one identically) yields the same final digest."""
+    return hashlib.sha256(f"{ticket}:pass{k}".encode()).digest()
+
+
+def expected_digest(ticket: str, npasses: int) -> str:
+    """The uninterrupted golden run's candidates_digest — what the
+    verifier's ``resume_consistent`` invariant compares against."""
+    h = hashlib.sha256()
+    for k in range(npasses):
+        h.update(pass_payload(ticket, k))
+    return h.hexdigest()
+
+
+def _run_pass_beam(spool: str, wid: str, rec: dict, args,
+                   npasses: int) -> dict:
+    """One multi-pass beam through the checkpoint store.  Returns the
+    result-record extras (passes, computed/resumed counts, digest)."""
+    from tpulsar import checkpoint as ckpt
+
+    tid = rec.get("ticket", "?")
+    att = int(rec.get("attempts", 0))
+    pass_s = float(rec.get("pass_s", 0.05))
+    outdir = rec.get("outdir") or ""
+
+    def jr(event: str, **extra) -> None:
+        journal.record(spool, event, ticket=tid, worker=wid,
+                       attempt=att,
+                       trace_id=rec.get("trace_id", ""), **extra)
+
+    store = None
+    if outdir and not args.no_checkpoint:
+        store = ckpt.CheckpointStore(
+            ckpt.default_root(outdir),
+            fingerprint=f"chaos:{tid}:{npasses}:{pass_s!r}",
+            journal=jr)
+    parts: dict[int, bytes] = {}
+    if store is not None:
+        # verify-then-skip: every prior artifact is loaded (and hash
+        # checked) up front, so the resume event's salvage accounting
+        # counts only artifacts that actually survived intact
+        for k in range(npasses):
+            data = store.load(f"pass_{k:04d}")
+            if data is not None:
+                parts[k] = data
+        if parts:
+            jr("resume", passes_done=len(parts), npasses=npasses,
+               salvaged_s=round(len(parts) * pass_s, 3))
+    computed = 0
+    for k in range(npasses):
+        if k in parts:
+            continue
+        time.sleep(pass_s)          # the 'compute'
+        computed += 1
+        data = pass_payload(tid, k)
+        parts[k] = data
+        if store is not None and store.save(
+                f"pass_{k:04d}", data, kind="pass", pass_idx=k):
+            jr("pass_complete", pass_idx=k, npasses=npasses)
+        if args.crash_after_pass and computed >= args.crash_after_pass:
+            os._exit(70)
+    h = hashlib.sha256()
+    for k in range(npasses):
+        h.update(parts[k])
+    return {"passes": npasses, "pass_s": pass_s,
+            "computed_passes": computed,
+            "resumed_passes": npasses - computed,
+            "candidates_digest": h.hexdigest()}
 
 
 def _policy():
@@ -73,6 +164,15 @@ def main(argv=None) -> int:
     p.add_argument("--exit-rc", type=int, default=-1,
                    help="exit immediately with this rc (spawn-crash "
                         "simulation; -1 = serve normally)")
+    p.add_argument("--no-checkpoint", action="store_true",
+                   help="run multi-pass beams WITHOUT the checkpoint "
+                        "store (the from-zero recovery control the "
+                        "resume bench measures waste against)")
+    p.add_argument("--crash-after-pass", type=int, default=0,
+                   help="os._exit(70) right after computing a beam's "
+                        "N-th pass (0 = never): a deterministic "
+                        "kill-mid-beam with the claim in place and "
+                        "the checkpoint store holding N artifacts")
     args = p.parse_args(argv)
 
     if args.exit_rc >= 0:
@@ -141,9 +241,15 @@ def main(argv=None) -> int:
             except BaseException:
                 os._exit(70)
         status, err = "done", ""
+        extras: dict = {}
+        npasses = int(rec.get("passes", 0) or 0)
         try:
             faults.fire("serve.beam", detail=f"ticket {tid}")
-            time.sleep(float(rec.get("beam_s", args.beam_s)))
+            if npasses > 0:
+                extras = _run_pass_beam(spool, wid, rec, args,
+                                        npasses)
+            else:
+                time.sleep(float(rec.get("beam_s", args.beam_s)))
         except Exception as e:   # noqa: BLE001 — crash isolation:
             status, err = "failed", str(e)[:500]   # this ticket only
         for io_try in range(3):
@@ -155,7 +261,7 @@ def main(argv=None) -> int:
                                                args.beam_s)),
                     warm=True, worker=wid, attempts=att,
                     outdir=rec.get("outdir", ""),
-                    trace_id=rec.get("trace_id", ""))
+                    trace_id=rec.get("trace_id", ""), **extras)
                 break
             except OSError:
                 if io_try == 2:
@@ -163,6 +269,12 @@ def main(argv=None) -> int:
                     # place — the janitor reassigns, never loses it
                     os._exit(74)
                 time.sleep(0.05 * (io_try + 1))
+        if status == "done" and npasses > 0 and rec.get("outdir"):
+            # resume state is disposable only once the result is
+            # durable (run_search's ordering) — and removing it keeps
+            # checkpoint litter out of the quiesced-spool audit
+            from tpulsar import checkpoint as ckpt
+            ckpt.clean(ckpt.default_root(rec["outdir"]))
         beat()
     if draining:
         try:

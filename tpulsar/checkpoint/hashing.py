@@ -1,0 +1,43 @@
+"""The one sha256 helper every integrity check shares.
+
+Three layers of this codebase verify bytes after writing them — the
+uploader's verify-after-write blob comparisons, the checkpoint
+manifests (tpulsar/checkpoint/store.py), and ad-hoc fingerprints —
+and before this module each grew its own spelling.  One helper, one
+algorithm, one place to change it: content integrity everywhere is
+``sha256`` over the raw bytes, hex-encoded.
+
+stdlib only — imported by serve/protocol.py-adjacent code that never
+imports jax or numpy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+#: streaming read granularity for file digests (1 MiB: large enough
+#: to amortize syscalls, small enough to keep memory flat on GB-scale
+#: artifacts)
+CHUNK_BYTES = 1 << 20
+
+
+def sha256_bytes(data: bytes) -> str:
+    """Hex sha256 of an in-memory payload."""
+    return hashlib.sha256(data).hexdigest()
+
+
+def sha256_file(path: str, chunk: int = CHUNK_BYTES) -> str:
+    """Hex sha256 of a file's contents, streamed (constant memory)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def short(digest: str, n: int = 12) -> str:
+    """Display prefix for log/error messages (never for comparison)."""
+    return digest[:n]

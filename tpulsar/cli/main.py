@@ -892,6 +892,53 @@ def cmd_chaos(args):
     return 2
 
 
+def cmd_checkpoint(args):
+    """Inspect/audit a beam's crash-resume checkpoints
+    (tpulsar/checkpoint/): render the manifest — fingerprint, one row
+    per artifact (key, kind, bytes, sha256 prefix, age) — and with
+    --verify re-hash every artifact against its manifest entry (exit
+    1 on any mismatch: the beam would recompute those on resume).
+    Accepts either a checkpoint dir or a beam outdir containing
+    ``.checkpoint``."""
+    import time as _time
+
+    from tpulsar import checkpoint as ckpt
+
+    root = args.dir
+    if not os.path.exists(ckpt.manifest_path(root)) \
+            and os.path.exists(
+                ckpt.manifest_path(ckpt.default_root(root))):
+        root = ckpt.default_root(root)
+    doc = ckpt.read_manifest(root)
+    if doc is None:
+        print(f"no readable checkpoint manifest under {root} "
+              f"(schema {ckpt.SCHEMA})")
+        return 1
+    entries = doc.get("entries") or {}
+    print(f"checkpoint: {root}")
+    print(f"  schema {doc.get('schema')}  fingerprint "
+          f"{str(doc.get('fingerprint'))[:16]}…  "
+          f"{len(entries)} artifact(s)")
+    now = _time.time()
+    for key, e in sorted(entries.items()):
+        age = now - float(e.get("written_at", now))
+        print(f"  {key:<12s} {e.get('kind', '?'):<9s} "
+              f"{e.get('bytes', -1):>10d} B  "
+              f"sha256 {str(e.get('sha256'))[:12]}…  "
+              f"{age:7.1f} s old")
+    if not args.verify:
+        return 0
+    report = ckpt.verify_root(root)
+    bad = [e for e in report["entries"] if not e["ok"]]
+    for e in bad:
+        print(f"  INVALID {e['key']}: {e['reason']}")
+    print("verify: OK — every artifact matches its manifest entry"
+          if report["ok"] else
+          f"verify: {len(bad)} invalid artifact(s) — resume would "
+          f"recompute them")
+    return 0 if report["ok"] else 1
+
+
 def cmd_search(args):
     from tpulsar.cli import search_job
     argv = list(args.files) + ["--outdir", args.outdir]
@@ -1412,6 +1459,17 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("--scenario", default=None)
     cp.add_argument("--max-attempts", type=int, default=3)
     cp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser(
+        "checkpoint",
+        help="inspect a beam's crash-resume checkpoints: render the "
+             "sha256 manifest, --verify re-hashes every artifact "
+             "(exit 1 on mismatch)")
+    sp.add_argument("dir", help="checkpoint dir, or a beam outdir "
+                                "containing .checkpoint")
+    sp.add_argument("--verify", action="store_true",
+                    help="re-hash every artifact against the manifest")
+    sp.set_defaults(fn=cmd_checkpoint)
 
     sp = sub.add_parser(
         "trace",

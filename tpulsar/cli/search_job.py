@@ -153,21 +153,29 @@ def prepare_inputs(fns: list[str], workdir: str,
 def run_search(ppfns: list[str], workdir: str, outdir: str,
                params: "executor.SearchParams",
                zap: np.ndarray | None,
-               log=print) -> "executor.SearchOutcome | None":
+               log=print,
+               journal=None) -> "executor.SearchOutcome | None":
     """Search a prepared beam and make the results durable in outdir
     (the device-owning half of a beam job, shared with serve/).
 
-    Checkpoints live in the durable output dir, so a retried
-    submission resumes at the first incomplete DDplan pass; a
+    Checkpoints (tpulsar/checkpoint/) live in the durable output dir,
+    so a retried submission — or a reclaimed fleet ticket — verifies
+    the manifest and resumes at the first incomplete artifact instead
+    of recomputing the beam from zero; ``journal`` (the serve
+    worker's spool-journal hook) carries the resume evidence
+    (``resume`` / ``pass_complete`` / ``checkpoint_invalid``).  A
     permanently-short observation is a clean skip (None return + a
     skipped.txt marker), not a failure the scheduler retries
     forever.  Returns the SearchOutcome, or None for a skip — both
     mean job success (rc 0)."""
-    ckdir = os.path.join(outdir, ".checkpoint")
+    from tpulsar import checkpoint as ckpt
+
+    ckdir = ckpt.default_root(outdir)
     try:
         outcome = executor.search_beam(
             ppfns, workdir, os.path.join(workdir, "results"),
-            params=params, zaplist=zap, checkpoint_dir=ckdir)
+            params=params, zaplist=zap, checkpoint_dir=ckdir,
+            checkpoint_journal=journal)
     except executor.TooShortToSearchError as e:
         os.makedirs(outdir, exist_ok=True)
         with open(os.path.join(outdir, "skipped.txt"), "w") as fh:
@@ -179,7 +187,7 @@ def run_search(ppfns: list[str], workdir: str, outdir: str,
         shutil.copy2(os.path.join(outcome.resultsdir, name),
                      os.path.join(outdir, name))
     # only after results are durable is resume state disposable
-    shutil.rmtree(ckdir, ignore_errors=True)
+    ckpt.clean(ckdir)
     log(f"search complete: {len(outcome.candidates)} candidates, "
         f"{outcome.num_dm_trials} DM trials")
     return outcome

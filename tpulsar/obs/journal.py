@@ -24,6 +24,20 @@ appends ONE stamped event per transition to
     stagein_done /   the prefetch thread staged the beam's inputs
     stagein_failed   (seconds / first error line)
     search_start     device work began (worker, attempt)
+    resume           the claimed beam restarted from checkpointed
+                     artifacts (tpulsar/checkpoint/): passes_done
+                     (+ salvaged_s where the worker can cost it) —
+                     recovery proportional to work LOST, not done
+    pass_complete    one checkpoint artifact (a DDplan pass) is
+                     durable + manifested (pass_idx/npasses); the
+                     unit the no_pass_rerun invariant audits
+    checkpoint_invalid   a corrupt/torn/mismatched checkpoint entry
+                     was discarded and recomputed (scope entry |
+                     manifest, key, reason) — excuses a re-run of
+                     exactly that pass
+    checkpoint_disabled  ENOSPC/EROFS on the checkpoint dir disabled
+                     checkpointing for the rest of the beam (the
+                     search finishes un-checkpointed, never fails)
     result           TERMINAL: the durable done/ record landed
                      (status done|failed|skipped, rc, worker, attempt)
     takeover         a janitor stole the claim from a DEAD owner

@@ -389,6 +389,18 @@ def chaos_violations_total() -> metrics.Counter:
         labelnames=("invariant",))
 
 
+def checkpoint_events_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_checkpoint_events_total",
+        "checkpoint-store lifecycle events (tpulsar/checkpoint/): "
+        "written = artifact durable+manifested, resumed = artifact "
+        "verified and loaded on re-entry, invalid = corrupt/torn "
+        "entry discarded and recomputed, disabled = ENOSPC/EROFS "
+        "degraded the beam to un-checkpointed — 'invalid' at any "
+        "sustained rate means a sick checkpoint volume",
+        labelnames=("outcome",))
+
+
 # --------------------------------------------------------------------
 # the shared heartbeat/progress event shape
 # --------------------------------------------------------------------

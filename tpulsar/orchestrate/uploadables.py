@@ -19,6 +19,7 @@ from typing import Any
 
 import numpy as np
 
+from tpulsar.checkpoint import hashing
 from tpulsar.orchestrate.results_db import ResultsDB
 
 
@@ -145,9 +146,18 @@ class PeriodicityCandidateUpload(Uploadable):
                             filename=os.path.basename(path), blob=blob)
             back = db.fetchone("SELECT blob FROM pdm_plots WHERE id=?",
                                (pid,))
-            if back["blob"] != blob:
+            # digest verify-after-write through the ONE shared sha256
+            # helper (tpulsar/checkpoint/hashing.py — the checkpoint
+            # manifests use the same one), and the error names what
+            # diverged instead of a bare boolean
+            want = hashing.sha256_bytes(blob)
+            got = hashing.sha256_bytes(back["blob"] or b"")
+            if got != want:
                 raise UploadError(
-                    f"plot blob verify failed for cand {self.cand_num}")
+                    f"plot blob verify failed for cand "
+                    f"{self.cand_num}: wrote sha256 "
+                    f"{hashing.short(want)} read back "
+                    f"{hashing.short(got)}")
         return cand_id
 
 
@@ -212,6 +222,11 @@ class PlotDiagnosticUpload(Uploadable):
                         filename=os.path.basename(self.path), blob=blob,
                         uploaded_at=_nowstr())
         row = db.fetchone("SELECT blob FROM diagnostics WHERE id=?", (did,))
-        if row["blob"] != blob:
-            raise UploadError(f"plot diagnostic verify failed: {self.name}")
+        want = hashing.sha256_bytes(blob)
+        got = hashing.sha256_bytes(row["blob"] or b"")
+        if got != want:
+            raise UploadError(
+                f"plot diagnostic verify failed: {self.name}: wrote "
+                f"sha256 {hashing.short(want)} read back "
+                f"{hashing.short(got)}")
         return did

@@ -95,6 +95,16 @@ FAULT_POINTS = (
     #                         is observational, so an injected failure
     #                         here must cost evidence, never the
     #                         transition the event describes
+    "checkpoint.write",     # checkpoint/store.py artifact+manifest
+    #                         writes: ENOSPC/EROFS must disable the
+    #                         store for the rest of the beam (the
+    #                         search finishes un-checkpointed); other
+    #                         errnos skip one artifact
+    "checkpoint.load",      # checkpoint/store.py verified reads: a
+    #                         failure is treated as corruption — the
+    #                         entry is discarded + journaled
+    #                         (checkpoint_invalid) and recomputed,
+    #                         never resumed from garbage
 )
 
 MODES = ("unimplemented", "hang", "delay", "poison")

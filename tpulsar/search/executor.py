@@ -196,6 +196,7 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                 plan: list[ddplan.DedispStep] | None = None,
                 baryv: float | None = None,
                 checkpoint_dir: str | None = None,
+                checkpoint_journal=None,
                 mesh=None) -> SearchOutcome:
     """Search one beam end-to-end and write the results directory.
 
@@ -204,6 +205,16 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     the way the reference does at obs_info time
     (PALFA2_presto_search.py:43-57,269); pass 0.0 explicitly to
     disable barycentric correction.
+
+    checkpoint_dir: pass-level crash resume (tpulsar/checkpoint/) —
+    the RFI mask, every DDplan pass's partials, the sifted list, and
+    each folded candidate are durably checkpointed with sha256
+    manifest entries, and a re-entered search verifies the manifest
+    and recomputes only what is missing or corrupt.
+    checkpoint_journal: optional ``callable(event, **extra)`` wired to
+    the spool journal (the serve worker stamps ticket/worker/attempt)
+    — carries ``resume`` / ``pass_complete`` / ``checkpoint_invalid``
+    / ``checkpoint_disabled`` events.
     """
     import tpulsar
 
@@ -259,6 +270,21 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
             hidm=params.dm_max if params.dm_max > 0 else 1000.0,
             numsub=params.nsub)
 
+    data_id = ";".join(
+        f"{os.path.basename(fn)}:{os.path.getsize(fn)}" for fn in
+        sorted(fns)) + f"|mjd={float(si.start_MJD[0])!r}"
+    store = None
+    if checkpoint_dir:
+        # opened HERE (not in search_block) so the RFI mask and the
+        # fold artifacts checkpoint too, not just the pass loop
+        shape_id = (f"({si.num_channels}, {int(si.N)})|{si.dt!r}|"
+                    f"{si.freqs[0]!r}|{si.freqs[-1]!r}")
+        store = _open_checkpoint(
+            checkpoint_dir,
+            _ckpt_fingerprint(plan, params, zaplist, baryv, nsub,
+                              data_id=data_id + "|" + shape_id),
+            checkpoint_journal)
+
     # ---------------------------------------------------------- read + RFI
     f32_bytes = int(si.N) * si.num_channels * 4
     quantize = (params.block_quantize == "on"
@@ -275,28 +301,36 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
         # smaller) and never transposes there again.
         data = jnp.asarray(np.ascontiguousarray(block.T))  # (nchan, T)
         del block
-        mask = rfi_k.find_rfi_chan(data, si.dt,
-                                   block_len=params.rfifind_blocklen,
-                                   threshold=params.rfi_threshold)
-        # the quantization affine travels with the mask: chan_fill
-        # (and any folded-profile amplitudes downstream) are in
-        # quantized units, and without the map a mask saved from a
-        # quantized run could not be re-applied to float32 data
-        mask.save(os.path.join(resultsdir, f"{basenm}_rfifind.npz"),
-                  qscale=qscale, qoff=qoff)
+        mask_path = os.path.join(resultsdir, f"{basenm}_rfifind.npz")
+        payload = store.load("rfi_mask") if store is not None else None
+        if payload is not None:
+            # resume: the verified checkpoint payload IS the output
+            # artifact — byte-identical mask file, no find_rfi compute
+            with open(mask_path, "wb") as fh:
+                fh.write(payload)
+            mask = rfi_k.RFIMask.load(mask_path)
+        else:
+            mask = rfi_k.find_rfi_chan(data, si.dt,
+                                       block_len=params.rfifind_blocklen,
+                                       threshold=params.rfi_threshold)
+            # the quantization affine travels with the mask: chan_fill
+            # (and any folded-profile amplitudes downstream) are in
+            # quantized units, and without the map a mask saved from a
+            # quantized run could not be re-applied to float32 data
+            mask.save(mask_path, qscale=qscale, qoff=qoff)
+            if store is not None:
+                with open(mask_path, "rb") as fh:
+                    store.save("rfi_mask", fh.read(), kind="stage",
+                               ext=".npz")
         # mask.block_len, not the configured one: find_rfi clamps it
         # for observations shorter than a block
         data = rfi_k.apply_mask_chan(
             data, jnp.asarray(mask.full_mask()),
             jnp.asarray(mask.chan_fill), mask.block_len)
 
-    data_id = ";".join(
-        f"{os.path.basename(fn)}:{os.path.getsize(fn)}" for fn in
-        sorted(fns)) + f"|mjd={float(si.start_MJD[0])!r}"
     result = search_block(data, si.freqs, si.dt, plan, params,
                           zaplist=zaplist, baryv=baryv, nsub=nsub,
-                          timers=timers, checkpoint_dir=checkpoint_dir,
-                          data_id=data_id, mesh=mesh)
+                          timers=timers, checkpoint=store, mesh=mesh)
     final, folded, sp_events, num_trials = result
 
     # ----------------------------------------------------------- artifacts
@@ -409,6 +443,8 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                  timers: StageTimers | None = None,
                  checkpoint_dir: str | None = None,
                  data_id: str = "",
+                 checkpoint=None,
+                 checkpoint_journal=None,
                  progress_cb=None,
                  mesh=None):
     """Run the plan loop + sifting + folding on an in-HBM block.
@@ -423,12 +459,16 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
     ICI traffic).  None = single-device.  Candidates are identical to
     the single-device path up to float reduction order.
 
-    checkpoint_dir: when set, per-pass candidate dumps are written
-    there and completed passes are skipped on re-entry — pass-level
-    resume on top of the reference's job-level restart unit
-    (SURVEY.md 5.4).  data_id should identify the input beam (file
-    names/sizes/MJD); it is folded into the checkpoint fingerprint so
-    another beam's dumps in the same directory are never resumed.
+    checkpoint_dir: when set, per-pass candidate dumps (plus the
+    sifted list and each folded candidate) are written there as
+    sha256-manifested artifacts (tpulsar/checkpoint/) and completed
+    work is verified and skipped on re-entry — pass-level resume on
+    top of the reference's job-level restart unit (SURVEY.md 5.4).
+    data_id should identify the input beam (file names/sizes/MJD); it
+    is folded into the checkpoint fingerprint so another beam's dumps
+    in the same directory are never resumed.  checkpoint: an
+    already-open CheckpointStore (search_beam passes its own so the
+    RFI mask checkpoints too); checkpoint_journal: see search_beam.
 
     progress_cb: optional callable(dict) invoked after every completed
     dedispersion pass with {pass_idx, npasses, step_idx, ntrials_done,
@@ -458,11 +498,13 @@ def search_block(data: jnp.ndarray, freqs: np.ndarray, dt: float,
                             npasses=sum(s.numpasses for s in plan)):
             return _search_block_inner(
                 data, freqs, dt, plan, params, zaplist, baryv, nsub,
-                timers, checkpoint_dir, data_id, progress_cb, mesh)
+                timers, checkpoint_dir, data_id, checkpoint,
+                checkpoint_journal, progress_cb, mesh)
 
 
 def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                         nsub, timers, checkpoint_dir, data_id,
+                        checkpoint, checkpoint_journal,
                         progress_cb, mesh):
     nchan = data.shape[0]
     nsub = nsub or (params.nsub if nchan % params.nsub == 0
@@ -472,19 +514,29 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
     sp_chunks: list[np.ndarray] = []
     num_trials = 0
     pass_idx = -1
-    if checkpoint_dir:
+    store = checkpoint
+    if store is None and checkpoint_dir:
         shape_id = f"{tuple(data.shape)}|{dt!r}|{freqs[0]!r}|{freqs[-1]!r}"
-        _prepare_checkpoint_dir(
+        store = _open_checkpoint(
             checkpoint_dir,
             _ckpt_fingerprint(plan, params, zaplist, baryv, nsub,
-                              data_id=data_id + "|" + shape_id))
+                              data_id=data_id + "|" + shape_id),
+            checkpoint_journal)
 
     npasses = sum(s.numpasses for s in plan)
+    # a verified 'sifted' artifact short-circuits the whole plan loop
+    # (+ sifting + refinement): the crash being resumed happened
+    # during folding, and every pass's science is already inside it
+    sifted_state = (_load_decoded(store, "sifted", _decode_sifted)
+                    if store is not None else None)
     for step_idx, step in enumerate(plan):
+        if sifted_state is not None:
+            break
         for ppass in step.passes():
             pass_idx += 1
-            if checkpoint_dir:
-                done = _load_pass_checkpoint(checkpoint_dir, pass_idx)
+            if store is not None:
+                done = _load_decoded(store, f"pass_{pass_idx:04d}",
+                                     _decode_pass)
                 if done is not None:
                     cands, events, ntr = done
                     all_cands.extend(cands)
@@ -706,14 +758,23 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                     timers.times.get("dedispersing", 0.0) - t_dd0,
                     family=fam)
             del subb
-            if checkpoint_dir:
-                _save_pass_checkpoint(
-                    checkpoint_dir, pass_idx,
-                    all_cands[pass_cands_start:],
-                    (np.concatenate(sp_chunks[pass_sp_start:])
-                     if len(sp_chunks) > pass_sp_start
-                     else _EMPTY_SP),
-                    num_trials - pass_trials_start)
+            if store is not None:
+                ntr_pass = num_trials - pass_trials_start
+                durable = store.save(
+                    f"pass_{pass_idx:04d}",
+                    _encode_pass(
+                        all_cands[pass_cands_start:],
+                        (np.concatenate(sp_chunks[pass_sp_start:])
+                         if len(sp_chunks) > pass_sp_start
+                         else _EMPTY_SP),
+                        ntr_pass),
+                    kind="pass", ext=".npz", pass_idx=pass_idx)
+                if durable:
+                    # journaled ONLY once the artifact is durable: the
+                    # chaos verifier's no_pass_rerun invariant treats
+                    # this event as "never recompute pass k again"
+                    store.journal("pass_complete", pass_idx=pass_idx,
+                                  npasses=npasses, ntrials=ntr_pass)
             telemetry.passes_total().inc()
             telemetry.dm_trials_total().inc(len(dms))
             if progress_cb is not None:
@@ -725,65 +786,80 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                                 for k, v in timers.times.items() if v},
                 })
 
-    with timers.timing("sifting"):
-        final = sifting.sift(all_cands, params.sifting)
-
-    sp_events = (np.concatenate(sp_chunks) if sp_chunks else _EMPTY_SP)
-
-    # One consistent bin scale for the reported r column: candidates
-    # from different plan passes carry pass-local (downsampled,
-    # padded) bin units; normalize everything to the full-resolution
-    # padded scale via the invariant frequency.
     nfft_full = ddplan.choose_n(data.shape[1])
     T_s_full = nfft_full * dt
-    for c in final:
-        c.r = c.freq_hz * T_s_full
-
-    # Sub-bin refinement of the reported candidates (PRESTO's
-    # harmpolish stage; round-1 verdict missing #3): each fold-worthy
-    # candidate's (r, z) is optimized on a full-resolution series for
-    # its DM, and its sigma recomputed from the refined power.  The
-    # per-DM series are processed group-by-group and only a few are
-    # cached (a long beam's full-resolution series is ~GBs across 100
-    # candidates' DMs).
-    to_refine = [c for c in final if c.sigma >= params.to_prepfold_sigma]
-    to_refine = to_refine[: params.max_cands_to_fold]
     _series_for = _BoundedCache(
         lambda dm: _dedisperse_single(data, freqs, nsub, dm, dt))
 
-    if params.refine_cands and to_refine:
-        from tpulsar.search import refine
+    if sifted_state is not None:
+        # resumed past every pass AND past sift/refine: the verified
+        # artifact carries the refined, sigma-sorted list (plus the SP
+        # events and the trial count) exactly as the original attempt
+        # computed them — the crash happened during folding
+        final, sp_events, num_trials = sifted_state
+    else:
+        with timers.timing("sifting"):
+            final = sifting.sift(all_cands, params.sifting)
 
-        with timers.timing("refinement"):
-            # lo/hi identity by DETECTION z — refinement perturbs z
-            # off exact zero, which must not flip a lo candidate onto
-            # the hi search's nz-times-larger trial count
-            was_hi = {id(c): abs(c.z) >= accel_k.DZ / 2
-                      for c in to_refine}
-            keep_full = fr.zap_mask(nfft_full // 2 + 1, T_s_full,
-                                    zaplist, baryv) \
-                if zaplist is not None else None
-            by_dm: dict[float, list] = {}
-            for c in to_refine:
-                by_dm.setdefault(c.dm, []).append(c)
-            for dm, group in by_dm.items():
-                refine.refine_candidates(
-                    group, {dm: _series_for(dm)}, dt, nfft_full,
-                    keep_mask=keep_full)
-            nz_hi = (len(_get_bank(params.hi_accel_zmax).zs)
-                     if params.run_hi_accel and params.hi_accel_zmax > 0
-                     else 1)
-            nbins_full = nfft_full // 2 + 1
-            for c in to_refine:
-                # trial count approximated with the full-res bin count
-                # (pass-local counts differ by <= the downsample
-                # factor: a few 0.1 sigma at most)
-                nind = max(1, (nbins_full
-                               * (nz_hi if was_hi[id(c)] else 1))
-                           // c.numharm)
-                c.sigma = float(fr.sigma_from_power(c.power, c.numharm,
-                                                    numindep=nind))
-            final.sort(key=lambda c: -c.sigma)
+        sp_events = (np.concatenate(sp_chunks) if sp_chunks
+                     else _EMPTY_SP)
+
+        # One consistent bin scale for the reported r column:
+        # candidates from different plan passes carry pass-local
+        # (downsampled, padded) bin units; normalize everything to the
+        # full-resolution padded scale via the invariant frequency.
+        for c in final:
+            c.r = c.freq_hz * T_s_full
+
+        # Sub-bin refinement of the reported candidates (PRESTO's
+        # harmpolish stage; round-1 verdict missing #3): each
+        # fold-worthy candidate's (r, z) is optimized on a
+        # full-resolution series for its DM, and its sigma recomputed
+        # from the refined power.  The per-DM series are processed
+        # group-by-group and only a few are cached (a long beam's
+        # full-resolution series is ~GBs across 100 candidates' DMs).
+        to_refine = [c for c in final
+                     if c.sigma >= params.to_prepfold_sigma]
+        to_refine = to_refine[: params.max_cands_to_fold]
+
+        if params.refine_cands and to_refine:
+            from tpulsar.search import refine
+
+            with timers.timing("refinement"):
+                # lo/hi identity by DETECTION z — refinement perturbs
+                # z off exact zero, which must not flip a lo candidate
+                # onto the hi search's nz-times-larger trial count
+                was_hi = {id(c): abs(c.z) >= accel_k.DZ / 2
+                          for c in to_refine}
+                keep_full = fr.zap_mask(nfft_full // 2 + 1, T_s_full,
+                                        zaplist, baryv) \
+                    if zaplist is not None else None
+                by_dm: dict[float, list] = {}
+                for c in to_refine:
+                    by_dm.setdefault(c.dm, []).append(c)
+                for dm, group in by_dm.items():
+                    refine.refine_candidates(
+                        group, {dm: _series_for(dm)}, dt, nfft_full,
+                        keep_mask=keep_full)
+                nz_hi = (len(_get_bank(params.hi_accel_zmax).zs)
+                         if params.run_hi_accel
+                         and params.hi_accel_zmax > 0
+                         else 1)
+                nbins_full = nfft_full // 2 + 1
+                for c in to_refine:
+                    # trial count approximated with the full-res bin
+                    # count (pass-local counts differ by <= the
+                    # downsample factor: a few 0.1 sigma at most)
+                    nind = max(1, (nbins_full
+                                   * (nz_hi if was_hi[id(c)] else 1))
+                               // c.numharm)
+                    c.sigma = float(fr.sigma_from_power(
+                        c.power, c.numharm, numindep=nind))
+                final.sort(key=lambda c: -c.sigma)
+        if store is not None:
+            store.save("sifted",
+                       _encode_sifted(final, sp_events, num_trials),
+                       kind="stage", ext=".npz")
 
     # Fold the top of the (possibly re-ranked) list.  Because final is
     # sigma-descending and the fold set is its >=threshold prefix,
@@ -793,6 +869,37 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
     to_fold = [c for c in final if c.sigma >= params.to_prepfold_sigma]
     to_fold = to_fold[: params.max_cands_to_fold]
     folded_by_idx: dict[int, fold_k.FoldResult] = {}
+    if store is not None:
+        # each already-folded candidate is its own verified artifact:
+        # a crash at fold k resumes at fold k, not fold 0.  Artifacts
+        # are keyed by POSITION, so each carries its candidate's
+        # (input period, dm) identity — if the sifted list was
+        # regenerated since the folds were written (e.g. its artifact
+        # failed to save and a recomputed pass shifted the sigma
+        # ordering), position k may name a DIFFERENT candidate, and a
+        # sha-valid fold must not be attributed to it
+        for k in range(len(to_fold)):
+            payload = store.load(f"fold_{k:04d}")
+            if payload is None:
+                continue
+            dec = _decode_fold(payload)
+            if dec is None:
+                store.discard(f"fold_{k:04d}",
+                              reason="undecodable payload")
+                continue
+            res, ident = dec
+            if ident != (to_fold[k].period_s, to_fold[k].dm):
+                store.discard(f"fold_{k:04d}",
+                              reason="candidate identity mismatch "
+                                     "(sifted list regenerated)")
+                continue
+            folded_by_idx[k] = res
+
+    def _save_fold(k: int) -> None:
+        if store is not None:
+            store.save(f"fold_{k:04d}",
+                       _encode_fold(folded_by_idx[k], to_fold[k]),
+                       kind="fold", ext=".npz", cand=k)
 
     def _subbands_for(dm: float):
         ch_sh, sub_sh = dd.plan_pass_shifts(freqs, nsub, dm, [dm],
@@ -808,12 +915,18 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
             # passes already compiled), one device program per tier.
             from tpulsar.kernels import fold_batch as fbk
 
-            folded_by_idx = fbk.fold_candidates_by_pass(
-                data, freqs, dt, plan,
-                [(k, c.period_s, c.dm) for k, c in enumerate(to_fold)],
-                nsub,
-                lambda d, ch_sh, ns, ds: dd.form_subbands(
-                    d, jnp.asarray(ch_sh), ns, ds))
+            missing = [k for k in range(len(to_fold))
+                       if k not in folded_by_idx]
+            if missing:
+                folded_by_idx.update(fbk.fold_candidates_by_pass(
+                    data, freqs, dt, plan,
+                    [(k, to_fold[k].period_s, to_fold[k].dm)
+                     for k in missing],
+                    nsub,
+                    lambda d, ch_sh, ns, ds: dd.form_subbands(
+                        d, jnp.asarray(ch_sh), ns, ds)))
+                for k in missing:
+                    _save_fold(k)
             folded = [folded_by_idx[k] for k in range(len(to_fold))]
             return final, folded, sp_events, num_trials
 
@@ -821,7 +934,8 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
         # when same-DM candidates interleave in the sigma ordering
         fold_groups: dict[float, list[int]] = {}
         for k, c in enumerate(to_fold):
-            fold_groups.setdefault(c.dm, []).append(k)
+            if k not in folded_by_idx:
+                fold_groups.setdefault(c.dm, []).append(k)
         for dm, idxs in fold_groups.items():
             if params.fold_by_rules:
                 # fold from subbands so the DM axis is a per-subband
@@ -835,6 +949,7 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                         subb_f, subrefs, dt, c.period_s, dm=dm,
                         rules=fold_k.fold_rules(c.period_s),
                         sub_shifts_dm0=sub_sh0)
+                    _save_fold(k)
                 del subb_f
             else:
                 for k in idxs:
@@ -842,6 +957,7 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                     folded_by_idx[k] = fold_k.fold_and_optimize(
                         _series_for(c.dm), dt, c.period_s, dm=c.dm,
                         nbin=params.fold_nbin, npart=params.fold_npart)
+                    _save_fold(k)
     folded = [folded_by_idx[k] for k in range(len(to_fold))]
 
     return final, folded, sp_events, num_trials
@@ -917,7 +1033,7 @@ def _ckpt_fingerprint(plan, params, zaplist, baryv, nsub,
     """Configuration + input fingerprint stored with the checkpoints:
     dumps from a different search configuration OR a different beam
     must not be resumed."""
-    import hashlib
+    from tpulsar.checkpoint import hashing
     zap = (np.asarray(zaplist).tobytes() if zaplist is not None
            else b"none")
     blob = repr((
@@ -925,55 +1041,148 @@ def _ckpt_fingerprint(plan, params, zaplist, baryv, nsub,
           s.downsamp) for s in plan],
         sorted(params.provenance().items()), baryv, nsub, data_id,
     )).encode() + zap
-    return hashlib.sha256(blob).hexdigest()
+    return hashing.sha256_bytes(blob)
 
 
-def _prepare_checkpoint_dir(ckdir: str, fingerprint: str) -> None:
-    """Create/validate the checkpoint dir; wipe stale dumps written
-    under a different configuration."""
-    import shutil
-    manifest = os.path.join(ckdir, "manifest.txt")
-    if os.path.isdir(ckdir):
-        old = None
-        if os.path.exists(manifest):
-            with open(manifest) as fh:
-                old = fh.read().strip()
-        if old != fingerprint:
-            shutil.rmtree(ckdir, ignore_errors=True)
-    os.makedirs(ckdir, exist_ok=True)
-    with open(manifest, "w") as fh:
-        fh.write(fingerprint)
+def _open_checkpoint(ckdir: str, fingerprint: str, journal=None):
+    """Open the beam's CheckpointStore (tpulsar/checkpoint/) and
+    journal the ``resume`` event when it holds prior artifacts — the
+    auditable record that this attempt started from saved work."""
+    import warnings
+
+    from tpulsar import checkpoint as ckpt_mod
+
+    store = ckpt_mod.CheckpointStore(
+        ckdir, fingerprint, journal=journal,
+        warn=lambda msg: warnings.warn(msg, stacklevel=2))
+    ent = store.entries()
+    if ent:
+        store.journal("resume", artifacts=len(ent),
+                      passes_done=len(store.entries(kind="pass")))
+    return store
 
 
-def _save_pass_checkpoint(ckdir: str, pass_idx: int,
-                          cands: list[sifting.Candidate],
-                          events: np.ndarray, ntrials: int) -> None:
-    """Durable per-pass dump; written atomically so a crash mid-write
-    re-runs the pass instead of resuming from garbage."""
-    path = os.path.join(ckdir, f"pass_{pass_idx:04d}.npz")
+def _npz_bytes(**arrays) -> bytes:
+    import io
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def _load_decoded(store, key: str, decode):
+    """Verified load + decode.  A payload whose BYTES verify but
+    whose layout no longer decodes (a payload-format drift shipped
+    without a SCHEMA bump) must be DISCARDED through the store —
+    journaling the ``checkpoint_invalid`` excuse — not silently
+    dropped: the recompute journals a second ``pass_complete``, and
+    without the excuse the no_pass_rerun invariant would flag a
+    healthy, correctly-recovering beam."""
+    payload = store.load(key)
+    if payload is None:
+        return None
+    out = decode(payload)
+    if out is None:
+        store.discard(key, reason="undecodable payload")
+    return out
+
+
+def _encode_pass(cands: list[sifting.Candidate], events: np.ndarray,
+                 ntrials: int) -> bytes:
+    """One pass's partials as an npz payload (the checkpoint layer
+    stores bytes; the sha256 manifest entry guards them)."""
     arrs = {f: np.asarray([getattr(c, f) for c in cands])
             for f in _CAND_FIELDS}
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        np.savez_compressed(fh, events=events,
-                            ntrials=np.int64(ntrials), **arrs)
-    os.replace(tmp, path)
+    return _npz_bytes(events=events, ntrials=np.int64(ntrials), **arrs)
 
 
-def _load_pass_checkpoint(ckdir: str, pass_idx: int):
-    """(cands, events, ntrials) for a completed pass, else None."""
-    path = os.path.join(ckdir, f"pass_{pass_idx:04d}.npz")
-    if not os.path.exists(path):
+def _decode_pass(payload: bytes | None):
+    """(cands, events, ntrials) from a verified pass payload, else
+    None (an undecodable payload is recomputed like a missing one)."""
+    if payload is None:
         return None
+    import io
     try:
-        with np.load(path, allow_pickle=False) as z:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
             n = len(z["sigma"])
             cands = [sifting.Candidate(**{
                 f: (int if f == "numharm" else float)(z[f][i])
                 for f in _CAND_FIELDS}) for i in range(n)]
             return cands, z["events"], int(z["ntrials"])
     except (OSError, ValueError, KeyError):
-        return None      # corrupt checkpoint: redo the pass
+        return None
+
+
+def _encode_sifted(final: list[sifting.Candidate],
+                   sp_events: np.ndarray, num_trials: int) -> bytes:
+    """The post-refinement sigma-sorted list, WITH each candidate's
+    DM-hit history (the uploader reports num_dm_hits) plus the beam's
+    SP events and trial count — everything the fold stage and the
+    artifact writers need, so a fold-stage crash resumes here."""
+    arrs = {f: np.asarray([getattr(c, f) for c in final])
+            for f in _CAND_FIELDS}
+    hit_counts = np.asarray([len(c.dm_hits) for c in final], np.int64)
+    flat = [pair for c in final for pair in c.dm_hits]
+    hits = (np.asarray(flat, np.float64).reshape(-1, 2) if flat
+            else np.zeros((0, 2), np.float64))
+    return _npz_bytes(events=sp_events, ntrials=np.int64(num_trials),
+                      hit_counts=hit_counts, hits=hits, **arrs)
+
+
+def _decode_sifted(payload: bytes | None):
+    if payload is None:
+        return None
+    import io
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            n = len(z["sigma"])
+            hit_counts, hits = z["hit_counts"], z["hits"]
+            cands, off = [], 0
+            for i in range(n):
+                c = sifting.Candidate(**{
+                    f: (int if f == "numharm" else float)(z[f][i])
+                    for f in _CAND_FIELDS})
+                k = int(hit_counts[i])
+                c.dm_hits = [(float(dm), float(sg))
+                             for dm, sg in hits[off:off + k]]
+                off += k
+                cands.append(c)
+            return cands, z["events"], int(z["ntrials"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _encode_fold(res: fold_k.FoldResult,
+                 cand: sifting.Candidate) -> bytes:
+    """A fold result PLUS the identity of the candidate it folded
+    (the sift-time input period/dm): FoldResult carries only the
+    optimized values, and the float round trip back to the input is
+    not exact — so the binding is stored, not derived."""
+    return _npz_bytes(
+        profile=res.profile, subints=res.subints,
+        scalars=np.asarray(
+            [res.period_s, res.pdot, res.dm, res.reduced_chi2,
+             res.delta_p, res.delta_pdot, res.delta_dm], np.float64),
+        geom=np.asarray([res.nbin, res.npart], np.int64),
+        cand_ident=np.asarray([cand.period_s, cand.dm], np.float64))
+
+
+def _decode_fold(payload: bytes | None):
+    """(FoldResult, (input_period_s, input_dm)) or None."""
+    if payload is None:
+        return None
+    import io
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            s, g, ident = z["scalars"], z["geom"], z["cand_ident"]
+            return fold_k.FoldResult(
+                period_s=float(s[0]), pdot=float(s[1]), dm=float(s[2]),
+                nbin=int(g[0]), npart=int(g[1]), profile=z["profile"],
+                subints=z["subints"], reduced_chi2=float(s[3]),
+                delta_p=float(s[4]), delta_pdot=float(s[5]),
+                delta_dm=float(s[6])), (float(ident[0]),
+                                        float(ident[1]))
+    except (OSError, ValueError, KeyError):
+        return None
 
 
 def _compute_baryv(si) -> float:

@@ -662,13 +662,35 @@ def _recover_abandoned_claimings(spool: str) -> None:
             pass
 
 
+def _checkpoint_progress(rec: dict) -> int:
+    """How many checkpoint artifacts this beam's outdir holds (see
+    tpulsar/checkpoint/.progress_marker): -1 = no readable manifest.
+    Guarded — a sick outdir volume must not fail a janitor pass."""
+    outdir = rec.get("outdir") or ""
+    if not outdir:
+        return -1
+    from tpulsar import checkpoint as ckpt
+    try:
+        return ckpt.progress_marker(ckpt.default_root(outdir))
+    except OSError:
+        return -1
+
+
 def _quarantine(spool: str, rec: dict, max_attempts: int) -> None:
     """Isolate a poisoned beam: the ticket record (with its crash
     history) is kept in quarantine/ for the operator, and a failed
     result is written into done/ so the submitting pool stops waiting
-    — no worker in the fleet will ever claim this beam again."""
+    — no worker in the fleet will ever claim this beam again.  Its
+    checkpoint dir is removed too: resume state for a beam nothing
+    will resume is dead weight, and a ``*.tmp`` a kill left inside it
+    must not outlive janitor cleanup (the chaos auditor's
+    no_orphan_sidefiles sweep covers checkpoint dirs)."""
     tid = rec.get("ticket", "?")
     rec["quarantined_at"] = time.time()
+    outdir = rec.get("outdir") or ""
+    if outdir:
+        from tpulsar import checkpoint as ckpt
+        ckpt.clean(ckpt.default_root(outdir))
     _atomic_write_json(ticket_path(spool, tid, "quarantine"), rec)
     journal.record(spool, "quarantined", ticket=tid,
                    attempt=int(rec.get("attempts", 0)),
@@ -720,10 +742,31 @@ def _requeue_claims(spool: str, verdict_fn,
         owner_pid = raw.get("claimed_by")
         owner_worker = raw.get("claimed_by_worker", "")
         rec = _strip_claim_stamps(raw)
+        progressed = False
         if verdict == "strike":
             # the owner died holding this beam: one more strike
             rec["attempts"] = int(rec.get("attempts", 0)) + 1
-            if rec["attempts"] >= max_attempts:
+            # Quarantine fairness: a worker that ADVANCED the beam's
+            # checkpoint before dying made progress — preemptions of
+            # a long beam are not a crash loop, and a beam that gains
+            # a pass per attempt eventually finishes.  ``attempts``
+            # stays monotone (the journal/verifier contract: takeover
+            # k carries attempt k); what resets is the crash-loop
+            # BUDGET — quarantine fires on attempts since the last
+            # recorded progress, so a worker failing repeatedly at
+            # the SAME pass still quarantines at the cap.
+            # floor the watermark at 0: a just-opened EMPTY store
+            # (manifest, no artifacts) is not progress — a beam that
+            # kills its worker at search start must not earn a free
+            # extra strike just for creating the manifest
+            progress = _checkpoint_progress(rec)
+            if progress > max(0, int(rec.get("ckpt_progress", 0))):
+                progressed = True
+                rec["ckpt_progress"] = progress
+                rec["attempts_at_progress"] = rec["attempts"]
+            stuck = rec["attempts"] - int(
+                rec.get("attempts_at_progress", 0))
+            if stuck >= max_attempts:
                 _quarantine(spool, rec, max_attempts)
                 try:
                     os.unlink(tmp)
@@ -742,7 +785,12 @@ def _requeue_claims(spool: str, verdict_fn,
                 attempt=int(rec.get("attempts", 0)),
                 trace_id=rec.get("trace_id", ""),
                 from_worker=owner_worker, from_pid=owner_pid,
-                by_pid=os.getpid())
+                by_pid=os.getpid(),
+                # the fairness evidence: checkpoint artifacts the dead
+                # owner left, and whether they reset the crash-loop
+                # budget (progress != crash loop)
+                **({"ckpt_progress": rec.get("ckpt_progress", -1),
+                    "budget_reset": True} if progressed else {}))
         else:
             journal.record(
                 spool, "drain_requeue", ticket=tid,

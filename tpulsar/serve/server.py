@@ -294,7 +294,12 @@ class SearchServer:
             prepared.ppfns, prepared.workdir,
             prepared.ticket["outdir"], params, prepared.zaplist,
             log=lambda msg: self.log.info("[%s] %s",
-                                          prepared.ticket_id, msg))
+                                          prepared.ticket_id, msg),
+            # checkpoint resume evidence rides the ticket journal,
+            # stamped with this worker + attempt: a reclaimed beam's
+            # 'resume'/'pass_complete' chain is auditable fleet-wide
+            journal=lambda event, **extra: self._journal(
+                event, prepared.ticket, **extra))
 
     def _process(self, prepared: PreparedBeam) -> None:
         tid = prepared.ticket_id
