@@ -1625,6 +1625,168 @@ def run_resume() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_autoscale() -> None:
+    """``bench.py --autoscale``: the fleet-economics headline the
+    elastic autoscaler (tpulsar/fleet/autoscale.py) exists to win —
+    COST-PER-BEAM AT A FIXED QUEUE-WAIT SLO.  The same bursty
+    synthetic workload (a thundering-herd burst, a lull, a second
+    surge) runs through two stub fleets on scratch spools:
+
+      * static — the pre-autoscaler answer: ``max_workers`` workers
+        for the whole run, idle capacity burning worker-seconds
+        through every lull;
+      * elastic — one worker plus the autoscaler (min 1 / max
+        ``max_workers``), scaling up on backlog pressure and back
+        down through the lull, spot-class workers SIGKILLed on
+        scale-down.
+
+    Worker-seconds are integrated from the journal's own
+    worker_spawn/worker_exit pairs (no side channel), so
+    ``cost_per_beam_ws`` = worker-seconds per done beam.  The elastic
+    fleet must BEAT the static one on cost while both hold the
+    queue-wait p95 SLO — a cheaper fleet that starves its queue has
+    not won anything, so ``slo_met`` and the invariant verifier's
+    violation count (including scaling_bounded / no_elastic_strike)
+    are part of the record and the only acceptable violation count
+    is 0.  Emits one bench/v2 record with an additive ``autoscale``
+    key.  Knobs: TPULSAR_AUTOSCALE_NBEAMS (per burst) / BEAM_S /
+    SLO_S, TPULSAR_AUTOSCALE_KEEP=1 keeps the spools."""
+    import shutil
+    import tempfile
+
+    from tpulsar.chaos import invariants, runner, scenario
+    from tpulsar.obs import fleetview, journal
+
+    burst = int(os.environ.get("TPULSAR_AUTOSCALE_NBEAMS", "10"))
+    beam_s = float(os.environ.get("TPULSAR_AUTOSCALE_BEAM_S",
+                                  "0.35"))
+    slo_s = float(os.environ.get("TPULSAR_AUTOSCALE_SLO_S", "8.0"))
+    max_workers = 3
+    surge_t = 9.0            # the lull between bursts
+    base = tempfile.mkdtemp(prefix="tpulsar_autoscalebench_")
+
+    def one(tag: str, workers: int, autoscale: dict | None) -> dict:
+        spool = os.path.join(base, f"spool_{tag}")
+        doc = {
+            "name": f"asbench-{tag}", "seed": 31,
+            "duration_s": 180.0, "workers": workers,
+            "worker_kind": "stub", "beam_s": beam_s,
+            "poll_s": 0.25,
+            "workload": {"beams": burst, "interval_s": 0.03},
+            "timeline": [{"t": surge_t, "action": "surge_submit",
+                          "beams": burst}],
+            "quiesce_timeout_s": 120.0,
+        }
+        if autoscale:
+            doc["autoscale"] = autoscale
+        sc = scenario.from_dict(doc)
+        _log(f"autoscale bench [{tag}]: 2 x {burst} beams x "
+             f"{beam_s:g} s, {workers} worker(s)"
+             + (f" elastic [{autoscale['min_workers']}, "
+                f"{autoscale['max_workers']}]" if autoscale else
+                " static"))
+        manifest = runner.run_scenario(sc, spool)
+        events = journal.read_events(spool)
+        t_end = max((e["t"] for e in events), default=0.0)
+        # worker-seconds from spawn/exit pairs (keyed by pid: each
+        # incarnation is one interval; anything still up at the last
+        # journal instant is charged to there)
+        spawns: dict = {}
+        ws = 0.0
+        for e in events:
+            if e.get("event") == "worker_spawn":
+                spawns[e.get("pid")] = e["t"]
+            elif e.get("event") == "worker_exit":
+                t0 = spawns.pop(e.get("pid"), None)
+                if t0 is not None:
+                    ws += e["t"] - t0
+        ws += sum(t_end - t0 for t0 in spawns.values())
+        tickets = journal.summarize(spool)["tickets"]
+        waits = sorted(rec["queue_wait_s"]
+                       for rec in tickets.values()
+                       if rec.get("queue_wait_s") is not None)
+        names = [e.get("event") for e in events]
+        report = invariants.verify(spool,
+                                   quiesced=manifest["quiesced"])
+        done = sum(1 for rec in tickets.values()
+                   if rec.get("status") == "done")
+        return {
+            "quiesced": manifest["quiesced"],
+            "beams_done": done,
+            "worker_seconds": round(ws, 3),
+            "cost_per_beam_ws": (round(ws / done, 3) if done
+                                 else -1.0),
+            "queue_wait_p95_s": (
+                round(fleetview._quantile(waits, 0.95), 3)
+                if waits else -1.0),
+            "scale_ups": names.count("scale_up"),
+            "scale_downs": names.count("scale_down"),
+            "invariant_violations": len(report["violations"]),
+            "violations": report["violations"][:10],
+        }
+
+    elastic_cfg = {
+        "min_workers": 1, "max_workers": max_workers,
+        "queue_wait_slo_s": slo_s, "backlog_per_worker": 2.0,
+        "cooldown_s": 1.5, "idle_window_s": 1.2,
+        "drain_deadline_s": 3.0, "worker_class": "spot",
+        "slo_lookback_s": 4.0,
+    }
+    static = one("static", max_workers, None)
+    elastic = one("elastic", 1, elastic_cfg)
+    saving = (round(1.0 - elastic["cost_per_beam_ws"]
+                    / static["cost_per_beam_ws"], 3)
+              if static["cost_per_beam_ws"] > 0
+              and elastic["cost_per_beam_ws"] > 0 else -1.0)
+    slo_met = (0 <= elastic["queue_wait_p95_s"] <= slo_s
+               and 0 <= static["queue_wait_p95_s"] <= slo_s)
+    _log(f"cost/beam: elastic {elastic['cost_per_beam_ws']} ws vs "
+         f"static {static['cost_per_beam_ws']} ws "
+         f"({saving if saving >= 0 else '?'} saving); p95 "
+         f"{elastic['queue_wait_p95_s']} s vs "
+         f"{static['queue_wait_p95_s']} s (SLO {slo_s:g} s, "
+         f"{'met' if slo_met else 'VIOLATED'}); "
+         f"{elastic['scale_ups']} up(s)/"
+         f"{elastic['scale_downs']} down(s); violations "
+         f"{static['invariant_violations']}"
+         f"+{elastic['invariant_violations']}")
+    result = {
+        "metric": "autoscale_cost_per_beam",
+        "value": elastic["cost_per_beam_ws"],
+        "unit": "s",
+        "autoscale": {
+            "nbeams": 2 * burst, "beam_s": beam_s, "slo_s": slo_s,
+            "workers_min": 1, "workers_max": max_workers,
+            "cost_per_beam_ws": elastic["cost_per_beam_ws"],
+            "cost_per_beam_static_ws": static["cost_per_beam_ws"],
+            # fraction of the static fleet's worker-seconds the
+            # autoscaler saved per beam — the economics headline
+            "cost_saving": saving,
+            "queue_wait_p95_s": elastic["queue_wait_p95_s"],
+            "queue_wait_p95_static_s": static["queue_wait_p95_s"],
+            "slo_met": slo_met,
+            "worker_seconds": elastic["worker_seconds"],
+            "worker_seconds_static": static["worker_seconds"],
+            "beams_done": elastic["beams_done"],
+            "scale_ups": elastic["scale_ups"],
+            "scale_downs": elastic["scale_downs"],
+            "quiesced": (elastic["quiesced"]
+                         and static["quiesced"]),
+            # the correctness row: MUST be 0 (CI asserts it
+            # explicitly — the gate skips zero-valued keys)
+            "invariant_violations": (
+                static["invariant_violations"]
+                + elastic["invariant_violations"]),
+        },
+    }
+    if static["violations"] or elastic["violations"]:
+        result["autoscale"]["violation_sample"] = (
+            static["violations"] + elastic["violations"])[:10]
+    _emit(result)
+    if os.environ.get("TPULSAR_AUTOSCALE_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _usable_cpus() -> list:
     """The CPU ids this process may actually run on, for taskset
     pinning (a cgroup cpuset need not start at 0 or be contiguous)."""
@@ -1947,6 +2109,9 @@ def main() -> None:
         return
     if "--resume" in sys.argv:
         run_resume()
+        return
+    if "--autoscale" in sys.argv:
+        run_autoscale()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

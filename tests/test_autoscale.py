@@ -1,0 +1,626 @@
+"""Elastic autoscaler tests (tpulsar/fleet/autoscale.py + the
+FleetController integration): the decision engine's triggers,
+hysteresis and cooldown; the elective-kill (scale-down) ledger's
+attempt-neutral requeue; worker-class stamping on claims; the
+scaling_bounded / no_elastic_strike invariant mutations; the
+restart-budget decay fairness fix; the configurable heartbeat
+staleness window; and a live controller e2e where a surge scales the
+fleet up and the lull drains it back down with zero strikes."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpulsar.chaos import invariants
+from tpulsar.fleet import autoscale
+from tpulsar.fleet import controller as fleet_ctl
+from tpulsar.obs import journal
+from tpulsar.serve import protocol
+
+_STUB_ARGV = [sys.executable, "-m", "tpulsar.chaos.worker"]
+
+
+@pytest.fixture(autouse=True)
+def _heartbeat_knob_reset():
+    yield
+    protocol.set_heartbeat_max_age(None)
+    os.environ.pop("TPULSAR_HEARTBEAT_MAX_AGE_S", None)
+
+
+def _dead_pid() -> int:
+    p = subprocess.Popen(["true"])
+    p.wait()
+    return p.pid
+
+
+def _cfg(**kw) -> autoscale.AutoscaleConfig:
+    base = dict(min_workers=1, max_workers=4, queue_wait_slo_s=10.0,
+                backlog_per_worker=2.0, cooldown_s=5.0,
+                idle_window_s=3.0, drain_deadline_s=1.0,
+                worker_class="spot", slo_lookback_s=30.0)
+    base.update(kw)
+    return autoscale.AutoscaleConfig(**base).validate()
+
+
+def _sig(**kw) -> autoscale.Signals:
+    base = dict(t=1000.0, pending=0, claimed=0, live_workers=1,
+                fresh_workers=1, capacity=8, oldest_wait_s=0.0,
+                queue_wait_p95_s=None, tenant_backlog={})
+    base.update(kw)
+    return autoscale.Signals(**base)
+
+
+def _engine(cfg, tmp_path, t0=1000.0):
+    spool = protocol.ensure_spool(str(tmp_path / "sp"))
+    eng = autoscale.Autoscaler(cfg, spool)
+    return eng
+
+
+# ------------------------------------------------------- decisions
+
+def test_scale_up_proportional_to_backlog_and_clamped(tmp_path):
+    eng = _engine(_cfg(max_workers=4), tmp_path)
+    d = eng.decide(_sig(pending=10, live_workers=1))
+    assert d is not None and d.direction == "up"
+    # ceil(10 / 2) = 5 wanted, clamped to max 4 -> +3
+    assert d.n == 3
+    assert "backlog" in d.reason
+    # at max already: trigger present, no decision
+    assert eng.decide(_sig(pending=40, live_workers=4)) is None
+
+
+def test_scale_up_on_starving_oldest_waiter(tmp_path):
+    eng = _engine(_cfg(), tmp_path)
+    d = eng.decide(_sig(pending=1, oldest_wait_s=11.0))
+    assert d is not None and d.direction == "up" and d.n == 1
+    assert "oldest waiter" in d.reason
+
+
+def test_scale_up_on_recent_p95_breach(tmp_path):
+    eng = _engine(_cfg(), tmp_path)
+    d = eng.decide(_sig(pending=1, queue_wait_p95_s=12.0))
+    assert d is not None and d.direction == "up"
+    assert "p95" in d.reason
+
+
+def test_scale_up_on_exhausted_advertised_headroom(tmp_path):
+    eng = _engine(_cfg(), tmp_path)
+    d = eng.decide(_sig(pending=1, capacity=None))   # shed
+    assert d is not None and d.direction == "up"
+    assert "SHED" in d.reason
+    d = eng.decide(_sig(pending=1, capacity=0))      # backpressure
+    assert d is not None and "backpressure" in d.reason
+    # headroom left, tiny backlog: no trigger
+    assert eng.decide(_sig(pending=1, capacity=7)) is None
+
+
+def test_victim_selection_spares_base_slots(tmp_path):
+    """A base slot below min is never a scale-down victim, and a
+    retirement that would leave fewer than min ALIVE workers is
+    refused — even when decide() counted a crashed elastic slot
+    (pending its paced restart) as live."""
+    spool = str(tmp_path / "sp")
+    cfg = autoscale.AutoscaleConfig(min_workers=1, max_workers=3,
+                                    cooldown_s=0.1,
+                                    idle_window_s=0.1)
+    ctrl = fleet_ctl.FleetController(spool, workers=2,
+                                     autoscale=cfg)
+    base, elastic = ctrl.workers
+    assert not base.elastic and elastic.elastic
+    # elastic slot crashed (not alive): only the base is alive, and
+    # alive count == min -> no victim at all
+    base.proc = _FakeProc(None)        # poll() None = alive
+    elastic.proc = None
+    assert ctrl._pick_victim() is None
+    # both alive: the ELASTIC slot is the victim, never the base
+    elastic.proc = _FakeProc(None)
+    assert ctrl._pick_victim() is elastic
+
+
+def test_cooldown_suppresses_consecutive_actions(tmp_path):
+    eng = _engine(_cfg(cooldown_s=5.0), tmp_path)
+    d = eng.decide(_sig(t=1000.0, pending=10))
+    assert d is not None
+    eng.note_action(1000.0)
+    assert eng.decide(_sig(t=1003.0, pending=30)) is None
+    assert eng.decide(_sig(t=1006.0, pending=30)) is not None
+
+
+def test_scale_down_needs_sustained_idle_window(tmp_path):
+    eng = _engine(_cfg(idle_window_s=3.0, cooldown_s=0.1), tmp_path)
+    low = dict(pending=0, claimed=0, live_workers=3)
+    assert eng.decide(_sig(t=1000.0, **low)) is None  # arms low_since
+    assert eng.decide(_sig(t=1001.0, **low)) is None  # within window
+    d = eng.decide(_sig(t=1003.5, **low))
+    assert d is not None and d.direction == "down" and d.n == 1
+    # load resets the window: back to square one
+    eng2 = _engine(_cfg(idle_window_s=3.0), tmp_path)
+    assert eng2.decide(_sig(t=1000.0, **low)) is None
+    assert eng2.decide(_sig(t=1002.0, pending=7,
+                            live_workers=3)) is not None  # scale up
+    assert eng2._low_since is None
+
+
+def test_scale_down_blocked_by_floor_and_high_p95(tmp_path):
+    eng = _engine(_cfg(idle_window_s=0.5, cooldown_s=0.1,
+                       min_workers=2), tmp_path)
+    low = dict(pending=0, claimed=0)
+    assert eng.decide(_sig(t=1000.0, live_workers=2, **low)) is None
+    assert eng.decide(_sig(t=1001.0, live_workers=2, **low)) is None
+    # p95 above the low-water mark (0.25 * 10 s) blocks the window
+    eng3 = _engine(_cfg(idle_window_s=0.5), tmp_path)
+    assert eng3.decide(_sig(t=1000.0, live_workers=3, pending=0,
+                            queue_wait_p95_s=9.0)) is None
+    assert eng3._low_since is None
+
+
+def test_config_validation_is_loud():
+    with pytest.raises(ValueError, match="max_workers"):
+        _cfg(max_workers=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        _cfg(cooldown_s=0)
+    with pytest.raises(ValueError, match="worker_class"):
+        _cfg(worker_class="preemptible")
+    with pytest.raises(ValueError, match="unknown key"):
+        autoscale.AutoscaleConfig.from_dict({"min_workers": 1,
+                                             "max_wrokers": 3})
+
+
+def test_oldest_pending_wait_from_mtimes(tmp_path):
+    spool = protocol.ensure_spool(str(tmp_path / "sp"))
+    assert autoscale.oldest_pending_wait_s(spool) == 0.0
+    protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    path = protocol.ticket_path(spool, "t1", "incoming")
+    old = time.time() - 42.0
+    os.utime(path, (old, old))
+    assert autoscale.oldest_pending_wait_s(spool) >= 41.0
+
+
+def test_signals_tail_recent_queue_waits(tmp_path):
+    spool = protocol.ensure_spool(str(tmp_path / "sp"))
+    eng = autoscale.Autoscaler(_cfg(slo_lookback_s=300.0), spool)
+    for i, wait in enumerate((1.0, 2.0, 30.0)):
+        journal.record(spool, "claimed", ticket=f"t{i}", worker="w0",
+                       attempt=0, queue_wait_s=wait)
+    sig = eng.read_signals(live_workers=1)
+    assert sig.queue_wait_p95_s == pytest.approx(27.2, abs=0.5)
+    # a second read is incremental (offset tail): nothing new, the
+    # window is unchanged
+    assert eng.read_signals(1).queue_wait_p95_s == \
+        sig.queue_wait_p95_s
+
+
+# ------------------------------------------- elective-kill ledger
+
+def test_elective_kill_requeues_attempt_neutral(tmp_path):
+    """The no_elastic_strike mechanism: a dead owner whose pid is in
+    the scale-down ledger requeues with NO strike and reason
+    scale_down; the same death without the ledger is a crash."""
+    spool = str(tmp_path / "sp")
+    protocol.write_ticket(spool, "tv", ["/x"], "/o")
+    protocol.claim_next_ticket(spool, "wv", worker_class="spot")
+    victim = _dead_pid()
+    path = protocol.ticket_path(spool, "tv", "claimed")
+    rec = json.load(open(path))
+    rec["claimed_by"] = victim
+    protocol._atomic_write_json(path, rec)
+    protocol.record_elective_kill(spool, "wv", victim)
+    assert victim in protocol.elective_kill_pids(spool)
+
+    assert protocol.requeue_stale_claims(spool) == ["tv"]
+    back = json.load(open(protocol.ticket_path(spool, "tv",
+                                               "incoming")))
+    assert back["attempts"] == 0                  # NO strike
+    evs = journal.read_events(spool, ticket="tv")
+    names = [e["event"] for e in evs]
+    assert "takeover" not in names
+    requeue = next(e for e in evs
+                   if e["event"] == "drain_requeue")
+    assert requeue["reason"] == "scale_down"
+    assert requeue["worker"] == "wv"
+
+
+def test_unledgered_dead_owner_still_strikes(tmp_path):
+    spool = str(tmp_path / "sp")
+    protocol.write_ticket(spool, "tc", ["/x"], "/o")
+    protocol.claim_next_ticket(spool, "wc")
+    victim = _dead_pid()
+    path = protocol.ticket_path(spool, "tc", "claimed")
+    rec = json.load(open(path))
+    rec["claimed_by"] = victim
+    protocol._atomic_write_json(path, rec)
+    assert protocol.requeue_stale_claims(spool) == ["tc"]
+    back = json.load(open(protocol.ticket_path(spool, "tc",
+                                               "incoming")))
+    assert back["attempts"] == 1                  # crash strike
+    assert any(e["event"] == "takeover"
+               for e in journal.read_events(spool, ticket="tc"))
+
+
+def test_recycled_pid_in_other_slot_still_strikes(tmp_path):
+    """The ledger matches (worker, pid) PAIRS: a ledgered pid that
+    shows up dead under a DIFFERENT worker's claim is a recycled
+    pid, not an elective victim — it must strike normally, or a
+    poisoned beam could dodge quarantine forever."""
+    spool = str(tmp_path / "sp")
+    protocol.write_ticket(spool, "tr", ["/x"], "/o")
+    protocol.claim_next_ticket(spool, "w-other")
+    victim = _dead_pid()
+    path = protocol.ticket_path(spool, "tr", "claimed")
+    rec = json.load(open(path))
+    rec["claimed_by"] = victim
+    protocol._atomic_write_json(path, rec)
+    # the ledger names this pid — but under a different worker slot
+    protocol.record_elective_kill(spool, "w-elastic", victim)
+    assert ("w-elastic", victim) in protocol.elective_kills(spool)
+    assert protocol.requeue_stale_claims(spool) == ["tr"]
+    back = json.load(open(protocol.ticket_path(spool, "tr",
+                                               "incoming")))
+    assert back["attempts"] == 1                  # crash strike
+    assert any(e["event"] == "takeover"
+               for e in journal.read_events(spool, ticket="tr"))
+
+
+def test_ledger_prunes_stale_entries(tmp_path):
+    spool = protocol.ensure_spool(str(tmp_path / "sp"))
+    protocol.record_elective_kill(spool, "w1", 111)
+    doc = protocol._read_json(protocol.scaledown_path(spool))
+    doc["kills"][0]["t"] -= 2 * protocol.SCALEDOWN_TTL_S
+    protocol._atomic_write_json(protocol.scaledown_path(spool), doc)
+    protocol.record_elective_kill(spool, "w2", 222)
+    assert protocol.elective_kill_pids(spool) == {222}
+
+
+def test_claim_carries_worker_class(tmp_path):
+    spool = str(tmp_path / "sp")
+    protocol.write_ticket(spool, "t1", ["/x"], "/o")
+    rec = protocol.claim_next_ticket(spool, "w1",
+                                     worker_class="spot")
+    assert rec["claimed_by_class"] == "spot"
+    claim = json.load(open(protocol.ticket_path(spool, "t1",
+                                                "claimed")))
+    assert claim["claimed_by_class"] == "spot"
+    ev = next(e for e in journal.read_events(spool, ticket="t1")
+              if e["event"] == "claimed")
+    assert ev["worker_class"] == "spot"
+    # the stamp never leaks back into a requeued ticket
+    assert "claimed_by_class" not in protocol._strip_claim_stamps(
+        dict(claim))
+
+
+# ------------------------------------------------- invariant audit
+
+def _chain(spool, tid, worker="w0"):
+    """A minimal well-formed journal chain for one done beam."""
+    protocol.write_ticket(spool, tid, ["/x"], "/o")
+    rec = protocol.claim_next_ticket(spool, worker)
+    protocol.write_result(spool, tid, "done", worker=worker,
+                          attempts=0,
+                          trace_id=rec.get("trace_id", ""))
+
+
+def _scale_event(spool, event, before, after, n=1, t_shift=0.0,
+                 cooldown=1.0, lo=1, hi=3, victims=None):
+    rec = journal.record(
+        spool, event, n=n, reason="test",
+        workers_before=before, workers_after=after,
+        min_workers=lo, max_workers=hi, cooldown_s=cooldown,
+        pending=0, claimed=0, live_workers=before, fresh_workers=0,
+        capacity=0, oldest_wait_s=0.0, queue_wait_p95_s=-1.0,
+        **({"victims": victims} if victims else {}))
+    if t_shift:
+        _shift_last_event(spool, t_shift)
+    return rec
+
+
+def _shift_last_event(spool, dt):
+    path = journal.journal_path(spool)
+    lines = open(path).read().splitlines()
+    rec = json.loads(lines[-1])
+    rec["t"] += dt
+    lines[-1] = json.dumps(rec, separators=(",", ":"),
+                           sort_keys=True)
+    open(path, "w").write("\n".join(lines) + "\n")
+
+
+def test_scaling_bounded_passes_clean_history(tmp_path):
+    spool = str(tmp_path / "sp")
+    _chain(spool, "t1")
+    _scale_event(spool, "scale_up", 1, 3, n=2)
+    _scale_event(spool, "scale_down", 3, 2, t_shift=5.0)
+    report = invariants.verify(spool, quiesced=True)
+    assert report["ok"], report["violations"]
+    assert report["checked"]["scale_ups"] == 1
+    assert report["checked"]["scale_downs"] == 1
+
+
+def test_scaling_bounded_flags_band_and_arithmetic(tmp_path):
+    spool = str(tmp_path / "sp")
+    _chain(spool, "t1")
+    _scale_event(spool, "scale_up", 3, 4, hi=3)        # above max
+    _scale_event(spool, "scale_up", 4, 6, n=1, hi=10,
+                 t_shift=10.0)                         # 4 + 1 != 6
+    report = invariants.verify(spool, quiesced=True)
+    names = [v["invariant"] for v in report["violations"]]
+    assert names.count("scaling_bounded") == 2
+    details = " | ".join(v["detail"] for v in report["violations"])
+    assert "outside" in details and "arithmetic" in details
+
+
+def test_scaling_bounded_flags_cooldown_thrash(tmp_path):
+    spool = str(tmp_path / "sp")
+    _chain(spool, "t1")
+    _scale_event(spool, "scale_up", 1, 2, cooldown=5.0)
+    _scale_event(spool, "scale_down", 2, 1, cooldown=5.0,
+                 t_shift=1.0)       # only ~1 s after the scale_up
+    report = invariants.verify(spool, quiesced=True)
+    assert any(v["invariant"] == "scaling_bounded"
+               and "thrash" in v["detail"]
+               for v in report["violations"])
+
+
+def test_no_elastic_strike_flags_struck_victim(tmp_path):
+    """A takeover whose dead owner is a journaled scale-down victim
+    = elasticity advanced a beam toward quarantine."""
+    spool = str(tmp_path / "sp")
+    protocol.write_ticket(spool, "tb", ["/x"], "/o")
+    rec = protocol.claim_next_ticket(spool, "wv")
+    _scale_event(spool, "scale_down", 2, 1,
+                 victims=[{"worker": "wv", "pid": 4242,
+                           "worker_class": "spot", "mode": "kill"}])
+    journal.record(spool, "takeover", ticket="tb", attempt=1,
+                   trace_id=rec.get("trace_id", ""),
+                   from_worker="wv", from_pid=4242,
+                   by_pid=os.getpid())
+    journal.record(spool, "claimed", ticket="tb", worker="w0",
+                   attempt=1, trace_id=rec.get("trace_id", ""))
+    protocol.write_result(spool, "tb", "done", worker="w0",
+                          attempts=1,
+                          trace_id=rec.get("trace_id", ""))
+    report = invariants.verify(spool, quiesced=False)
+    hits = [v for v in report["violations"]
+            if v["invariant"] == "no_elastic_strike"]
+    assert len(hits) == 1 and hits[0]["ticket"] == "tb"
+    assert "4242" in hits[0]["detail"]
+
+
+# -------------------------------------------- restart-budget decay
+
+class _FakeProc:
+    def __init__(self, rc):
+        self.returncode = rc
+
+    def poll(self):
+        return self.returncode
+
+
+def _slot(ctrl, rc=1, uptime=0.0, strikes=0):
+    w = fleet_ctl._Worker("wx")
+    w.proc = _FakeProc(rc)
+    w.pid = 4242
+    w.incarnation = 1
+    w.crash_restarts = strikes
+    w.spawned_at = time.time() - uptime
+    ctrl.workers.append(w)
+    return w
+
+
+def test_restart_budget_decays_after_healthy_uptime(tmp_path):
+    """The fairness fix: --max-restarts is no longer a LIFETIME cap.
+    A crash after a healthy-uptime window resets the strike count
+    (mirroring the ticket side's attempts_at_progress watermark), so
+    a long-lived fleet with rare unrelated crashes never permanently
+    abandons a worker slot."""
+    spool = str(tmp_path / "sp")
+    ctrl = fleet_ctl.FleetController(
+        spool, workers=0, max_worker_restarts=1,
+        restart_backoff_s=0.01, restart_decay_uptime_s=5.0)
+    # budget exhausted (1 strike, cap 1) BUT the incarnation ran
+    # healthy for 10 s >= the 5 s decay window: strikes decay, the
+    # slot gets a restart instead of being abandoned
+    w = _slot(ctrl, uptime=10.0, strikes=1)
+    ctrl._reap()
+    assert not w.gave_up
+    assert w.next_restart_at is not None
+    assert w.crash_restarts == 1        # the NEW crash's strike
+
+
+def test_restart_budget_still_caps_crash_loops(tmp_path):
+    spool = str(tmp_path / "sp")
+    ctrl = fleet_ctl.FleetController(
+        spool, workers=0, max_worker_restarts=1,
+        restart_backoff_s=0.01, restart_decay_uptime_s=5.0)
+    # a fast crash (uptime under the window) with the budget spent:
+    # the slot is abandoned — the decay must not excuse crash loops
+    w = _slot(ctrl, uptime=0.5, strikes=1)
+    ctrl._reap()
+    assert w.gave_up and w.next_restart_at is None
+
+
+def test_restart_decay_disabled_with_zero_window(tmp_path):
+    spool = str(tmp_path / "sp")
+    ctrl = fleet_ctl.FleetController(
+        spool, workers=0, max_worker_restarts=1,
+        restart_backoff_s=0.01, restart_decay_uptime_s=0.0)
+    w = _slot(ctrl, uptime=1e6, strikes=1)
+    ctrl._reap()
+    assert w.gave_up                    # lifetime-cap legacy mode
+
+
+# ------------------------------------- heartbeat staleness window
+
+def test_heartbeat_max_age_env_and_config_override():
+    assert protocol.heartbeat_max_age() == 120.0
+    os.environ["TPULSAR_HEARTBEAT_MAX_AGE_S"] = "7.5"
+    assert protocol.heartbeat_max_age() == 7.5
+    protocol.set_heartbeat_max_age(60.0)      # config beats env
+    assert protocol.heartbeat_max_age() == 60.0
+    with pytest.raises(ValueError):
+        protocol.set_heartbeat_max_age(0)
+    protocol.set_heartbeat_max_age(None)
+    assert protocol.heartbeat_max_age() == 7.5
+    os.environ["TPULSAR_HEARTBEAT_MAX_AGE_S"] = "junk"
+    assert protocol.heartbeat_max_age() == 120.0
+
+
+def test_hb_fresh_resolves_window_at_call_time():
+    rec = {"t": time.time() - 10.0, "status": "running"}
+    assert protocol._hb_fresh(rec)
+    protocol.set_heartbeat_max_age(5.0)
+    assert not protocol._hb_fresh(rec)
+    assert protocol._hb_fresh(rec, max_age_s=30.0)  # explicit wins
+
+
+def test_default_config_does_not_shadow_env_knob():
+    """set_settings with an UNTOUCHED (120 s default) config must
+    leave env resolution alive — otherwise the documented
+    TPULSAR_HEARTBEAT_MAX_AGE_S knob is dead in every CLI process."""
+    from tpulsar.config.core import (TpulsarConfig,
+                                     _apply_runtime_knobs)
+    os.environ["TPULSAR_HEARTBEAT_MAX_AGE_S"] = "11.0"
+    _apply_runtime_knobs(TpulsarConfig())          # default 120
+    assert protocol.heartbeat_max_age() == 11.0    # env survives
+    cfg = TpulsarConfig()
+    cfg.jobpooler.heartbeat_max_age_s = 90.0       # explicit
+    _apply_runtime_knobs(cfg)
+    assert protocol.heartbeat_max_age() == 90.0    # config wins
+
+
+def test_config_floor_validates_against_heartbeat_interval():
+    from tpulsar.config.core import InsaneConfigsError, TpulsarConfig
+    cfg = TpulsarConfig()
+    cfg.jobpooler.heartbeat_max_age_s = 20.0       # < 3 x 10 s
+    with pytest.raises(InsaneConfigsError,
+                       match="heartbeat_max_age_s"):
+        cfg.check_sanity(create_dirs=True)
+    cfg.jobpooler.heartbeat_max_age_s = 30.0
+    cfg.check_sanity(create_dirs=True)             # the floor itself
+
+
+def test_config_validates_autoscale_knobs():
+    from tpulsar.config.core import InsaneConfigsError, TpulsarConfig
+    cfg = TpulsarConfig()
+    cfg.jobpooler.fleet_autoscale = True
+    cfg.jobpooler.fleet_max_workers = 0
+    with pytest.raises(InsaneConfigsError, match="autoscale"):
+        cfg.check_sanity(create_dirs=True)
+    cfg.jobpooler.fleet_max_workers = 4
+    cfg.check_sanity(create_dirs=True)
+    assert cfg.fleet_autoscale_config().max_workers == 4
+    cfg.jobpooler.fleet_autoscale = False
+    assert cfg.fleet_autoscale_config() is None
+
+
+# ----------------------------------------------- scenario surface
+
+def test_scenario_validates_surge_and_flap():
+    from tpulsar.chaos import scenario
+    base = {"name": "x", "workers": 1, "workload": {"beams": 2}}
+    with pytest.raises(ValueError, match="beams >= 1"):
+        scenario.from_dict({**base, "timeline": [
+            {"t": 1.0, "action": "surge_submit"}]})
+    with pytest.raises(ValueError, match="cycles"):
+        scenario.from_dict({**base, "timeline": [
+            {"t": 1.0, "action": "flap_capacity", "beams": 2,
+             "cycles": 0}]})
+    sc = scenario.from_dict({**base, "timeline": [
+        {"t": 1.0, "action": "surge_submit", "beams": 5},
+        {"t": 2.0, "action": "flap_capacity", "beams": 2,
+         "cycles": 3, "period_s": 0.5}]})
+    assert [a.action for a in sc.conductor_actions()] == \
+        ["surge_submit", "flap_capacity"]
+    with pytest.raises(ValueError, match="autoscale"):
+        scenario.from_dict({**base,
+                            "autoscale": {"max_workers": 0}})
+
+
+def test_decision_trail_renders(tmp_path):
+    spool = str(tmp_path / "sp")
+    protocol.ensure_spool(spool)
+    _scale_event(spool, "scale_up", 1, 3, n=2)
+    _scale_event(spool, "scale_down", 3, 2, t_shift=4.0,
+                 victims=[{"worker": "w2", "pid": 9,
+                           "worker_class": "spot", "mode": "kill"}])
+    trail = autoscale.decision_trail(spool)
+    assert [e["event"] for e in trail] == ["scale_up", "scale_down"]
+    text = "\n".join(autoscale.render_trail(trail))
+    assert "1->3" in text and "3->2" in text
+    assert "w2/spot kill" in text
+    status = fleet_ctl.render_status(spool)
+    assert "scaling decision(s)" in status and "scale_up" in status
+
+
+# ------------------------------------------------ controller e2e
+
+@pytest.mark.slow
+def test_controller_elastic_surge_and_lull_e2e(tmp_path):
+    """The tentpole, live: a 1-worker elastic fleet (min 1 / max 2,
+    spot class) meets a surge — the controller scales up, drains the
+    backlog, scales back down through the lull, and every beam is
+    done exactly once with ZERO strikes (the elective kill never
+    touches a ticket's attempts)."""
+    spool = str(tmp_path / "sp")
+    cfg = autoscale.AutoscaleConfig(
+        min_workers=1, max_workers=2, queue_wait_slo_s=5.0,
+        backlog_per_worker=2.0, cooldown_s=0.4, idle_window_s=0.4,
+        drain_deadline_s=2.0, worker_class="spot",
+        slo_lookback_s=1.0)
+
+    def cmd(wid):
+        return [*_STUB_ARGV, "--spool", spool, "--worker-id", wid,
+                "--beam-s", "0.15"]
+
+    ctrl = fleet_ctl.FleetController(
+        spool, workers=1, worker_cmd=cmd, autoscale=cfg,
+        poll_s=0.05, restart_backoff_s=0.05, drain_timeout_s=20.0)
+    th = threading.Thread(target=ctrl.run, daemon=True)
+    th.start()
+    try:
+        deadline = time.time() + 15.0
+        while time.time() < deadline \
+                and not protocol.fresh_workers(spool):
+            time.sleep(0.05)
+        tickets = [f"s{i}" for i in range(8)]
+        for tid in tickets:                       # the surge
+            protocol.write_ticket(spool, tid, ["/x"], "/o")
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if all(protocol.read_result(spool, t) for t in tickets):
+                break
+            time.sleep(0.1)
+        # ... and the lull: wait for the scale-down
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            if any(e.get("event") == "scale_down"
+                   for e in journal.read_events(spool)):
+                break
+            time.sleep(0.1)
+    finally:
+        ctrl.request_drain()
+        th.join(timeout=30.0)
+    assert not th.is_alive()
+    recs = [protocol.read_result(spool, t) for t in tickets]
+    assert all(r and r["status"] == "done" for r in recs)
+    assert all(r["attempts"] == 0 for r in recs)   # zero strikes
+    events = journal.read_events(spool)
+    names = [e.get("event") for e in events]
+    assert "scale_up" in names and "scale_down" in names
+    assert "takeover" not in names
+    up = next(e for e in events if e["event"] == "scale_up")
+    assert up["workers_after"] <= 2 and up["pending"] >= 1
+    down = next(e for e in events if e["event"] == "scale_down")
+    assert down["victims"][0]["worker_class"] == "spot"
+    assert down["victims"][0]["pid"] in \
+        protocol.elective_kill_pids(spool)
+    spawned = {e.get("worker_class", "") for e in events
+               if e["event"] == "worker_spawn"
+               and e.get("kind") == "scale_up"}
+    assert spawned == {"spot"}
+    report = invariants.verify(spool, quiesced=True)
+    assert report["ok"], report["violations"]

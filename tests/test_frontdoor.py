@@ -169,6 +169,41 @@ def test_contract_drain_requeue_is_attempt_neutral(q):
     assert q.claim_next("w1")["ticket"] == "t1"
 
 
+def test_contract_scaledown_racing_admission(q):
+    """The autoscaler race, as a contract property on BOTH backends:
+    a ticket submitted while a drain victim is being retired must be
+    claimed by a surviving worker promptly — never lost, never
+    double-run, never charged a strike.  The drain victim holds a
+    claim when retirement starts; admission lands mid-retirement;
+    the victim's attempt-neutral requeue and the survivor's claims
+    must interleave into exactly-once execution of BOTH beams."""
+    q.submit("t-held", ["/x"], "/o", job_id=1)
+    held = q.claim_next("w-victim")
+    assert held["ticket"] == "t-held"
+    # retirement begins; a submitter races it through admission
+    q.submit("t-racing", ["/y"], "/o", job_id=2)
+    assert q.requeue_own_claims() == ["t-held"]    # the drain
+    # the survivor picks BOTH up: the returned beam kept its FIFO
+    # seniority (older submitted_at), the racer follows, and neither
+    # carries a strike from the retirement
+    first = q.claim_next("w-survivor")
+    second = q.claim_next("w-survivor")
+    assert [first["ticket"], second["ticket"]] == \
+        ["t-held", "t-racing"]
+    assert first["attempts"] == 0 and second["attempts"] == 0
+    assert q.claim_next("w-survivor") is None      # nothing doubled
+    for rec in (first, second):
+        q.write_result(rec["ticket"], "done", worker="w-survivor",
+                       attempts=0,
+                       trace_id=rec.get("trace_id", ""))
+    for tid in ("t-held", "t-racing"):
+        evs = q.read_events(ticket=tid)
+        assert journal.validate_chain(evs) == [], evs
+        assert [e["event"] for e in evs].count(
+            journal.TERMINAL_EVENT) == 1
+        assert not any(e["event"] == "takeover" for e in evs)
+
+
 def test_contract_result_durable_and_one_terminal_event(q):
     q.submit("t1", ["/x"], "/odir", job_id=1)
     rec = q.claim_next("w0")

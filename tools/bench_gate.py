@@ -70,6 +70,9 @@ DEFAULT_KEYS = (
     ("resume.wasted_compute_s", "lower"),
     ("resume.wasted_reduction", "higher"),
     ("resume.mttr_s", "lower"),
+    ("autoscale.cost_per_beam_ws", "lower"),
+    ("autoscale.queue_wait_p95_s", "lower"),
+    ("autoscale.cost_saving", "higher"),
 )
 
 

@@ -118,6 +118,15 @@ INVARIANTS = {
     "no_pass_rerun":
         "journaled pass completions are never re-executed after "
         "resume (checkpoint_invalid/_disabled are the only excuses)",
+    "scaling_bounded":
+        "autoscaler worker counts stay within the journaled "
+        "[min, max] band, every scale event's arithmetic is "
+        "consistent, and consecutive scale events respect the "
+        "cooldown (no capacity thrash)",
+    "no_elastic_strike":
+        "autoscaler-initiated preemptions never advance a ticket "
+        "toward quarantine: no takeover (strike) ever names a "
+        "journaled scale-down victim's pid as the dead owner",
 }
 
 #: events that RELEASE a claim (close an inflight interval)
@@ -402,6 +411,97 @@ def _quota_sweep(per_ticket: dict[str, list[dict]],
     return out
 
 
+#: slack for the cooldown audit: the scale event is journaled after
+#: the action executes (spawns take milliseconds), while the cooldown
+#: clock is armed from the decision's signal-read instant — real
+#: thrash shows deltas far BELOW the cooldown, not within this slop
+_COOLDOWN_SLACK_S = 0.5
+
+
+def _elastic_sweep(events: list[dict]) -> list[dict]:
+    """The autoscaler's contract, replayed from the journal alone.
+
+    scaling_bounded: every ``scale_up``/``scale_down`` event carries
+    its own policy bounds (min/max/cooldown) and before/after counts
+    — self-contained evidence.  Checked: the after-count stays inside
+    [min, max], the arithmetic is consistent (after = before ± n),
+    and consecutive scale events are at least the cooldown apart.
+
+    no_elastic_strike: a ``scale_down`` event names its victims
+    (worker, pid) — the controller wrote the elective-kill ledger
+    BEFORE the signal, so a janitor must reclaim those pids' claims
+    attempt-neutrally (``drain_requeue`` reason ``scale_down``).  A
+    ``takeover`` naming an elective victim as its dead owner means
+    elasticity charged a beam a crash strike toward quarantine."""
+    out: list[dict] = []
+    scale = [e for e in events
+             if e.get("event") in ("scale_up", "scale_down")]
+    prev = None
+    for ev in scale:
+        name = ev.get("event")
+        before = ev.get("workers_before")
+        after = ev.get("workers_after")
+        lo, hi = ev.get("min_workers"), ev.get("max_workers")
+        n = int(ev.get("n", 1))
+        if None in (before, after, lo, hi):
+            out.append(_v("scaling_bounded", "",
+                          f"{name} event missing its policy "
+                          f"evidence (before/after/min/max): {ev}"))
+            continue
+        if not lo <= after <= hi:
+            out.append(_v("scaling_bounded", "",
+                          f"{name} left {after} worker(s), outside "
+                          f"[{lo}, {hi}]"))
+        want = before + n if name == "scale_up" else before - n
+        if after != want:
+            out.append(_v("scaling_bounded", "",
+                          f"{name} arithmetic: {before} "
+                          f"{'+' if name == 'scale_up' else '-'}{n} "
+                          f"!= {after}"))
+        if prev is not None:
+            gap = ev.get("t", 0.0) - prev.get("t", 0.0)
+            cool = float(ev.get("cooldown_s", 0.0))
+            if gap + _COOLDOWN_SLACK_S < cool:
+                out.append(_v(
+                    "scaling_bounded", "",
+                    f"{prev.get('event')} -> {name} only "
+                    f"{gap:.2f} s apart (cooldown {cool:g} s): "
+                    f"capacity thrash"))
+        prev = ev
+    # elective victims: (worker, pid) -> kill instant — the PAIR,
+    # exactly as the janitor's verdict matches the ledger (a pid
+    # alone can be recycled into another worker's incarnation, whose
+    # genuine crash strike must not read as an elastic one)
+    victims: dict[tuple[str, int], float] = {}
+    for ev in scale:
+        if ev.get("event") != "scale_down":
+            continue
+        for v in ev.get("victims") or ():
+            pid = v.get("pid")
+            if pid:
+                victims[(str(v.get("worker", "")), int(pid))] = \
+                    ev.get("t", 0.0)
+    if victims:
+        for ev in events:
+            if ev.get("event") != "takeover":
+                continue
+            try:
+                pair = (str(ev.get("from_worker", "")),
+                        int(ev.get("from_pid") or 0))
+            except (TypeError, ValueError):
+                continue
+            t_kill = victims.get(pair)
+            if t_kill is not None and ev.get("t", 0.0) >= t_kill:
+                out.append(_v(
+                    "no_elastic_strike", ev.get("ticket", ""),
+                    f"takeover charged attempt "
+                    f"{ev.get('attempt')} against pid {pair[1]} "
+                    f"(worker {pair[0] or '?'}) — a journaled "
+                    f"scale-down victim: an elective preemption "
+                    f"advanced this beam toward quarantine"))
+    return out
+
+
 def _sidefile_sweep(spool: str) -> list[dict]:
     out = []
     for state in ("incoming", "claimed", "done", "quarantine"):
@@ -465,7 +565,11 @@ def verify(spool: str, *, tenants: dict | None = None,
     counts = {"tickets": len(per_ticket), "events": len(events),
               "terminal": 0, "pending_at_quiesce": 0,
               "submit_failed": 0, "takeovers": 0, "quarantined": 0,
-              "resumes": 0, "journal_gaps": 0}
+              "resumes": 0, "journal_gaps": 0,
+              "scale_ups": sum(1 for e in events
+                               if e.get("event") == "scale_up"),
+              "scale_downs": sum(1 for e in events
+                                 if e.get("event") == "scale_down")}
     for tid, evs in sorted(per_ticket.items()):
         presence = _spool_presence(spool, tid)
         violations.extend(_audit_chain(tid, evs, presence,
@@ -505,6 +609,7 @@ def verify(spool: str, *, tenants: dict | None = None,
                 f"trace id {tr} shared by {len(tids)} tickets"))
 
     violations.extend(_quota_sweep(per_ticket, done_recs, tenants))
+    violations.extend(_elastic_sweep(events))
     if quiesced:
         violations.extend(_sidefile_sweep(spool))
         violations.extend(_checkpoint_litter_sweep(per_ticket))
@@ -711,7 +816,9 @@ def render_verify(report: dict) -> str:
         f"pending, {c['submit_failed']} submit-failed, "
         f"{c['takeovers']} takeover(s), {c['quarantined']} "
         f"quarantined, {c.get('resumes', 0)} checkpoint resume(s), "
-        f"{c['journal_gaps']} journal gap(s)")
+        f"{c['journal_gaps']} journal gap(s), "
+        f"{c.get('scale_ups', 0)} scale-up(s) / "
+        f"{c.get('scale_downs', 0)} scale-down(s)")
     width = max(len(n) for n in INVARIANTS)
     for name in INVARIANTS:
         n = report["invariants"].get(name, 0)

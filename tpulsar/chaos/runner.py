@@ -53,6 +53,10 @@ class ChaosRunner:
         self._stopped_pids: set[int] = set()
         self.tickets: list[str] = []
         self.actions: list[dict] = []
+        #: next beam index for EXTRA submissions (surge_submit /
+        #: flap_capacity bursts) — continues past the steady
+        #: workload's ids so every ticket id and outdir stays unique
+        self._beam_seq = sc.workload.beams
 
     # ------------------------------------------------------------- fleet
 
@@ -84,13 +88,17 @@ class ChaosRunner:
         return env
 
     def _start_fleet(self):
+        from tpulsar.fleet.autoscale import AutoscaleConfig
         from tpulsar.fleet.controller import FleetController
+        asc = (AutoscaleConfig.from_dict(self.sc.autoscale)
+               if self.sc.autoscale else None)
         self._ctrl = FleetController(
             self.spool, workers=self.sc.workers,
             worker_cmd=self._worker_cmd,
             worker_env=self._worker_env,
             max_worker_restarts=self.sc.max_worker_restarts,
             ticket_max_attempts=self.sc.max_attempts,
+            autoscale=asc,
             poll_s=self.sc.poll_s,
             drain_timeout_s=20.0, logger=self.log)
         self._ctrl_thread = threading.Thread(
@@ -111,10 +119,13 @@ class ChaosRunner:
         self._gateway_port = self.gateway.port
 
     def _wait_fleet_fresh(self, timeout_s: float = 30.0) -> bool:
+        # the controller may have clamped the initial count into the
+        # autoscale [min, max] band — wait for what it actually spawned
+        want = len(self._ctrl.workers) if self._ctrl is not None \
+            else self.sc.workers
         deadline = time.time() + timeout_s
         while time.time() < deadline:
-            if len(protocol.fresh_workers(self.spool)) \
-                    >= self.sc.workers:
+            if len(protocol.fresh_workers(self.spool)) >= want:
                 return True
             self.sleeper(0.1)
         return False
@@ -177,6 +188,30 @@ class ChaosRunner:
             self._ctrl.pause_janitor(a.seconds)
             self._journal_action(t_rel, a.action,
                                  seconds=a.seconds)
+        elif a.action == "surge_submit":
+            # thundering herd: `beams` submissions as fast as the
+            # transport allows — the backlog spike the autoscaler
+            # must answer with a bounded, cooled-down scale-up
+            self._journal_action(t_rel, a.action, beams=a.beams)
+            for _ in range(a.beams):
+                i = self._beam_seq
+                self._beam_seq += 1
+                self._submit(i, t_rel)
+        elif a.action == "flap_capacity":
+            # oscillating load: bursts separated by silence, faster
+            # than a naive policy would scale — the hysteresis/
+            # cooldown trap.  Runs inline on the conductor; later
+            # timeline entries are not delayed (the plan executor
+            # only sleeps when it is AHEAD of schedule).
+            self._journal_action(t_rel, a.action, beams=a.beams,
+                                 cycles=a.cycles,
+                                 period_s=a.period_s)
+            for cycle in range(a.cycles):
+                for _ in range(a.beams):
+                    i = self._beam_seq
+                    self._beam_seq += 1
+                    self._submit(i, t_rel + cycle * a.period_s)
+                self.sleeper(a.period_s)
 
     # ---------------------------------------------------------- workload
 

@@ -63,7 +63,8 @@ SCHEDULE_FILE = "schedule.json"
 RUN_FILE = "run.json"
 
 ACTIONS = ("kill_worker", "stop_worker", "cont_worker",
-           "restart_gateway", "pause_janitor", "set_faults")
+           "restart_gateway", "pause_janitor", "set_faults",
+           "surge_submit", "flap_capacity")
 KILL_SIGNALS = ("KILL", "TERM")
 WORKER_KINDS = ("stub", "serve")
 SUBMIT_VIAS = ("spool", "gateway")
@@ -90,6 +91,15 @@ class Action:
     seconds: float = 5.0        # pause_janitor duration
     until: float | None = None  # set_faults window close (None = open)
     faults: str = ""
+    #: surge_submit: a thundering herd of `beams` extra submissions
+    #: at instant t (on top of the steady workload) — the autoscaler
+    #: storm; flap_capacity: `cycles` alternations of a `beams` burst
+    #: followed by `period_s` of silence — load that OSCILLATES
+    #: faster than naive scaling reacts, the thrash the cooldown/
+    #: hysteresis must absorb
+    beams: int = 0
+    cycles: int = 2
+    period_s: float = 1.0
 
 
 @dataclasses.dataclass
@@ -120,6 +130,12 @@ class Scenario:
     max_worker_restarts: int = 5
     gateway: bool = False
     tenants: dict = dataclasses.field(default_factory=dict)
+    #: non-empty = run the fleet ELASTIC: the dict is an
+    #: autoscale.AutoscaleConfig (validated at load, same loud
+    #: contract), `workers` becomes the initial count (clamped into
+    #: [min, max] by the controller), and the new scaling_bounded /
+    #: no_elastic_strike invariants arm themselves on the journal
+    autoscale: dict = dataclasses.field(default_factory=dict)
     workload: Workload = dataclasses.field(default_factory=Workload)
     timeline: list[Action] = dataclasses.field(default_factory=list)
     quiesce_timeout_s: float = 45.0
@@ -190,6 +206,14 @@ def from_dict(doc: dict) -> Scenario:
             if a.until is not None and a.until <= a.t:
                 raise ValueError(f"timeline[{i}]: until {a.until} "
                                  f"<= t {a.t}")
+        if a.action in ("surge_submit", "flap_capacity") \
+                and a.beams < 1:
+            raise ValueError(f"timeline[{i}]: {a.action} needs "
+                             f"beams >= 1")
+        if a.action == "flap_capacity" \
+                and (a.cycles < 1 or a.period_s <= 0):
+            raise ValueError(f"timeline[{i}]: flap_capacity needs "
+                             f"cycles >= 1 and a positive period_s")
         timeline.append(a)
     sc = _take(doc, Scenario, "scenario", workload=wl,
                timeline=timeline)
@@ -207,6 +231,10 @@ def from_dict(doc: dict) -> Scenario:
         # validate the tenant table exactly as the claim path will
         from tpulsar.frontdoor.tenancy import TenantPolicy
         TenantPolicy(sc.tenants)
+    if sc.autoscale:
+        # validate the elastic policy exactly as the controller will
+        from tpulsar.fleet.autoscale import AutoscaleConfig
+        AutoscaleConfig.from_dict(sc.autoscale)
     return sc
 
 

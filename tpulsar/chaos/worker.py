@@ -85,7 +85,7 @@ def _run_pass_beam(spool: str, wid: str, rec: dict, args,
                    npasses: int) -> dict:
     """One multi-pass beam through the checkpoint store.  Returns the
     result-record extras (passes, computed/resumed counts, digest)."""
-    from tpulsar import checkpoint as ckpt
+    from tpulsar import checkpoint as ckpt   # hoisted via main()
 
     tid = rec.get("ticket", "?")
     att = int(rec.get("attempts", 0))
@@ -150,6 +150,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--spool", required=True)
     p.add_argument("--worker-id", required=True)
+    p.add_argument("--worker-class", default="",
+                   help="worker class stamped on heartbeats and "
+                        "claims ('spot' = the autoscaler SIGKILLs "
+                        "this worker on scale-down instead of "
+                        "draining it)")
     p.add_argument("--beam-s", type=float, default=0.2)
     p.add_argument("--depth", type=int, default=8)
     p.add_argument("--poll-s", type=float, default=0.05)
@@ -180,6 +185,12 @@ def main(argv=None) -> int:
 
     faults.configure()          # TPULSAR_FAULTS + chaos schedule env
     policy = _policy()
+    # pay the checkpoint layer's import at BOOT, not inside the first
+    # claimed beam: on a loaded host the lazy import would stretch
+    # the first beam by whole seconds and skew every storm timing
+    # (the worker heartbeats only after this line, so the conductor's
+    # fleet-fresh gate already accounts for it)
+    import tpulsar.checkpoint  # noqa: F401
     spool, wid = args.spool, args.worker_id
 
     draining = []
@@ -196,7 +207,9 @@ def main(argv=None) -> int:
             protocol.write_heartbeat(
                 spool, worker_id=wid, status=status,
                 queue_depth=protocol.pending_count(spool),
-                max_queue_depth=args.depth)
+                max_queue_depth=args.depth,
+                **({"worker_class": args.worker_class}
+                   if args.worker_class else {}))
             last_beat[0] = now
         except OSError:
             pass      # a spool.io window costs freshness, not the worker
@@ -212,8 +225,9 @@ def main(argv=None) -> int:
     claims = 0
     while not draining:
         try:
-            rec = protocol.claim_next_ticket(spool, wid,
-                                             policy=policy)
+            rec = protocol.claim_next_ticket(
+                spool, wid, policy=policy,
+                worker_class=args.worker_class)
         except OSError:
             beat()
             time.sleep(args.poll_s)

@@ -267,15 +267,19 @@ def cmd_serve(args):
     server = SearchServer(
         spool=args.spool or _serve_spool(cfg), cfg=cfg,
         worker_id=args.worker_id,
+        worker_class=args.worker_class,
         max_queue_depth=cfg.jobpooler.serve_queue_depth,
         beam_deadline_s=args.beam_deadline,
         ticket_max_attempts=cfg.jobpooler.serve_max_attempts,
         warm_boot=not args.no_warmstart,
         warm_boot_scale=args.warmstart_scale,
+        heartbeat_interval_s=cfg.jobpooler.serve_heartbeat_interval_s,
         prefetch_depth=args.prefetch_depth)
     server.install_signal_handlers()
     print(f"serve: spool {server.spool} "
           + (f"worker {args.worker_id} " if args.worker_id else "")
+          + (f"class {args.worker_class} " if args.worker_class
+             else "")
           + f"(depth {server.max_queue_depth}, "
           f"warm boot {'on' if server.warm_boot else 'off'}"
           + (f", beam deadline {args.beam_deadline:g} s"
@@ -312,14 +316,37 @@ def cmd_fleet(args):
         return 0
     nworkers = (args.workers if args.workers is not None
                 else cfg.jobpooler.fleet_workers)
+    autoscale_cfg = cfg.fleet_autoscale_config()
+    if args.autoscale:
+        # --autoscale MIN:MAX overrides (and enables) the config's
+        # elastic policy for this controller; the knob->config
+        # mapping itself lives in ONE place (fleet_autoscale_config)
+        import dataclasses as _dc
+        try:
+            lo, _, hi = args.autoscale.partition(":")
+            base = autoscale_cfg \
+                or cfg.fleet_autoscale_config(force=True)
+            autoscale_cfg = _dc.replace(
+                base, min_workers=int(lo),
+                max_workers=int(hi)).validate()
+        except ValueError as e:
+            print(f"--autoscale wants MIN:MAX within a sane elastic "
+                  f"policy, got {args.autoscale!r}: {e}",
+                  file=sys.stderr)
+            return 2
     ctrl = fleet_ctl.FleetController(
         spool=spool, workers=nworkers, once=args.once,
         max_worker_restarts=args.max_restarts,
         ticket_max_attempts=cfg.jobpooler.serve_max_attempts,
+        autoscale=autoscale_cfg,
         worker_args=tuple(args.worker_arg))
-    print(f"fleet: {nworkers} worker(s) on spool {spool} "
+    print(f"fleet: {len(ctrl.workers)} worker(s) on spool {spool} "
           f"(restart budget {args.max_restarts}, ticket attempts cap "
-          f"{cfg.jobpooler.serve_max_attempts})")
+          f"{cfg.jobpooler.serve_max_attempts}"
+          + (f", elastic [{autoscale_cfg.min_workers}, "
+             f"{autoscale_cfg.max_workers}] class "
+             f"{autoscale_cfg.worker_class or 'ondemand'}"
+             if autoscale_cfg else "") + ")")
     try:
         rc = ctrl.run()
     finally:
@@ -1239,6 +1266,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "server.<id>.json and claims/results are "
                          "stamped with it (empty = single-server "
                          "server.json)")
+    sp.add_argument("--worker-class", default="",
+                    choices=["", "ondemand", "spot"],
+                    help="capacity class stamped on heartbeats, "
+                         "claims, and results: 'spot' workers treat "
+                         "an autoscaler SIGKILL as routine (claims "
+                         "requeue attempt-neutrally off the "
+                         "scale-down ledger, checkpoint resume "
+                         "salvages durable passes)")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(
@@ -1251,7 +1286,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="worker count (default: "
                          "jobpooler.fleet_workers; 0 = janitor/"
                          "aggregator only, for externally-launched "
-                         "workers)")
+                         "workers; with autoscaling this is the "
+                         "INITIAL count, clamped into [min, max])")
+    sp.add_argument("--autoscale", default="", metavar="MIN:MAX",
+                    help="run the fleet elastic: scale workers "
+                         "between MIN and MAX from journal-derived "
+                         "signals (queue-wait SLO, backlog per "
+                         "worker, advertised headroom) with "
+                         "hysteresis + cooldown; scale-down drains "
+                         "on-demand workers and SIGKILLs spot ones "
+                         "(config: jobpooler.fleet_autoscale and the "
+                         "autoscale_* knobs)")
     sp.add_argument("--spool", default=None,
                     help="spool dir (default: jobpooler.serve_spool "
                          "or <base_working_directory>/.serve_spool)")

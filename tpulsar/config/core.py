@@ -101,6 +101,32 @@ class JobPoolerConfig:
     #                                        a beam is quarantined
     fleet_workers: int = 2                 # default `tpulsar fleet`
     #                                        worker count
+    serve_heartbeat_interval_s: float = 10.0   # worker heartbeat
+    #                                        cadence
+    heartbeat_max_age_s: float = 120.0     # heartbeats older than
+    #                                        this read stale (worker
+    #                                        presumed gone); one knob
+    #                                        for the whole stack —
+    #                                        freshness, capacity,
+    #                                        janitor grace, autoscaler
+    #                                        reaction.  Floor-checked
+    #                                        against the heartbeat
+    #                                        interval.
+    # --- elastic fleet (tpulsar/fleet/autoscale.py) ---
+    fleet_autoscale: bool = False          # scale workers between
+    #                                        min/max from journal
+    #                                        signals
+    fleet_min_workers: int = 1
+    fleet_max_workers: int = 4
+    autoscale_queue_wait_slo_s: float = 30.0   # scale-up SLO trigger
+    autoscale_backlog_per_worker: float = 2.0  # pending/worker target
+    autoscale_cooldown_s: float = 30.0     # min gap between actions
+    autoscale_idle_window_s: float = 60.0  # sustained-low-load gate
+    #                                        before scale-down
+    autoscale_drain_deadline_s: float = 20.0   # drain grace before
+    #                                        the SIGKILL escalation
+    autoscale_worker_class: str = "spot"   # class of elastic workers
+    #                                        (spot = SIGKILL routine)
 
 
 @dataclasses.dataclass
@@ -254,6 +280,23 @@ class TpulsarConfig:
             problems.append("jobpooler.serve_max_attempts must be >= 1")
         if self.jobpooler.fleet_workers < 1:
             problems.append("jobpooler.fleet_workers must be >= 1")
+        if self.jobpooler.serve_heartbeat_interval_s <= 0:
+            problems.append(
+                "jobpooler.serve_heartbeat_interval_s must be "
+                "positive")
+        elif self.jobpooler.heartbeat_max_age_s \
+                < 3 * self.jobpooler.serve_heartbeat_interval_s:
+            # the floor: a staleness window under ~3 heartbeats
+            # would declare healthy workers dead on one missed beat
+            problems.append(
+                f"jobpooler.heartbeat_max_age_s "
+                f"({self.jobpooler.heartbeat_max_age_s:g}) must be "
+                f">= 3 x serve_heartbeat_interval_s "
+                f"({self.jobpooler.serve_heartbeat_interval_s:g})")
+        try:
+            self.fleet_autoscale_config()
+        except ValueError as e:
+            problems.append(f"jobpooler autoscale: {e}")
         if (self.jobpooler.queue_manager == "tpu_slice"
                 and not self.jobpooler.tpu_hosts.strip()):
             problems.append(
@@ -287,6 +330,29 @@ class TpulsarConfig:
 
         if problems:
             raise InsaneConfigsError(problems)
+
+    def fleet_autoscale_config(self, force: bool = False):
+        """The jobpooler autoscale knobs as a validated
+        fleet.autoscale.AutoscaleConfig (None when autoscaling is
+        off; ``force=True`` builds it regardless — the CLI's
+        ``--autoscale MIN:MAX`` path, so the knob->config mapping
+        lives in exactly one place).  Raises ValueError on
+        inconsistent knobs — called from check_sanity so a bad
+        elastic config fails at load, not at the first scale
+        decision."""
+        jp = self.jobpooler
+        if not jp.fleet_autoscale and not force:
+            return None
+        from tpulsar.fleet.autoscale import AutoscaleConfig
+        return AutoscaleConfig(
+            min_workers=jp.fleet_min_workers,
+            max_workers=jp.fleet_max_workers,
+            queue_wait_slo_s=jp.autoscale_queue_wait_slo_s,
+            backlog_per_worker=jp.autoscale_backlog_per_worker,
+            cooldown_s=jp.autoscale_cooldown_s,
+            idle_window_s=jp.autoscale_idle_window_s,
+            drain_deadline_s=jp.autoscale_drain_deadline_s,
+            worker_class=jp.autoscale_worker_class).validate()
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -327,14 +393,33 @@ def load_config(path: str | None = None, create_dirs: bool = True
     return cfg
 
 
+def _apply_runtime_knobs(cfg: TpulsarConfig) -> None:
+    """Propagate config fields that back module-level runtime knobs
+    (today: the heartbeat staleness window every serve/fleet
+    freshness judgment resolves through)."""
+    try:
+        from tpulsar.serve import protocol
+        v = cfg.jobpooler.heartbeat_max_age_s
+        # a DEFAULT-valued config must not install an override: doing
+        # so would shadow the TPULSAR_HEARTBEAT_MAX_AGE_S env var in
+        # every CLI process and make the documented env knob dead —
+        # only an explicitly non-default config value wins over env
+        protocol.set_heartbeat_max_age(
+            v if v != protocol.HEARTBEAT_MAX_AGE_S else None)
+    except (ImportError, ValueError):
+        pass
+
+
 def settings() -> TpulsarConfig:
     """Process-global settings (lazy default)."""
     global _SETTINGS
     if _SETTINGS is None:
         _SETTINGS = load_config(os.environ.get("TPULSAR_CONFIG"))
+        _apply_runtime_knobs(_SETTINGS)
     return _SETTINGS
 
 
 def set_settings(cfg: TpulsarConfig) -> None:
     global _SETTINGS
     _SETTINGS = cfg
+    _apply_runtime_knobs(cfg)

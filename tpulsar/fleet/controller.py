@@ -46,6 +46,7 @@ import sys
 import threading
 import time
 
+from tpulsar.fleet import autoscale as autoscale_mod
 from tpulsar.obs import fleetview, journal, metrics, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.resilience import policy
@@ -84,13 +85,23 @@ class _Worker:
     """One supervised worker slot (the process behind it comes and
     goes across restarts; the slot and its id persist)."""
 
-    def __init__(self, worker_id: str):
+    def __init__(self, worker_id: str, worker_class: str = "",
+                 elastic: bool = False):
         self.worker_id = worker_id
+        #: "" (on-demand) or "spot" — elastic slots the autoscaler
+        #: adds carry the configured class; spot workers are
+        #: SIGKILLed on scale-down instead of drained
+        self.worker_class = worker_class
+        #: True for slots the autoscaler may retire (above min, or
+        #: added by a scale-up); base slots below min are NEVER
+        #: scale-down candidates, independent of class
+        self.elastic = elastic
         self.proc: subprocess.Popen | None = None
         self.pid: int | None = None
         self.incarnation = 0
         self.crash_restarts = 0
         self.next_restart_at: float | None = None
+        self.spawned_at: float = 0.0
         self.gave_up = False
         self.done = False            # exited 0 in once mode
         self.last_rc: int | None = None
@@ -107,11 +118,13 @@ class FleetController:
                  once: bool = False,
                  max_worker_restarts: int = 5,
                  restart_backoff_s: float = 1.0,
+                 restart_decay_uptime_s: float = 300.0,
                  restart_policy: policy.RetryPolicy | None = None,
                  ticket_max_attempts: int =
                  protocol.DEFAULT_MAX_ATTEMPTS,
-                 heartbeat_max_age_s: float =
-                 protocol.HEARTBEAT_MAX_AGE_S,
+                 heartbeat_max_age_s: float | None = None,
+                 autoscale: autoscale_mod.AutoscaleConfig
+                 | None = None,
                  poll_s: float = 1.0,
                  drain_timeout_s: float = 120.0,
                  logger=None, sleeper=time.sleep):
@@ -129,13 +142,44 @@ class FleetController:
             max_attempts=max(0, max_worker_restarts),
             backoff_base_s=restart_backoff_s, backoff_mult=2.0,
             backoff_max_s=60.0)
+        #: restart-budget FAIRNESS: an incarnation that stayed up
+        #: this long before crashing proves the slot is healthy, so
+        #: its accumulated strikes decay to zero (the PR-10
+        #: attempts_at_progress watermark pattern, applied to the
+        #: worker axis) — a long-lived fleet with rare unrelated
+        #: crashes no longer exhausts a LIFETIME cap and abandons the
+        #: slot forever.  0 disables the decay.
+        self.restart_decay_uptime_s = restart_decay_uptime_s
         self.ticket_max_attempts = ticket_max_attempts
         self.heartbeat_max_age_s = heartbeat_max_age_s
         self.poll_s = poll_s
         self.drain_timeout_s = drain_timeout_s
         self.log = logger or get_logger("fleet")
         self.sleeper = sleeper
-        self.workers = [_Worker(f"w{i}") for i in range(workers)]
+        #: elastic policy (None = the classic static fleet).  With it
+        #: the initial worker count is clamped into [min, max] and
+        #: slots past min_workers carry the elastic worker class.
+        self.autoscale_cfg = autoscale
+        self._as: autoscale_mod.Autoscaler | None = None
+        if autoscale is not None:
+            autoscale.validate()
+            workers = max(autoscale.min_workers,
+                          min(workers, autoscale.max_workers))
+            self._as = autoscale_mod.Autoscaler(autoscale, self.spool)
+        self.workers = [
+            _Worker(f"w{i}",
+                    worker_class=(autoscale.worker_class
+                                  if autoscale is not None
+                                  and i >= autoscale.min_workers
+                                  else ""),
+                    elastic=(autoscale is not None
+                             and i >= autoscale.min_workers))
+            for i in range(workers)]
+        self._next_wid = workers
+        #: scale-down victims mid-retirement: worker -> SIGKILL
+        #: escalation deadline (0 = already killed); their exit is
+        #: elective, so _reap must not count it as a crash
+        self._retiring: dict[_Worker, float] = {}
         self._cycling: _Worker | None = None
         #: chaos-harness hook: while set in the future, the janitor
         #: skips its recovery scan — models a slow/partitioned
@@ -184,6 +228,11 @@ class FleetController:
 
     def _spawn(self, w: _Worker, kind: str = "start") -> None:
         argv = self.worker_cmd(w.worker_id)
+        if w.worker_class:
+            # the class rides the command line uniformly: both the
+            # real serve worker and the chaos stub accept it, and an
+            # injected worker_cmd needn't know elasticity exists
+            argv = list(argv) + ["--worker-class", w.worker_class]
         env = dict(os.environ)
         if self.worker_env is not None:
             env.update(self.worker_env(w.worker_id) or {})
@@ -198,11 +247,16 @@ class FleetController:
         w.pid = w.proc.pid
         w.incarnation += 1
         w.next_restart_at = None
+        w.spawned_at = time.time()
         journal.record(self.spool, "worker_spawn",
                        worker=w.worker_id, kind=kind, pid=w.pid,
-                       incarnation=w.incarnation)
-        self.log.info("%s worker %s (pid %d, incarnation %d)",
-                      kind, w.worker_id, w.pid, w.incarnation)
+                       incarnation=w.incarnation,
+                       **({"worker_class": w.worker_class}
+                          if w.worker_class else {}))
+        self.log.info("%s worker %s (pid %d, incarnation %d%s)",
+                      kind, w.worker_id, w.pid, w.incarnation,
+                      f", class {w.worker_class}"
+                      if w.worker_class else "")
 
     def _mark_worker_down(self, w: _Worker) -> None:
         """Stamp a dead incarnation's heartbeat 'stopped' so the warm
@@ -221,13 +275,16 @@ class FleetController:
                 pass     # the heartbeat ages out on its own
 
     def _reap(self) -> None:
-        for w in self.workers:
-            if w is self._cycling:
-                continue     # mid-rolling-restart: its exit is the
-                             # drain we asked for, not a crash
+        for w in list(self.workers):
+            if w is self._cycling or w in self._retiring:
+                continue     # mid-rolling-restart / mid-scale-down:
+                             # its exit is the one we asked for, not
+                             # a crash
             if w.proc is None or w.proc.poll() is None:
                 continue
             rc = w.proc.returncode
+            uptime = (time.time() - w.spawned_at
+                      if w.spawned_at else 0.0)
             w.proc = None
             w.last_rc = rc
             self._mark_worker_down(w)
@@ -241,6 +298,20 @@ class FleetController:
                 self.log.info("worker %s finished (spool drained)",
                               w.worker_id)
                 continue
+            # restart-budget fairness: a crash after a HEALTHY uptime
+            # window is not part of a crash loop — decay the strikes
+            # so rare unrelated crashes over months cannot exhaust a
+            # lifetime cap and abandon the slot (mirrors the ticket
+            # side's attempts_at_progress watermark)
+            if w.crash_restarts and self.restart_decay_uptime_s > 0 \
+                    and uptime >= self.restart_decay_uptime_s:
+                self.log.info(
+                    "worker %s ran healthy for %.0f s (>= %.0f s): "
+                    "restart budget reset (%d strike(s) decayed)",
+                    w.worker_id, uptime, self.restart_decay_uptime_s,
+                    w.crash_restarts)
+                w.crash_restarts = 0
+                w.gave_up = False
             if not self.restart_policy.should_retry(w.crash_restarts):
                 if not w.gave_up:
                     w.gave_up = True
@@ -305,6 +376,180 @@ class FleetController:
                     "worker (attempts cap %d)", tid,
                     self.ticket_max_attempts)
 
+    # ---------------------------------------------------------- autoscale
+
+    def _active_slots(self) -> list[_Worker]:
+        """Slots that count toward capacity: not retiring, not done,
+        not permanently given up (a crashed slot pending its paced
+        restart still counts — it is coming back)."""
+        return [w for w in self.workers
+                if w not in self._retiring
+                and not w.done and not w.gave_up]
+
+    def _finalize_retiring(self) -> None:
+        """Reap scale-down victims: SIGKILL those past their drain
+        deadline, and retire the slots of those that exited (their
+        exit is journaled ``kind=scale_down`` — elective, never a
+        crash strike against the restart budget)."""
+        now = time.time()
+        for w in list(self._retiring):
+            if w.proc is not None and w.proc.poll() is None:
+                if now >= self._retiring[w]:
+                    self.log.warning(
+                        "scale-down victim %s still alive past its "
+                        "%.0f s drain deadline; escalating to "
+                        "SIGKILL (checkpoint resume makes this "
+                        "cheap)", w.worker_id,
+                        self.autoscale_cfg.drain_deadline_s)
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+                    self._retiring[w] = now + 10.0   # re-checked
+                continue
+            rc = w.proc.returncode if w.proc is not None else None
+            w.proc = None
+            w.last_rc = rc
+            self._mark_worker_down(w)
+            journal.record(self.spool, "worker_exit",
+                           worker=w.worker_id, rc=rc, pid=w.pid,
+                           incarnation=w.incarnation,
+                           kind="scale_down")
+            self.log.info("scale-down victim %s retired (rc %s)",
+                          w.worker_id, rc)
+            del self._retiring[w]
+            try:
+                self.workers.remove(w)
+            except ValueError:
+                pass
+            # elastic slot ids are never reused, so a retired slot's
+            # spool files are permanently dead — remove them, or a
+            # long-lived fleet leaks one heartbeat + one metrics
+            # snapshot per scale cycle, all stat+parsed by every
+            # freshness/capacity probe forever
+            for path in (protocol.heartbeat_path(self.spool,
+                                                 w.worker_id),
+                         fleetview.snapshot_path(self.spool,
+                                                 w.worker_id)):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def _pick_victim(self) -> _Worker | None:
+        """Scale-down victim choice: ELASTIC slots only (a base slot
+        below min is never retired, whatever decide() counted as
+        live), spot class first (SIGKILL is routine for them), then
+        the youngest.  Refuses entirely when retiring would leave
+        fewer than min ALIVE workers — decide() counts crashed slots
+        pending restart as live (they are coming back), but the
+        fleet must not go dark through their backoff window."""
+        alive = [w for w in self._active_slots()
+                 if w is not self._cycling and w.alive]
+        if len(alive) <= self.autoscale_cfg.min_workers:
+            return None
+        candidates = [w for w in alive if w.elastic]
+        if not candidates:
+            return None
+        candidates.sort(key=lambda w: (
+            0 if w.worker_class == "spot" else 1,
+            -self.workers.index(w)))
+        return candidates[0]
+
+    def _autoscale_tick(self) -> None:
+        if self._as is None:
+            return
+        self._finalize_retiring()
+        if self.draining or self._cycling is not None:
+            return
+        cfg = self.autoscale_cfg
+        sig = self._as.read_signals(len(self._active_slots()))
+        decision = self._as.decide(sig)
+        if decision is None:
+            return
+        before = len(self._active_slots())
+        if decision.direction == "up":
+            spawned = 0
+            for _ in range(decision.n):
+                w = _Worker(f"w{self._next_wid}",
+                            worker_class=cfg.worker_class,
+                            elastic=True)
+                self._next_wid += 1
+                self.workers.append(w)
+                try:
+                    self._spawn(w, kind="scale_up")
+                except OSError as e:
+                    # a failed elastic spawn costs the slot, never
+                    # the controller: drop it and retry next trigger
+                    self.log.error("scale-up spawn of %s failed: %s",
+                                   w.worker_id, e)
+                    self.workers.remove(w)
+                    continue
+                spawned += 1
+            if not spawned:
+                return
+            telemetry.fleet_scale_total().inc(spawned,
+                                              direction="up")
+            if spawned != decision.n:
+                # journal what actually HAPPENED: a partial spawn
+                # (EAGAIN under the very load that triggered the
+                # scale-up) must not make the event's arithmetic lie
+                # to the scaling_bounded auditor
+                import dataclasses as _dc
+                decision = _dc.replace(decision, n=spawned)
+            ev = autoscale_mod.journal_scale_event(
+                self.spool, decision, cfg, before, before + spawned)
+            # cooldown armed from the JOURNAL timestamp, not the
+            # signal-read instant: the auditor measures gaps between
+            # journaled events, and spawns on a loaded host can take
+            # longer than any fixed audit slack
+            self._as.note_action((ev or {}).get("t"))
+            self.log.warning("scale UP %d -> %d worker(s): %s",
+                             before, before + spawned,
+                             decision.reason)
+            return
+        # ---- scale down: drain-or-preempt one victim
+        w = self._pick_victim()
+        if w is None:
+            return
+        spot = w.worker_class == "spot"
+        mode = "kill" if spot else "drain"
+        # ledger BEFORE the signal: by the instant the pid reads
+        # dead, every janitor already knows the death was elective —
+        # the ordering no_elastic_strike rests on
+        try:
+            protocol.record_elective_kill(self.spool, w.worker_id,
+                                          w.pid or 0)
+        except OSError as e:
+            # without the ledger a kill would charge the victim's
+            # beams a crash strike — skip this scale-down entirely
+            self.log.error("scale-down ledger write failed (%s); "
+                           "keeping %s", e, w.worker_id)
+            return
+        ev = autoscale_mod.journal_scale_event(
+            self.spool, decision, cfg, before, before - 1,
+            victims=[{"worker": w.worker_id, "pid": w.pid,
+                      "worker_class": w.worker_class,
+                      "mode": mode}])
+        try:
+            if spot:
+                # spot semantics: SIGKILL is routine — no drain, the
+                # janitor reclaims its claims attempt-neutrally and
+                # checkpoint resume salvages its durable passes
+                w.proc.kill()
+                self._retiring[w] = 0.0
+            else:
+                w.proc.send_signal(signal.SIGTERM)
+                self._retiring[w] = time.time() \
+                    + cfg.drain_deadline_s
+        except OSError:
+            self._retiring[w] = 0.0      # already dead: just retire
+        telemetry.fleet_scale_total().inc(direction="down")
+        self._as.note_action((ev or {}).get("t"))
+        self.log.warning("scale DOWN %d -> %d: %s %s (%s)",
+                         before, before - 1, mode, w.worker_id,
+                         decision.reason)
+
     # ---------------------------------------------------------- aggregate
 
     def _worker_state(self, w: _Worker) -> str:
@@ -331,6 +576,9 @@ class FleetController:
         # workers but a full queue (backpressure) — a dashboard must
         # be able to tell a down fleet from a busy one
         telemetry.fleet_capacity().set(-1 if cap is None else cap)
+        if self.autoscale_cfg is not None:
+            telemetry.fleet_autoscale_workers().set(
+                len(self._active_slots()))
         rec = {
             "t": time.time(),
             "controller_pid": os.getpid(),
@@ -339,11 +587,20 @@ class FleetController:
             "workers": [{
                 "id": w.worker_id, "pid": w.pid, "alive": w.alive,
                 "state": states[w.worker_id],
+                "class": w.worker_class,
+                "retiring": w in self._retiring,
                 "incarnation": w.incarnation,
                 "crash_restarts": w.crash_restarts,
                 "gave_up": w.gave_up, "last_rc": w.last_rc,
                 "heartbeat": heartbeats.get(w.worker_id),
             } for w in self.workers],
+            "autoscale": ({
+                "min": self.autoscale_cfg.min_workers,
+                "max": self.autoscale_cfg.max_workers,
+                "active": len(self._active_slots()),
+                "retiring": len(self._retiring),
+                "cooldown_s": self.autoscale_cfg.cooldown_s,
+            } if self.autoscale_cfg is not None else None),
             "external_workers": sorted(
                 wid for wid in heartbeats
                 if wid not in states and wid != ""),
@@ -396,6 +653,11 @@ class FleetController:
         self._reap()
         self._respawn_due()
         self._janitor()
+        if self._as is not None:
+            # a rolling restart must still reap retirees and
+            # escalate overdue drains; _autoscale_tick makes no new
+            # decisions while _cycling is set
+            self._finalize_retiring()
 
     def _rolling_restart(self) -> None:
         """Cycle workers ONE at a time so the fleet never goes fully
@@ -463,6 +725,7 @@ class FleetController:
                 self._reap()
                 self._respawn_due()
                 self._janitor()
+                self._autoscale_tick()
                 cmd = read_control(self.spool)
                 if cmd == "drain":
                     self.log.info("control file: drain")
@@ -516,6 +779,15 @@ class FleetController:
             w.last_rc = w.proc.returncode
             w.proc = None
             self._mark_worker_down(w)
+            # the drain exit belongs in the journal like every other
+            # incarnation end: worker-seconds accounting (the
+            # autoscale bench's cost-per-beam) pairs every
+            # worker_spawn with a worker_exit
+            journal.record(self.spool, "worker_exit",
+                           worker=w.worker_id, rc=w.last_rc,
+                           pid=w.pid, incarnation=w.incarnation,
+                           kind="drain")
+        self._retiring.clear()
         # one last janitor pass: claims the TERM'd workers requeued
         # themselves are fine, but a worker that died ignoring the
         # drain leaves orphans this controller should not strand
@@ -535,13 +807,15 @@ class FleetController:
 # ---------------------------------------------------------------- status
 
 def status_rc(spool: str,
-              max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S) -> int:
+              max_age_s: float | None = None) -> int:
     """Health exit code for ``tpulsar fleet --status`` (cron/CI
     scripting): 1 when a RUNNING controller's fleet.json has gone
     stale past the heartbeat grace — the controller died without
     stamping the fleet stopped.  0 otherwise: a fresh file, a
     deliberately stopped fleet, or no fleet.json at all (nothing to
     judge — workers may be launched externally)."""
+    if max_age_s is None:
+        max_age_s = protocol.heartbeat_max_age()
     rec = protocol._read_json(os.path.join(spool, FLEET_JSON))
     if rec is None or rec.get("status") == "stopped":
         return 0
@@ -549,10 +823,13 @@ def status_rc(spool: str,
 
 
 def render_status(spool: str,
-                  max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S
-                  ) -> str:
+                  max_age_s: float | None = None) -> str:
     """Human-readable fleet status from the spool's shared state (no
-    controller required: heartbeats + fleet.json are on disk)."""
+    controller required: heartbeats + fleet.json are on disk) —
+    including the autoscaler's decision trail, so the operator can
+    audit from the journal alone why the fleet is its current size."""
+    if max_age_s is None:
+        max_age_s = protocol.heartbeat_max_age()
     lines = [f"fleet spool: {spool}"]
     rec = protocol._read_json(os.path.join(spool, FLEET_JSON))
     if rec is not None:
@@ -576,7 +853,9 @@ def render_status(spool: str,
             beams = hb.get("beams") or {}
             lines.append(
                 f"  [{'fresh' if fresh else 'STALE'}] "
-                f"{wid or '(single server)'}: pid {hb.get('pid')} "
+                f"{wid or '(single server)'}"
+                f"{' (' + hb['worker_class'] + ')' if hb.get('worker_class') else ''}"
+                f": pid {hb.get('pid')} "
                 f"{hb.get('status', '?')}, heartbeat {age:.0f} s ago, "
                 f"depth {hb.get('queue_depth', '?')}/"
                 f"{hb.get('max_queue_depth', '?')}, beams "
@@ -592,4 +871,22 @@ def render_status(spool: str,
         f"done={protocol.state_count(spool, 'done')} "
         f"quarantined={protocol.state_count(spool, 'quarantine')}"
         f" capacity={'none (0 fresh workers)' if cap is None else cap}")
+    asc = (rec or {}).get("autoscale")
+    trail = autoscale_mod.decision_trail(spool)
+    if asc or trail:
+        head = "autoscaler"
+        if asc:
+            head += (f": {asc.get('active', '?')} active worker(s) "
+                     f"in [{asc.get('min', '?')}, "
+                     f"{asc.get('max', '?')}]"
+                     + (f", {asc['retiring']} retiring"
+                        if asc.get("retiring") else "")
+                     + f", cooldown {asc.get('cooldown_s', '?')} s")
+        lines.append(head)
+        if trail:
+            lines.append(f"last {len(trail)} scaling decision(s) "
+                         f"(journal):")
+            lines.extend(autoscale_mod.render_trail(trail))
+        else:
+            lines.append("  (no journaled scaling decisions yet)")
     return "\n".join(lines)

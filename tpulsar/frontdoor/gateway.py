@@ -88,7 +88,7 @@ class GatewayServer:
                  policy: tenancy.TenantPolicy | None = None,
                  host: str = "127.0.0.1", port: int = 0,
                  outdir_base: str | None = None,
-                 max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S,
+                 max_age_s: float | None = None,
                  default_depth: int = 8,
                  query_limit: int = 200,
                  retry_jitter_seed: int = 0, logger=None):

@@ -123,12 +123,12 @@ class TicketQueue:
         raise NotImplementedError
 
     def fresh_workers(
-            self, max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S
+            self, max_age_s: float | None = None
     ) -> dict[str, dict]:
         raise NotImplementedError
 
     def capacity(self,
-                 max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S,
+                 max_age_s: float | None = None,
                  default_depth: int = 8) -> int | None:
         """Remaining admission capacity; None = zero fresh workers
         (load-shed), 0 = fresh workers but a full queue
@@ -235,12 +235,10 @@ class FilesystemSpoolQueue(TicketQueue):
         protocol.write_heartbeat(self.spool, worker_id=worker_id,
                                  **fields)
 
-    def fresh_workers(self,
-                      max_age_s=protocol.HEARTBEAT_MAX_AGE_S):
+    def fresh_workers(self, max_age_s=None):
         return protocol.fresh_workers(self.spool, max_age_s)
 
-    def capacity(self, max_age_s=protocol.HEARTBEAT_MAX_AGE_S,
-                 default_depth=8):
+    def capacity(self, max_age_s=None, default_depth=8):
         # the short-TTL cached probe: this sits on every gateway
         # admission decision
         return protocol.fleet_capacity_cached(self.spool, max_age_s,
@@ -529,15 +527,13 @@ class MemoryTicketQueue(TicketQueue):
                 "t": time.time(), "pid": os.getpid(),
                 "worker": worker_id, **fields}
 
-    def fresh_workers(self,
-                      max_age_s=protocol.HEARTBEAT_MAX_AGE_S):
+    def fresh_workers(self, max_age_s=None):
         with self._lock:
             return {wid: dict(rec)
                     for wid, rec in self._heartbeats.items()
                     if protocol._hb_fresh(rec, max_age_s)}
 
-    def capacity(self, max_age_s=protocol.HEARTBEAT_MAX_AGE_S,
-                 default_depth=8):
+    def capacity(self, max_age_s=None, default_depth=8):
         fresh = self.fresh_workers(max_age_s)
         if not fresh:
             return None

@@ -203,8 +203,7 @@ def _strip_gauges(snap: dict) -> dict:
 
 def fleet_snapshot(spool: str,
                    extra_snapshots: tuple = (),
-                   max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S
-                   ) -> dict:
+                   max_age_s: float | None = None) -> dict:
     """The merged fleet-wide snapshot: every worker's exported
     registry + the journal SLO series + any caller-supplied
     snapshots (the controller passes its own registry).  A STALE
@@ -213,6 +212,8 @@ def fleet_snapshot(spool: str,
     the process) but NOT its gauges: a dead worker's point-in-time
     readings would otherwise haunt fleet.prom forever via the
     gauge-max merge rule."""
+    if max_age_s is None:
+        max_age_s = protocol.heartbeat_max_age()
     now = time.time()
     snaps = []
     for rec in worker_snapshots(spool).values():
@@ -243,10 +244,11 @@ def write_fleet_prom(spool: str, extra_snapshots: tuple = (),
 # ------------------------------------------------------------- ops top
 
 def render_top(spool: str,
-               max_age_s: float = protocol.HEARTBEAT_MAX_AGE_S
-               ) -> str:
+               max_age_s: float | None = None) -> str:
     """One refresh of ``tpulsar obs top``: live per-worker state,
     queue depths, spool counts, and the journal SLO gauges."""
+    if max_age_s is None:
+        max_age_s = protocol.heartbeat_max_age()
     now = time.time()
     lines = [f"fleet spool {spool}  "
              f"({time.strftime('%H:%M:%S', time.localtime(now))})"]

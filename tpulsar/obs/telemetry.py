@@ -298,6 +298,23 @@ def fleet_quarantined_total() -> metrics.Counter:
         "killing their worker (attempts reached the cap)")
 
 
+def fleet_scale_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_fleet_scale_total",
+        "autoscaler decisions executed, by direction (up = workers "
+        "added from journal-derived load signals, down = a victim "
+        "drained or — spot class — SIGKILLed; every decision is also "
+        "journaled as a scale_up/scale_down event with its signals)",
+        labelnames=("direction",))
+
+
+def fleet_autoscale_workers() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_fleet_autoscale_workers",
+        "the autoscaler's current active worker-slot count (within "
+        "configured [min, max]); absent when autoscaling is off")
+
+
 def fleet_capacity() -> metrics.Gauge:
     return metrics.gauge(
         "tpulsar_fleet_capacity",

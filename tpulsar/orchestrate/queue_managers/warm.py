@@ -36,14 +36,15 @@ from tpulsar.serve import protocol
 class WarmServerManager:
     def __init__(self, spool: str | None = None,
                  max_queue_depth: int = 8,
-                 heartbeat_max_age_s: float =
-                 protocol.HEARTBEAT_MAX_AGE_S,
+                 heartbeat_max_age_s: float | None = None,
                  fallback_kwargs: dict | None = None,
                  logger=None):
         if spool is None:
             spool = protocol.default_spool_dir()
         self.spool = protocol.ensure_spool(spool)
         self.max_queue_depth = max_queue_depth
+        # None = resolve config/env/default at CALL time via
+        # protocol.heartbeat_max_age() — the one staleness knob
         self.heartbeat_max_age_s = heartbeat_max_age_s
         self.fallback_kwargs = fallback_kwargs or {}
         self.log = logger or get_logger("warmq")

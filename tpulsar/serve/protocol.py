@@ -66,8 +66,46 @@ from tpulsar.resilience import faults
 
 #: heartbeats older than this are stale: the worker is gone (crashed,
 #: drained, or never started); with zero fresh workers clients must
-#: fall back to process-per-beam submission
+#: fall back to process-per-beam submission.  This is the BUILT-IN
+#: default only — every freshness judgment resolves the effective
+#: value through :func:`heartbeat_max_age` (config
+#: ``jobpooler.heartbeat_max_age_s`` via set_heartbeat_max_age, or
+#: the ``TPULSAR_HEARTBEAT_MAX_AGE_S`` env var), so the autoscaler's
+#: reaction time and the tests are knobs, not a module constant.
 HEARTBEAT_MAX_AGE_S = 120.0
+
+_heartbeat_max_age_override: float | None = None
+
+
+def set_heartbeat_max_age(seconds: float | None) -> None:
+    """Install the deployment's heartbeat staleness window (the CLI
+    calls this from config; ``None`` reverts to env/default
+    resolution).  Invalid values are rejected loudly — a zero or
+    negative window would declare every worker dead."""
+    global _heartbeat_max_age_override
+    if seconds is not None and seconds <= 0:
+        raise ValueError(
+            f"heartbeat max age must be positive, got {seconds!r}")
+    _heartbeat_max_age_override = seconds
+
+
+def heartbeat_max_age() -> float:
+    """The effective heartbeat staleness window: config override >
+    TPULSAR_HEARTBEAT_MAX_AGE_S env > the 120 s built-in.  Every
+    signature that used to bake HEARTBEAT_MAX_AGE_S in as a default
+    now resolves through here at CALL time, so one knob moves the
+    whole stack (freshness, capacity, janitor grace) together."""
+    if _heartbeat_max_age_override is not None:
+        return _heartbeat_max_age_override
+    env = os.environ.get("TPULSAR_HEARTBEAT_MAX_AGE_S", "")
+    if env:
+        try:
+            val = float(env)
+            if val > 0:
+                return val
+        except ValueError:
+            pass
+    return HEARTBEAT_MAX_AGE_S
 
 #: crash-shaped claims a ticket may accumulate before it is judged
 #: poisoned and quarantined (overridable per call / via
@@ -280,7 +318,8 @@ def inflight_by_tenant(spool: str) -> dict[str, int]:
 
 
 def claim_next_ticket(spool: str, worker_id: str = "",
-                      policy=None) -> dict | None:
+                      policy=None,
+                      worker_class: str = "") -> dict | None:
     """Atomically move the oldest incoming ticket to claimed/ and
     return its record (None when the queue is empty).  Rename is the
     claim: two workers on one spool cannot claim the same ticket.
@@ -318,7 +357,7 @@ def claim_next_ticket(spool: str, worker_id: str = "",
     co-claimer promoted in the meantime and raises ENOENT when the
     staging was stolen — a lost claim is abandoned, never
     fabricated."""
-    grace = ORPHAN_SIDEFILE_GRACE_S
+    grace = orphan_sidefile_grace()
 
     def _journal_claim(rec: dict) -> None:
         journal.record(
@@ -333,7 +372,12 @@ def claim_next_ticket(spool: str, worker_id: str = "",
             # can be reconstructed from the journal alone (the chaos
             # verifier's quota invariant)
             **({"tenant": rec["tenant"]} if rec.get("tenant")
-               else {}))
+               else {}),
+            # the worker CLASS rides it too: a spot worker's claims
+            # are expected to be SIGKILLed by the autoscaler, and the
+            # no_elastic_strike audit wants that context in-band
+            **({"worker_class": rec["claimed_by_class"]}
+               if rec.get("claimed_by_class") else {}))
 
     if policy is None or getattr(policy, "is_trivial", False):
         # a trivial policy (no tenants configured) IS FIFO: skip the
@@ -371,6 +415,10 @@ def claim_next_ticket(spool: str, worker_id: str = "",
         rec["claimed_by"] = os.getpid()
         if worker_id:
             rec["claimed_by_worker"] = worker_id
+        if worker_class:
+            # spot vs on-demand: elasticity context the requeue
+            # machinery and the journal audit read off the claim
+            rec["claimed_by_class"] = worker_class
         try:
             _atomic_write_json(staging, rec)
         except OSError:
@@ -455,13 +503,21 @@ def _pid_alive(pid) -> bool:
 #: a ``.takeover.<pid>`` / ``.claiming.<pid>`` file is held for
 #: milliseconds by a live process; one this old is abandoned even if
 #: its pid reads alive (pid recycled by an unrelated process) — the
-#: age fallback keeps a recycled pid from stranding a ticket forever
+#: age fallback keeps a recycled pid from stranding a ticket forever.
+#: The effective grace follows heartbeat_max_age() (one staleness
+#: knob for the whole stack) but never drops below this floor: a
+#: deployment tuning heartbeats to seconds for autoscaler reaction
+#: must not also shrink the stall-withdrawal window claims depend on.
 ORPHAN_SIDEFILE_GRACE_S = HEARTBEAT_MAX_AGE_S
+ORPHAN_SIDEFILE_GRACE_FLOOR_S = 30.0
+
+
+def orphan_sidefile_grace() -> float:
+    return max(ORPHAN_SIDEFILE_GRACE_FLOOR_S, heartbeat_max_age())
 
 
 def _sidefile_owner_live(path: str, pid,
-                         grace_s: float = ORPHAN_SIDEFILE_GRACE_S
-                         ) -> bool:
+                         grace_s: float | None = None) -> bool:
     """Does a transient claim side-file still belong to a live owner?
     Liveness is pid-alive AND recently renamed: past the grace window
     the pid must be a recycled one, because no healthy claim or
@@ -471,6 +527,8 @@ def _sidefile_owner_live(path: str, pid,
     preserves mtime and a ticket that waited minutes in incoming/
     (or a claim held through a long beam) would otherwise make a
     fresh side-file look ancient and steal-able."""
+    if grace_s is None:
+        grace_s = orphan_sidefile_grace()
     if not _pid_alive(pid):
         return False
     try:
@@ -502,7 +560,68 @@ def _strip_claim_stamps(rec: dict) -> dict:
     rec.pop("claimed_at", None)
     rec.pop("claimed_by", None)
     rec.pop("claimed_by_worker", None)
+    rec.pop("claimed_by_class", None)
     return rec
+
+
+# --------------------------------------------------- elective kills
+
+#: the autoscaler's kill ledger (<spool>/scale_downs.json): pids the
+#: controller killed ON PURPOSE while scaling down.  The journal's
+#: ``scale_down`` event is the audit evidence; this file is the
+#: hot-path index every janitor consults, so an elective victim's
+#: claims requeue attempt-neutrally (reason ``scale_down``) instead
+#: of charging a crash strike — elasticity must never advance a beam
+#: toward quarantine (the no_elastic_strike invariant).
+SCALEDOWN_FILE = "scale_downs.json"
+
+#: ledger entries older than this are pruned on write: the only
+#: window that matters is kill -> the claim's reclamation, which the
+#: janitor closes within seconds
+SCALEDOWN_TTL_S = 3600.0
+
+
+def scaledown_path(spool: str) -> str:
+    return os.path.join(spool, SCALEDOWN_FILE)
+
+
+def record_elective_kill(spool: str, worker_id: str, pid: int,
+                         reason: str = "scale_down") -> None:
+    """Record an autoscaler-initiated kill BEFORE the signal is sent
+    (the ordering the neutral requeue depends on: by the time the pid
+    reads dead, the ledger already names it elective).  Single
+    writer — the fleet controller — so read-modify-write is safe."""
+    now = time.time()
+    rec = _read_json(scaledown_path(spool)) or {}
+    kills = [k for k in rec.get("kills", ())
+             if now - k.get("t", 0.0) <= SCALEDOWN_TTL_S]
+    kills.append({"worker": worker_id, "pid": int(pid), "t": now,
+                  "reason": reason})
+    _atomic_write_json(scaledown_path(spool),
+                       {"kills": kills, "updated": now})
+
+
+def elective_kill_pids(spool: str) -> set[int]:
+    """Pids the autoscaler killed on purpose.  Tolerant: a
+    missing/torn ledger means no elective kills."""
+    rec = _read_json(scaledown_path(spool)) or {}
+    return {int(k["pid"]) for k in rec.get("kills", ())
+            if k.get("pid") is not None}
+
+
+def elective_kills(spool: str) -> set[tuple[str, int]]:
+    """(worker_id, pid) pairs from the scale-down ledger — what the
+    janitor's neutral verdict matches against.  The PAIR matters: a
+    pid alone can be recycled within the ledger's TTL (this codebase
+    already defends against that in _sidefile_owner_live), and a
+    recycled pid must not turn a genuine crash strike into a neutral
+    requeue and defeat quarantine.  Elastic worker ids are minted
+    from a monotone counter and never reused, so the pair uniquely
+    names one incarnation."""
+    rec = _read_json(scaledown_path(spool)) or {}
+    return {(str(k.get("worker", "")), int(k["pid"]))
+            for k in rec.get("kills", ())
+            if k.get("pid") is not None}
 
 
 def _ticket_exists_elsewhere(spool: str, ticket_id: str) -> bool:
@@ -713,13 +832,16 @@ def _requeue_claims(spool: str, verdict_fn,
     reconcile claims that already have a done record, judge the rest
     via ``verdict_fn(rec)`` (None = leave the claim alone, 'neutral'
     = requeue without a strike, 'strike' = crash-shaped requeue that
-    counts attempts and quarantines at the cap), take the claim file
-    over exclusively, and make the incoming/ record durable BEFORE
-    unlinking the takeover — the ordering a crashed requeuer depends
-    on to never lose a ticket.  Every requeue lands in the journal:
-    a strike as ``takeover`` (naming the dead owner — the crash
-    evidence the crashed worker could not write itself), a neutral
-    one as ``drain_requeue`` with ``neutral_reason``."""
+    counts attempts and quarantines at the cap; a ``('neutral',
+    reason)`` tuple overrides the journaled reason per ticket — how a
+    scale-down victim's claims are distinguished from drain requeues
+    within one janitor pass), take the claim file over exclusively,
+    and make the incoming/ record durable BEFORE unlinking the
+    takeover — the ordering a crashed requeuer depends on to never
+    lose a ticket.  Every requeue lands in the journal: a strike as
+    ``takeover`` (naming the dead owner — the crash evidence the
+    crashed worker could not write itself), a neutral one as
+    ``drain_requeue`` with its reason."""
     requeued = []
     for tid in list_tickets(spool, "claimed"):
         src = ticket_path(spool, tid, "claimed")
@@ -735,6 +857,9 @@ def _requeue_claims(spool: str, verdict_fn,
         verdict = verdict_fn(rec)
         if verdict is None:
             continue
+        reason = neutral_reason
+        if isinstance(verdict, tuple):
+            verdict, reason = verdict
         tmp = _takeover_claim(spool, tid)
         if tmp is None:
             continue            # another janitor beat us to it
@@ -797,7 +922,7 @@ def _requeue_claims(spool: str, verdict_fn,
                 worker=owner_worker,
                 attempt=int(rec.get("attempts", 0)),
                 trace_id=rec.get("trace_id", ""),
-                reason=neutral_reason)
+                reason=reason)
         requeued.append(tid)
     return requeued
 
@@ -815,14 +940,20 @@ def requeue_stale_claims(spool: str,
     of re-running the beam.
 
     Every dead-owner requeue is crash-shaped and increments the
-    ticket's ``attempts``; at ``max_attempts`` the beam is judged
-    poisoned and quarantined (see _quarantine) instead of requeued.
-    Returns the requeued ticket ids (quarantined ones are visible via
+    ticket's ``attempts`` — EXCEPT when the owner's death was an
+    autoscaler decision (its pid is in the scale-down ledger): an
+    elective preemption is priced into elasticity, not evidence the
+    beam is poisoned, so those claims requeue attempt-neutrally with
+    reason ``scale_down`` (the no_elastic_strike invariant).
+    At ``max_attempts`` the beam is judged poisoned and quarantined
+    (see _quarantine) instead of requeued.  Returns the requeued
+    ticket ids (quarantined ones are visible via
     ``list_tickets(spool, "quarantine")``)."""
     ensure_spool(spool)
     _recover_abandoned_takeovers(spool)
     _recover_abandoned_claimings(spool)
     me = os.getpid()
+    elective = elective_kills(spool)
 
     def verdict(rec):
         owner = rec.get("claimed_by")
@@ -830,6 +961,17 @@ def requeue_stale_claims(spool: str,
             return "neutral"    # our own claim (boot recovery)
         if owner is not None and _pid_alive(owner):
             return None         # a live co-worker owns this beam
+        try:
+            pair = (str(rec.get("claimed_by_worker", "")),
+                    int(owner))
+            if pair in elective:
+                # the autoscaler killed this owner on purpose: the
+                # beam did nothing wrong — no strike.  Matched on
+                # (worker, pid) so a recycled pid in some OTHER
+                # worker slot still strikes normally.
+                return ("neutral", "scale_down")
+        except (TypeError, ValueError):
+            pass
         return "strike"
     return _requeue_claims(spool, verdict, max_attempts,
                            neutral_reason="boot_recovery")
@@ -948,17 +1090,19 @@ def list_heartbeats(spool: str) -> dict[str, dict]:
 
 
 def _hb_fresh(rec: dict | None,
-              max_age_s: float = HEARTBEAT_MAX_AGE_S) -> bool:
+              max_age_s: float | None = None) -> bool:
     """A live worker wrote this heartbeat recently AND is not
     draining.  A draining worker still finishes its claimed beams but
     must receive no new work."""
+    if max_age_s is None:
+        max_age_s = heartbeat_max_age()
     if rec is None or rec.get("status") in ("draining", "stopped"):
         return False
     return (time.time() - rec.get("t", 0.0)) <= max_age_s
 
 
 def fresh_workers(spool: str,
-                  max_age_s: float = HEARTBEAT_MAX_AGE_S
+                  max_age_s: float | None = None
                   ) -> dict[str, dict]:
     """Heartbeats of workers currently accepting work."""
     return {wid: rec for wid, rec in list_heartbeats(spool).items()
@@ -966,20 +1110,22 @@ def fresh_workers(spool: str,
 
 
 def heartbeat_fresh(spool: str,
-                    max_age_s: float = HEARTBEAT_MAX_AGE_S) -> bool:
+                    max_age_s: float | None = None) -> bool:
     """True while ANY worker on the spool is accepting work — a fleet
     with one fresh worker of N still serves tickets."""
     return bool(fresh_workers(spool, max_age_s))
 
 
 def fleet_capacity(spool: str,
-                   max_age_s: float = HEARTBEAT_MAX_AGE_S,
+                   max_age_s: float | None = None,
                    default_depth: int = 8) -> int | None:
     """Aggregate remaining admission capacity: the sum of fresh
     workers' advertised queue depths minus the tickets already
     waiting.  Returns None when ZERO workers are fresh — the signal
     for clients to load-shed to process-per-beam submission (a full
     queue, by contrast, is backpressure: wait, don't shed)."""
+    if max_age_s is None:
+        max_age_s = heartbeat_max_age()
     fresh = fresh_workers(spool, max_age_s)
     if not fresh:
         return None
@@ -1007,7 +1153,7 @@ def _invalidate_capacity(spool: str) -> None:
 
 
 def fleet_capacity_cached(spool: str,
-                          max_age_s: float = HEARTBEAT_MAX_AGE_S,
+                          max_age_s: float | None = None,
                           default_depth: int = 8,
                           ttl_s: float = CAPACITY_PROBE_TTL_S
                           ) -> int | None:
@@ -1015,6 +1161,8 @@ def fleet_capacity_cached(spool: str,
     hot-loop spelling.  A cached entry is only served for the same
     (max_age_s, default_depth) question; ``ttl_s=0`` bypasses the
     cache entirely."""
+    if max_age_s is None:
+        max_age_s = heartbeat_max_age()
     now = time.time()
     hit = _capacity_cache.get(spool)
     if hit is not None and hit[0] > now and hit[1] == max_age_s \

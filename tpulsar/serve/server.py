@@ -55,6 +55,7 @@ from tpulsar.serve.stagein import PreparedBeam, StageInPipeline
 class SearchServer:
     def __init__(self, spool: str | None = None, cfg=None, *,
                  worker_id: str = "",
+                 worker_class: str = "",
                  max_queue_depth: int = 8,
                  beam_deadline_s: float = 0.0,
                  ticket_max_attempts: int = protocol.DEFAULT_MAX_ATTEMPTS,
@@ -78,6 +79,12 @@ class SearchServer:
         self.cfg = cfg
         self.spool = spool or protocol.default_spool_dir(cfg)
         self.worker_id = worker_id
+        #: "spot" workers advertise that an autoscaler SIGKILL is
+        #: routine for them: the class rides the heartbeat, every
+        #: claim, and every result — no behavioural difference inside
+        #: the worker itself (checkpoint resume + the scale-down
+        #: ledger's attempt-neutral requeue carry the semantics)
+        self.worker_class = worker_class
         self.max_queue_depth = max_queue_depth
         self.ticket_max_attempts = ticket_max_attempts
         self.beam_deadline_s = beam_deadline_s
@@ -96,7 +103,8 @@ class SearchServer:
         self.pipeline = StageInPipeline(
             claim=lambda: protocol.claim_next_ticket(
                 self.spool, self.worker_id,
-                policy=self.claim_policy),
+                policy=self.claim_policy,
+                worker_class=self.worker_class),
             workdir_base=cfg.processing.base_working_directory,
             cfg=cfg, depth=prefetch_depth, poll_s=poll_s,
             logger=self.log, journal=self._journal)
@@ -180,7 +188,9 @@ class SearchServer:
         protocol.write_heartbeat(
             self.spool, worker_id=self.worker_id, status=status,
             queue_depth=depth, max_queue_depth=self.max_queue_depth,
-            beams=dict(self.beams), started_at=self.started_at)
+            beams=dict(self.beams), started_at=self.started_at,
+            **({"worker_class": self.worker_class}
+               if self.worker_class else {}))
         # every heartbeat also drops this worker's registry snapshot
         # into the spool, so the fleet aggregator can merge ALL
         # workers' metrics without attaching to any process
@@ -404,7 +414,9 @@ class SearchServer:
                     self.spool, tid, status,
                     rc=0 if status in ("done", "skipped") else 1,
                     error=error, beam_seconds=dt, warm=warm,
-                    outdir=outdir, worker=self.worker_id, **extra)
+                    outdir=outdir, worker=self.worker_id,
+                    **({"worker_class": self.worker_class}
+                       if self.worker_class else {}), **extra)
                 break
             except OSError as e:
                 if io_try == 2:
