@@ -1,0 +1,266 @@
+"""The contract linter's mutation suite.
+
+Each of the six checkers is proven LIVE: a minimal fixture seeding
+exactly its violation class must produce the named checker's finding
+at the right path:line.  A checker that silently stopped firing
+would otherwise keep CI green while the contract it guards drifts —
+the linter is itself regression-gated here (and again in CI's lint
+job, which seeds a mutation into a copy of the real tree).
+
+Fixtures are tiny synthetic roots under tmp_path: the checkers'
+per-site passes are purely syntactic (catalogs come from the
+installed package), and their cross-file coverage judgments are
+gated on the audited artifact existing under the lint root, so a
+one-file fixture yields exactly the seeded finding and no coverage
+noise.
+"""
+
+import json
+import os
+import textwrap
+
+import tpulsar
+from tpulsar.analysis import render_json, run_lint
+from tpulsar.analysis.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(tpulsar.__file__)))
+
+
+def _write(root, relpath, text):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(textwrap.dedent(text))
+    return relpath
+
+
+def _findings(root, checker=None):
+    out = run_lint(str(root), checker_ids=[checker] if checker
+                   else None)
+    return [(f.path, f.line, f.message) for f in out]
+
+
+# ------------------------------------------------------ 1. fault-points
+
+def test_unknown_fault_point_fires_at_line(tmp_path):
+    rel = _write(tmp_path, "bad.py", """\
+        from tpulsar.resilience import faults
+        faults.fire("not.a.point")
+    """)
+    found = _findings(tmp_path, "fault-points")
+    assert found and found[0][0] == rel and found[0][1] == 2
+    assert "not.a.point" in found[0][2]
+
+
+def test_known_fault_point_is_clean(tmp_path):
+    _write(tmp_path, "ok.py", """\
+        from tpulsar.resilience import faults
+        faults.fire("spool.io")
+        faults.targets("journal.append")
+        faults.targets_prefix("accel.")
+    """)
+    assert _findings(tmp_path, "fault-points") == []
+
+
+# ---------------------------------------------------------- 2. metrics
+
+def test_adhoc_metric_constructor_fires(tmp_path):
+    rel = _write(tmp_path, "bad.py", """\
+        from tpulsar.obs import metrics
+        c = metrics.counter("tpulsar_bogus_total", "nope")
+    """)
+    found = _findings(tmp_path, "metrics")
+    assert [(rel, 2)] == [(p, ln) for p, ln, _ in found]
+    assert "tpulsar_bogus_total" in found[0][2]
+    assert "ad-hoc" in found[0][2]
+
+
+def test_catalog_metric_constructed_elsewhere_fires(tmp_path):
+    # even a CORRECT name is a violation outside the catalog: two
+    # constructors for one instrument can drift in labels/buckets
+    _write(tmp_path, "bad.py", """\
+        from tpulsar.obs import metrics
+        c = metrics.counter("tpulsar_passes_total", "dup")
+    """)
+    found = _findings(tmp_path, "metrics")
+    assert found and "outside the telemetry catalog" in found[0][2]
+
+
+# --------------------------------------------------- 3. journal events
+
+def test_unjournaled_event_literal_fires(tmp_path):
+    rel = _write(tmp_path, "bad.py", """\
+        from tpulsar.obs import journal
+        journal.record("/spool", "weird_event", ticket="t1")
+    """)
+    found = _findings(tmp_path, "journal-events")
+    assert found and (found[0][0], found[0][1]) == (rel, 2)
+    assert "weird_event" in found[0][2]
+
+
+def test_verifier_comparison_against_unknown_event_fires(tmp_path):
+    # consumer-side coverage (scoped to the package tree): a verifier
+    # comparing against an unknown event name is audit blindness
+    rel = _write(tmp_path, "tpulsar/chaos/aud.py", """\
+        def check(events):
+            names = [e.get("event") for e in events]
+            return names.count("weird_event")
+    """)
+    found = _findings(tmp_path, "journal-events")
+    assert found and found[0][0] == rel
+    assert "weird_event" in found[0][2]
+
+
+def test_vocabulary_events_are_clean(tmp_path):
+    _write(tmp_path, "tpulsar/chaos/ok.py", """\
+        from tpulsar.obs import journal
+        def check(spool, events):
+            journal.record(spool, "takeover", ticket="t")
+            name = events[0].get("event")
+            return name in ("scale_up", "scale_down")
+    """)
+    assert _findings(tmp_path, "journal-events") == []
+
+
+# ------------------------------------------------------- 4. env knobs
+
+def test_undeclared_env_knob_fires(tmp_path):
+    rel = _write(tmp_path, "tpulsar/kernels/bad.py", """\
+        import os
+        v = os.environ.get("TPULSAR_BOGUS_KNOB", "0")
+        w = os.getenv("TPULSAR_BOGUS_TOO")
+        x = os.environ["TPULSAR_BOGUS_SUB"]
+        y = "TPULSAR_BOGUS_IN" in os.environ
+    """)
+    found = _findings(tmp_path, "env-knobs")
+    assert [(p, ln) for p, ln, _ in found] == [
+        (rel, 2), (rel, 3), (rel, 4), (rel, 5)]
+
+
+def test_declared_knob_and_out_of_scope_read_are_clean(tmp_path):
+    _write(tmp_path, "tpulsar/obs/ok.py", """\
+        import os
+        v = os.environ.get("TPULSAR_TRACE", "")
+    """)
+    # bench/tools harness knobs are out of the registry's scope
+    _write(tmp_path, "tools/harness.py", """\
+        import os
+        v = os.environ.get("TPULSAR_BENCH_SCALE", "1")
+    """)
+    assert _findings(tmp_path, "env-knobs") == []
+
+
+# ------------------------------------------------- 5. spool discipline
+
+_BARE_WRITE = """\
+    import json, os
+    def stash(rec, path):
+        with open(path, "w") as fh:
+            json.dump(rec, fh)
+        os.replace(path, path + ".final")
+"""
+
+
+def test_bare_spool_write_fires_per_call(tmp_path):
+    rel = _write(tmp_path, "tpulsar/serve/bad.py", _BARE_WRITE)
+    found = _findings(tmp_path, "spool-write")
+    assert [(p, ln) for p, ln, _ in found] == [
+        (rel, 3), (rel, 4), (rel, 5)]
+
+
+def test_spool_write_out_of_scope_and_blessed_are_clean(tmp_path):
+    # same code outside the spool packages: not this checker's business
+    _write(tmp_path, "tpulsar/io/ok.py", _BARE_WRITE)
+    # and inside a blessed discipline module: it IS the mechanism
+    _write(tmp_path, "tpulsar/serve/protocol.py", _BARE_WRITE)
+    assert _findings(tmp_path, "spool-write") == []
+
+
+# ------------------------------------------------------ 6. bench keys
+
+def test_dangling_bench_gate_key_fires(tmp_path):
+    _write(tmp_path, "tools/bench_gate.py", """\
+        DEFAULT_KEYS = (
+            ("serve.ok_key", "lower"),
+            ("serve.dangling_key", "higher"),
+        )
+    """)
+    with open(os.path.join(str(tmp_path), "BENCH_t.json"),
+              "w") as fh:
+        json.dump({"serve": {"ok_key": 1.5}}, fh)
+    found = _findings(tmp_path, "bench-keys")
+    assert len(found) == 1
+    assert found[0][0] == "tools/bench_gate.py"
+    assert "serve.dangling_key" in found[0][2]
+    assert "serve.ok_key" not in found[0][2]
+
+
+# ------------------------------------------------ suppression + output
+
+def test_suppression_comment_same_and_previous_line(tmp_path):
+    _write(tmp_path, "tpulsar/serve/ok.py", """\
+        import os
+        def swap(a, b):
+            os.rename(a, b)   # tpulsar: lint-ok[spool-write]
+            # tpulsar: lint-ok[spool-write]
+            os.replace(a, b)
+    """)
+    assert _findings(tmp_path, "spool-write") == []
+
+
+def test_suppression_is_checker_scoped(tmp_path):
+    # a comment naming ANOTHER checker must not silence this one
+    rel = _write(tmp_path, "tpulsar/serve/bad.py", """\
+        import os
+        def swap(a, b):
+            os.rename(a, b)   # tpulsar: lint-ok[env-knobs]
+    """)
+    found = _findings(tmp_path, "spool-write")
+    assert found and found[0][0] == rel
+
+
+def test_json_schema(tmp_path):
+    _write(tmp_path, "bad.py", """\
+        from tpulsar.resilience import faults
+        faults.fire("not.a.point")
+    """)
+    doc = json.loads(render_json(run_lint(str(tmp_path))))
+    assert doc["schema"] == "tpulsar-lint/v1"
+    assert doc["ok"] is False
+    assert doc["counts"] == {"fault-points": 1}
+    (f,) = doc["findings"]
+    assert set(f) == {"checker", "path", "line", "message", "hint"}
+    assert f["checker"] == "fault-points" and f["line"] == 2
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert lint_main(["--root", str(tmp_path)]) == 0
+    _write(tmp_path, "bad.py", 'import os\nos.rename\n')
+    _write(tmp_path, "worse.py", """\
+        from tpulsar.resilience import faults
+        faults.fired("nope.point")
+    """)
+    assert lint_main(["--root", str(tmp_path)]) == 1
+    assert lint_main(["--root", str(tmp_path),
+                      "--checker", "no-such-checker"]) == 2
+    capsys.readouterr()
+
+
+def test_unparseable_file_is_a_finding_not_a_crash(tmp_path):
+    rel = _write(tmp_path, "broken.py", "def nope(:\n")
+    found = _findings(tmp_path)
+    assert found and found[0][0] == rel
+    assert found[0][2].startswith("cannot parse")
+
+
+# ------------------------------------------------- the committed tree
+
+def test_committed_tree_is_clean():
+    """THE acceptance gate, as a test: `tpulsar lint` exits 0 on the
+    repo itself.  Any catalog/docs/discipline drift introduced by a
+    change lands here (and in CI's lint job) with the checker id and
+    the exact path:line."""
+    findings = run_lint(REPO_ROOT)
+    assert findings == [], "\n".join(
+        f.render() for f in findings)

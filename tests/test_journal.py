@@ -511,6 +511,26 @@ def test_bench_gate_per_key_tolerance_and_direction(bench_records,
     capsys.readouterr()
 
 
+def test_bench_gate_explicit_key_missing_from_baseline_is_rc2(
+        bench_records, capsys):
+    """An operator-named --key the baseline cannot resolve is
+    unusable input: exit 2 naming the key, never a silent skip (and
+    never a KeyError traceback).  DEFAULT_KEYS stay additive-schema
+    skips — the lint bench-keys checker guards those at commit
+    time."""
+    bg = _load_tool("bench_gate")
+    base, cand, write = bench_records
+    b, c = write(cand)
+    assert bg.main([b, c, "--key",
+                    "serve.no_such_key:lower:0.5"]) == 2
+    err = capsys.readouterr().err
+    assert "serve.no_such_key" in err
+    # the same key present in the baseline gates normally
+    assert bg.main([b, c, "--key",
+                    "serve.warm_steady_state_s:lower:0.5"]) == 0
+    capsys.readouterr()
+
+
 def test_bench_gate_tol_only_override_keeps_direction(tmp_path,
                                                       capsys):
     """`--key <higher-is-better-key>:0.2` (tolerance only) must keep

@@ -52,7 +52,11 @@ _HIGHER_UNITS = ("beams/s", "trials/s", "/s", "x", "ratio")
 DEFAULT_KEYS = (
     ("serve.warm_steady_state_s", "lower"),
     ("serve.cold_first_beam_s", "lower"),
-    ("serve.warm_vs_cold_process_speedup", "higher"),
+    # serve.warm_vs_cold_process_speedup is deliberately absent: no
+    # committed baseline carries it (the smoke baseline runs with
+    # TPULSAR_SERVE_COLD=0, cold_process_beam_s null), and the lint
+    # bench-keys checker fails any DEFAULT_KEYS row that resolves in
+    # no baseline — re-add it together with a baseline that has it
     ("fleet.speedup_vs_one_worker_warm", "higher"),
     ("fleet.two_worker.aggregate_warm_beams_per_s", "higher"),
     ("fleet.scaling_efficiency_vs_host_ceiling", "higher"),
@@ -201,6 +205,21 @@ def main(argv=None) -> int:
         return 2
 
     extra = [_parse_key_spec(s) for s in args.key]
+    # an EXPLICITLY requested key that the baseline cannot resolve is
+    # unusable input, not a skippable gap: the operator named the key,
+    # so a typo'd path (or a baseline from before the key existed)
+    # must fail loudly with the key's name instead of silently gating
+    # nothing.  DEFAULT_KEYS stay additive-schema skips — an old
+    # baseline simply gates fewer keys (the lint bench-keys checker
+    # guards those against going dead repo-wide at commit time).
+    missing = [path for path, _, _ in extra
+               if lookup(base, path) is None]
+    if missing:
+        for path in missing:
+            print(f"bench_gate: --key {path!r} does not resolve to "
+                  f"a number in baseline {args.baseline}",
+                  file=sys.stderr)
+        return 2
     result = compare(base, cand, gate_keys(base, cand, extra),
                      args.default_tol)
     result["metric"] = base.get("metric")

@@ -1,0 +1,45 @@
+"""Static contract analysis: the repo's hand-maintained contracts,
+machine-checked at commit time.
+
+The reliability story (fault injection, chaos invariants, the ticket
+journal, the telemetry catalog, the bench regression gate) rests on
+catalogs and disciplines that used to be enforced only at runtime or
+by one-off tests: a new ``metrics.Counter`` outside the telemetry
+catalog, a journal event the invariant verifier has never heard of,
+an undeclared ``TPULSAR_*`` env knob, or a bare ``json.dump`` onto a
+spool path would ship silently — and the chaos oracle goes blind to
+exactly the failure class it exists to catch.  ``tpulsar lint`` walks
+the tree with stdlib-``ast`` visitors and fails the commit instead.
+
+Checkers (``tpulsar lint --checker <id>`` runs a subset):
+
+  fault-points    every literal passed to the faults layer is in
+                  ``resilience.faults.FAULT_POINTS``; every catalog
+                  point is fired somewhere and has a docs table row
+  metrics         every metric constructor resolves to the
+                  ``obs/telemetry.py`` instrument catalog; the
+                  docs/operations.md metric table matches it both ways
+  journal-events  every journal ``record()`` literal and every
+                  verifier event comparison is in the exported
+                  ``obs.journal.EVENTS`` vocabulary, and every
+                  vocabulary entry has a docs table row
+  env-knobs       every ``os.environ``/``os.getenv`` read of a
+                  ``TPULSAR_*`` name inside the package is declared
+                  in ``config.knobs.KNOBS`` (which also renders the
+                  docs/configuration.md table)
+  spool-write     inside serve/fleet/frontdoor/chaos/checkpoint, raw
+                  ``open(.., "w")``/``json.dump``/``os.rename``/
+                  ``os.replace`` must route through the blessed
+                  atomic-write/two-rename helpers
+  bench-keys      every ``tools/bench_gate.py`` ``DEFAULT_KEYS`` path
+                  resolves in at least one committed BENCH_*.json
+
+A justified exception carries ``# tpulsar: lint-ok[<checker>]`` on
+(or one line above) the flagged line.  Exit codes: 0 clean, 1
+findings, 2 internal error.  stdlib only — the lint CI job needs no
+jax, no numpy.
+"""
+
+from tpulsar.analysis.core import (Finding, run_lint, render_text,
+                                   render_json)   # noqa: F401
+from tpulsar.analysis.checkers import CHECKERS    # noqa: F401

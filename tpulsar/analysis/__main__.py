@@ -1,0 +1,10 @@
+"""``python -m tpulsar.analysis`` — the lint entry point CI uses
+(jax-free; ``tpulsar lint`` is the same code behind the operator
+CLI)."""
+
+import sys
+
+from tpulsar.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""The ``tpulsar lint`` command: run the contract checkers, render
+findings, map the verdict to an exit code.
+
+Exit codes (the CI contract):
+  0  clean — every selected checker passed
+  1  findings — at least one contract violation
+  2  internal error — the linter itself failed (bad --checker id,
+     unreadable root, a crashed checker); never silently green
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def add_arguments(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--root", default=".",
+                    help="tree to lint (default: the current "
+                         "checkout)")
+    ap.add_argument("--checker", action="append", default=[],
+                    metavar="ID",
+                    help="run only this checker (repeatable); "
+                         "default: all six")
+    ap.add_argument("--list", action="store_true",
+                    help="list checker ids and contracts, then exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the findings as one JSON document "
+                         "(schema tpulsar-lint/v1)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulsar lint", description=__doc__.splitlines()[0])
+    add_arguments(ap)
+    return run(ap.parse_args(argv))
+
+
+def run(args) -> int:
+    from tpulsar.analysis import (CHECKERS, render_json, render_text,
+                                  run_lint)
+
+    if args.list:
+        for c in CHECKERS:
+            print(f"{c.id:16s} {c.doc}")
+        return 0
+    try:
+        findings = run_lint(args.root,
+                            checker_ids=args.checker or None)
+    except Exception as e:     # noqa: BLE001 — rc 2 is the contract
+        print(f"tpulsar lint: internal error: "
+              f"{e.__class__.__name__}: {e}", file=sys.stderr)
+        return 2
+    n_run = (len(set(args.checker)) if args.checker
+             else len(CHECKERS))
+    print(render_json(findings) if args.json
+          else render_text(findings, checkers_run=n_run))
+    return 1 if findings else 0

@@ -1,0 +1,222 @@
+"""The checker framework: file walker, AST contexts, findings,
+suppressions.
+
+Checkers are two-phase: ``visit(ctx)`` runs once per walked file
+(local, line-anchored findings), ``finalize(repo)`` once at the end
+(cross-file coverage: "every catalog entry is used somewhere",
+"the docs table matches").  Each coverage judgment gates itself on
+the specific artifact it audits existing under the lint root (the
+real ``faults.py``, a docs file, the knob registry) — a test fixture
+holding one offending file gets per-site findings without spurious
+"nothing fires fault point X" noise.
+
+Suppression is per line and per checker: ``# tpulsar:
+lint-ok[<checker-id>]`` on the flagged line or the line directly
+above it silences that checker there (and documents the exception in
+place — the comment IS the justification's anchor).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+#: the suppression comment: ``# tpulsar: lint-ok[spool-write]``
+_SUPPRESS_RE = re.compile(r"tpulsar:\s*lint-ok\[([a-z0-9_\-, ]+)\]")
+
+#: walked under the lint root (tests/ is excluded on purpose: tests
+#: seed violations deliberately; the mutation suite proves the
+#: checkers fire on them)
+_WALK_DIRS = ("tpulsar", "tools")
+_WALK_FILES = ("bench.py",)
+_SKIP_PARTS = ("__pycache__", "tests")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint violation: where, which contract, what to do."""
+    checker: str
+    path: str          # lint-root-relative
+    line: int
+    message: str
+    hint: str = ""
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        out = f"{loc}: [{self.checker}] {self.message}"
+        if self.hint:
+            out += f"\n{'':4s}hint: {self.hint}"
+        return out
+
+
+class FileCtx:
+    """One walked file: source, AST, and per-line suppressions."""
+
+    def __init__(self, relpath: str, source: str):
+        self.path = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        #: line number -> set of suppressed checker ids
+        self.suppress: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",")}
+                self.suppress[i] = ids
+
+    def suppressed(self, checker: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            ids = self.suppress.get(ln)
+            if ids and (checker in ids or "*" in ids):
+                return True
+        return False
+
+
+class Repo:
+    """The lint root plus cached doc/file access for finalize()."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._docs: dict[str, str | None] = {}
+
+    def doc_text(self, relpath: str) -> str | None:
+        if relpath not in self._docs:
+            try:
+                with open(os.path.join(self.root, relpath)) as fh:
+                    self._docs[relpath] = fh.read()
+            except OSError:
+                self._docs[relpath] = None
+        return self._docs[relpath]
+
+    def doc_table_names(self, relpath: str, pattern: str) -> set[str]:
+        """Backticked names matching ``pattern`` that appear in a
+        markdown table row (a line starting with ``|``) of the doc."""
+        text = self.doc_text(relpath)
+        out: set[str] = set()
+        if text is None:
+            return out
+        rx = re.compile(r"`(" + pattern + r")[`{]")
+        for line in text.splitlines():
+            if line.lstrip().startswith("|"):
+                for m in rx.finditer(line):
+                    out.add(m.group(1))
+        return out
+
+
+class Checker:
+    """Base checker: subclasses set ``id``/``doc`` and override
+    ``visit`` and/or ``finalize``."""
+
+    id = "base"
+    doc = ""
+
+    def visit(self, ctx: FileCtx):
+        return ()
+
+    def finalize(self, repo: Repo):
+        return ()
+
+
+def walk_files(root: str):
+    """Lint-root-relative paths of every Python file in scope."""
+    out: list[str] = []
+    for fn in _WALK_FILES:
+        if os.path.isfile(os.path.join(root, fn)):
+            out.append(fn)
+    for d in _WALK_DIRS:
+        top = os.path.join(root, d)
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [n for n in sorted(dirnames)
+                           if n not in _SKIP_PARTS]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    rel = os.path.relpath(
+                        os.path.join(dirpath, name), root)
+                    out.append(rel)
+    # a bare fixture dir (tests) may hold loose .py files outside the
+    # package layout — walk those too so one-file fixtures lint
+    if not os.path.isdir(os.path.join(root, "tpulsar")):
+        for name in sorted(os.listdir(root)):
+            if name.endswith(".py") and name not in out:
+                out.append(name)
+    return out
+
+
+def run_lint(root: str, checker_ids: list[str] | None = None
+             ) -> list[Finding]:
+    """Run the (selected) checkers over ``root``; returns findings
+    with suppressions already applied.  Raises on internal errors
+    (the CLI maps those to rc 2); an unparseable walked file is a
+    finding, not a crash."""
+    from tpulsar.analysis.checkers import CHECKERS
+
+    checkers = [c() for c in CHECKERS
+                if checker_ids is None or c.id in checker_ids]
+    if checker_ids is not None:
+        known = {c.id for c in CHECKERS}
+        bad = [i for i in checker_ids if i not in known]
+        if bad:
+            raise ValueError(
+                f"unknown checker id(s) {bad}; known: "
+                f"{sorted(known)}")
+    repo = Repo(root)
+    findings: list[Finding] = []
+    for rel in walk_files(repo.root):
+        try:
+            with open(os.path.join(repo.root, rel),
+                      encoding="utf-8") as fh:
+                ctx = FileCtx(rel, fh.read())
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding(
+                "parse", rel, getattr(e, "lineno", 0) or 0,
+                f"cannot parse: {e}"))
+            continue
+        for checker in checkers:
+            for f in checker.visit(ctx):
+                if not ctx.suppressed(f.checker, f.line):
+                    findings.append(f)
+    for checker in checkers:
+        findings.extend(checker.finalize(repo))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings
+
+
+def render_text(findings: list[Finding],
+                checkers_run: int | None = None) -> str:
+    """``checkers_run`` is how many checkers actually executed — a
+    ``--checker``-restricted run must not claim all six passed."""
+    from tpulsar.analysis.checkers import CHECKERS
+
+    if checkers_run is None:
+        checkers_run = len(CHECKERS)
+    lines = [f.render() for f in findings]
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.checker] = counts.get(f.checker, 0) + 1
+    lines.append(
+        f"tpulsar lint: {len(findings)} finding(s) across "
+        f"{len(counts)} checker(s)" if findings else
+        f"tpulsar lint: clean ({checkers_run} of {len(CHECKERS)} "
+        f"checkers run)")
+    for cid, n in sorted(counts.items()):
+        lines.append(f"  {cid}: {n}")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.checker] = counts.get(f.checker, 0) + 1
+    return json.dumps(
+        {"schema": "tpulsar-lint/v1",
+         "ok": not findings,
+         "counts": counts,
+         "findings": [f.as_dict() for f in findings]},
+        indent=1, sort_keys=True)

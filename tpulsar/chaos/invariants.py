@@ -129,9 +129,14 @@ INVARIANTS = {
         "journaled scale-down victim's pid as the dead owner",
 }
 
-#: events that RELEASE a claim (close an inflight interval)
+#: events that RELEASE a claim (close an inflight interval) — drawn
+#: from the journal's exported vocabulary; every event literal this
+#: auditor compares is machine-checked against ``journal.EVENTS`` by
+#: the contract linter (``tpulsar lint --checker journal-events``),
+#: so a new event type cannot ship without verifier awareness
 _RELEASES = ("takeover", "drain_requeue", "quarantined",
              journal.TERMINAL_EVENT)
+assert set(_RELEASES) <= set(journal.EVENTS)
 
 
 def _v(invariant: str, ticket: str = "", detail: str = "") -> dict:

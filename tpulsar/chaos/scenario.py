@@ -281,10 +281,12 @@ def write_schedule(spool: str, sc: Scenario, t0: float,
             entry["until"] = a.until
         entries.append(entry)
     path = schedule_path(spool)
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as fh:
-        json.dump({"version": 1, "t0": t0, "seed": sc.seed,
-                   "scenario": sc.name, "entries": entries}, fh,
-                  indent=1)
-    os.replace(tmp, path)
+    # the blessed atomic write (same helper the runner's manifest
+    # uses): a worker's faults poller must never observe a torn
+    # schedule, and the conductor process is not itself armed, so
+    # the helper's spool.io fault point cannot sever the storm
+    from tpulsar.serve import protocol
+    protocol._atomic_write_json(
+        path, {"version": 1, "t0": t0, "seed": sc.seed,
+               "scenario": sc.name, "entries": entries})
     return path

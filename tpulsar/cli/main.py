@@ -966,6 +966,11 @@ def cmd_checkpoint(args):
     return 0 if report["ok"] else 1
 
 
+def cmd_lint(args):
+    from tpulsar.analysis import cli as lint_cli
+    return lint_cli.run(args)
+
+
 def cmd_search(args):
     from tpulsar.cli import search_job
     argv = list(args.files) + ["--outdir", args.outdir]
@@ -1565,6 +1570,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap = asub.add_parser("ls", help="list the program registry, "
                                     "exemptions, and manifest state")
     ap.set_defaults(fn=cmd_aot)
+
+    sp = sub.add_parser(
+        "lint",
+        help="static contract linter: prove the fault-point / "
+             "metric / journal-event / env-knob catalogs, the "
+             "spool-write discipline, and the bench-gate keys have "
+             "not drifted (rc 0 clean / 1 findings / 2 internal "
+             "error; jax-free)")
+    from tpulsar.analysis.cli import add_arguments as _lint_args
+    _lint_args(sp)
+    sp.set_defaults(fn=cmd_lint)
     return p
 
 

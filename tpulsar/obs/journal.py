@@ -96,10 +96,63 @@ class JournalCorrupt(OSError):
 #: the spool volume, and readers merge both generations
 MAX_BYTES = 64 << 20
 
+#: the journal event vocabulary — THE exported contract between the
+#: writers (serve/protocol, serve/server, fleet, frontdoor, chaos)
+#: and the readers (validate_chain below, chaos/invariants.py, the
+#: ops console, docs/operations.md).  One entry per event name with a
+#: one-line meaning; the static contract linter (``tpulsar lint
+#: --checker journal-events``) fails any ``record()`` call or
+#: verifier comparison whose literal is missing here, so a new event
+#: type cannot ship without the verifier and the docs knowing it.
+EVENTS = {
+    "received": "gateway-edge chain head: HTTP submission accepted "
+                "(trace id minted at the edge; tenant recorded)",
+    "submitted": "client wrote the ticket into incoming/ (mints the "
+                 "trace id unless a gateway already did)",
+    "submit_failed": "the incoming/ write behind 'submitted' failed: "
+                     "the submission was cleanly refused, chain ends",
+    "claimed": "a worker won the claim rename (pid, queue_wait_s)",
+    "stagein_done": "the prefetch thread staged the beam's inputs",
+    "stagein_failed": "stage-in error (first error line)",
+    "search_start": "device work began (worker, attempt)",
+    "resume": "the claimed beam restarted from checkpointed "
+              "artifacts (passes_done, salvaged_s where known)",
+    "pass_complete": "one checkpoint artifact (a DDplan pass) is "
+                     "durable + manifested (pass_idx/npasses)",
+    "checkpoint_invalid": "a corrupt/torn/mismatched checkpoint "
+                          "entry was discarded and recomputed "
+                          "(scope entry | manifest, key, reason)",
+    "checkpoint_disabled": "ENOSPC/EROFS disabled checkpointing for "
+                           "the rest of the beam",
+    "checkpoint_write_failed": "a transient (non-disabling) "
+                               "checkpoint artifact write failed: "
+                               "that one artifact is skipped and "
+                               "recomputed on resume (key, errno)",
+    "result": "TERMINAL: the durable done/ record landed (status, "
+              "rc, worker, attempt)",
+    "takeover": "a janitor stole the claim from a DEAD owner "
+                "(from_worker/from_pid; attempt = after the strike)",
+    "drain_requeue": "attempt-neutral requeue (reason: drain | "
+                     "boot_recovery | abandoned_claiming | "
+                     "abandoned_takeover | scale_down)",
+    "quarantined": "the beam hit the attempts cap (a terminal "
+                   "failed result follows)",
+    "worker_spawn": "controller spawned a worker (no ticket key)",
+    "worker_exit": "controller reaped a worker exit (kind, rc)",
+    "scale_up": "autoscaler added worker(s): before/after counts, "
+                "policy bounds, and the triggering signals",
+    "scale_down": "autoscaler retired worker(s): victims (worker, "
+                  "pid, class) named for the no_elastic_strike audit",
+    "chaos_action": "chaos conductor executed a timeline action",
+    "chaos_run_start": "chaos conductor opened a storm",
+    "chaos_run_end": "chaos conductor quiesced the storm",
+}
+
 #: the one terminal event name: a ticket is finished exactly when its
 #: durable done/ record lands, so exactly-once across the fleet reads
 #: as "exactly one ``result`` event per ticket" in the journal
 TERMINAL_EVENT = "result"
+assert TERMINAL_EVENT in EVENTS
 
 
 def journal_path(spool: str) -> str:

@@ -388,6 +388,48 @@ def frontdoor_routed_total() -> metrics.Counter:
         labelnames=("host", "outcome"))
 
 
+# the journal-derived fleet SLO instruments: built into a CALLER-
+# OWNED registry, not the process-global one — the fleet aggregator
+# derives them from the spool journal on every aggregation pass and
+# merges the fresh registry into fleet.prom, so a half-updated
+# global series is never scraped.  Catalog membership is what the
+# contract linter checks; the registry handle is the caller's.
+
+def fleet_slo_seconds(reg: metrics.Registry) -> metrics.Gauge:
+    return reg.gauge(
+        "tpulsar_fleet_slo_seconds",
+        "journal-derived fleet latency quantiles: queue_wait = "
+        "gateway receipt (HTTP arrival; spool submit when no "
+        "gateway) -> first claim, claim_to_start = claim -> device "
+        "work, beam_e2e = receipt -> terminal result (exact "
+        "quantiles over the journal's raw durations, spanning every "
+        "worker that touched each beam)",
+        labelnames=("series", "quantile"))
+
+
+def fleet_slo_source_workers(reg: metrics.Registry) -> metrics.Gauge:
+    return reg.gauge(
+        "tpulsar_fleet_slo_source_workers",
+        "distinct workers whose journal events feed each SLO series",
+        labelnames=("series",))
+
+
+def fleet_tickets(reg: metrics.Registry) -> metrics.Gauge:
+    return reg.gauge(
+        "tpulsar_fleet_tickets",
+        "journal tickets by lifecycle status (terminal statuses "
+        "from the result event; in-flight = no terminal yet)",
+        labelnames=("status",))
+
+
+def fleet_event_rate(reg: metrics.Registry) -> metrics.Gauge:
+    return reg.gauge(
+        "tpulsar_fleet_event_rate",
+        "journal takeovers/quarantines per TERMINAL ticket — the "
+        "fleet's crash-recovery and poison pressure",
+        labelnames=("event",))
+
+
 def chaos_actions_total() -> metrics.Counter:
     return metrics.counter(
         "tpulsar_chaos_actions_total",

@@ -21,4 +21,18 @@ This package makes them first-class:
                a slower row, not lost science.
 """
 
-from tpulsar.resilience import faults, policy, rescue  # noqa: F401
+from tpulsar.resilience import faults, policy  # noqa: F401
+
+# rescue imports numpy; faults/policy (and their jax-free consumers:
+# the journal, the serve protocol, the contract linter's CI job with
+# nothing installed) must stay stdlib-only, so the rescue submodule
+# loads lazily on first attribute access (PEP 562) — `from
+# tpulsar.resilience import rescue` keeps working either way.
+
+
+def __getattr__(name: str):
+    if name == "rescue":
+        import importlib
+        return importlib.import_module("tpulsar.resilience.rescue")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
