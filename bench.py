@@ -1106,6 +1106,187 @@ def run_dedisp() -> None:
     _emit(rec)
 
 
+# --------------------------------------------------------------- accel bench
+
+def run_accel_ab() -> None:
+    """``bench.py --accel``: per-trial vs batched FDAS A/B on one
+    block of whitened DM-trial spectra — the per-stage
+    ``dm_trials_per_sec`` contrast that justifies the batched
+    acceleration-search path (kernels/accel.py + the
+    kernels/accel_batch.py planner + the native plane consumer).
+    Emits one bench/v2 record with an additive ``accel`` key;
+    tools/bench_gate.py gates ``accel.batched.dm_trials_per_sec``
+    (and the per-DM rate, and the speedup) against the committed
+    baseline.
+
+    Sides of the A/B are both PRODUCTION paths, pinned by the same
+    control an operator would use: per_dm = ``TPULSAR_ACCEL_BATCH=0``
+    (per-trial row dispatch, the degrade target), batched = the
+    default batched path (on CPU that routes through the native
+    z-chunked consumer when the toolchain allows).  The batched
+    side's plane-construction seconds are measured separately so the
+    record carries the plane-vs-fused-top-k split.  Measurements
+    interleave within each rep and medians are reported (the
+    bench --dedisp bracketing discipline: shared-host capacity drift
+    must not masquerade as the path contrast).
+
+    Knobs: TPULSAR_ACCEL_AB_NBINS (spectrum bins, default 1<<15),
+    TPULSAR_ACCEL_AB_NDMS (DM trials, default 24),
+    TPULSAR_ACCEL_AB_ZMAX (default 50), TPULSAR_ACCEL_AB_NUMHARM
+    (default 8), TPULSAR_ACCEL_AB_TOPK (default 32),
+    TPULSAR_ACCEL_AB_REPS (default 3)."""
+    import statistics
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if want:
+        jax.config.update("jax_platforms", want)
+
+    from tpulsar import native
+    from tpulsar.kernels import accel as ak
+    from tpulsar.kernels import accel_batch as abp
+
+    nbins = int(os.environ.get("TPULSAR_ACCEL_AB_NBINS",
+                               str(1 << 15)))
+    ndms = int(os.environ.get("TPULSAR_ACCEL_AB_NDMS", "24"))
+    zmax = float(os.environ.get("TPULSAR_ACCEL_AB_ZMAX", "50"))
+    numharm = int(os.environ.get("TPULSAR_ACCEL_AB_NUMHARM", "8"))
+    topk = int(os.environ.get("TPULSAR_ACCEL_AB_TOPK", "32"))
+    reps = max(1, int(os.environ.get("TPULSAR_ACCEL_AB_REPS", "3")))
+
+    bank = ak.build_template_bank(zmax)
+    nz = len(bank.zs)
+    rng = np.random.default_rng(13)
+    host = (rng.normal(size=(ndms, nbins))
+            + 1j * rng.normal(size=(ndms, nbins))).astype(np.complex64)
+    # a strong drifting tone so the A/B's candidate parity is judged
+    # on a real detection, not only on noise maxima
+    host[:, nbins // 3] += 25.0
+    specs = jnp.asarray(host)
+    plan = abp.plan_batches(ndms, ak.plane_dm_chunk(nbins, nz))
+    block = specs if plan.padded_rows == ndms else ak._pad_block(
+        specs, rows=plan.padded_rows)
+    bank_fft = jnp.asarray(bank.bank_fft)
+
+    def _pin(mode: str | None):
+        # the same knob an operator pins the path with; the cached
+        # probe verdict must be re-derived after every flip
+        if mode is None:
+            os.environ.pop("TPULSAR_ACCEL_BATCH", None)
+        else:
+            os.environ["TPULSAR_ACCEL_BATCH"] = mode
+        ak._reset_batch_state()
+
+    def per_dm_fn():
+        _pin("0")
+        return ak.accel_search_batch(specs, bank,
+                                     max_numharm=numharm, topk=topk)
+
+    def batched_fn():
+        _pin(None)
+        return ak.accel_search_batch(specs, bank,
+                                     max_numharm=numharm, topk=topk)
+
+    use_z = native.has_accel_zsegs()
+
+    def plane_fn():
+        # the batched side's plane construction alone, at the exact
+        # per-batch shapes the planner dispatches (the z-chunked
+        # pieces program when the native consumer will eat them, the
+        # assembled block otherwise).  Pieces are dropped per batch,
+        # matching the real path's buffer lifetime — holding every
+        # batch's GB-scale pieces alive would measure allocator
+        # pressure the pipeline never creates.
+        for s0 in plan.starts:
+            sub = jax.lax.dynamic_slice_in_dim(
+                block, np.int32(s0), plan.b, axis=0)
+            if use_z:
+                out = ak._correlate_zpieces(
+                    sub, bank_fft, seg=bank.seg, step=bank.step,
+                    width=bank.width, nz=nz)
+            else:
+                out = ak._correlate_block(
+                    sub, bank_fft, bank.seg, bank.step, bank.width,
+                    nz)
+            jax.block_until_ready(out)
+            del out
+        return True
+
+    measures = {"per_dm": per_dm_fn, "batched": batched_fn,
+                "plane": plane_fn}
+    outs: dict[str, object] = {}
+    for name, fn in measures.items():
+        outs[name] = fn()                      # warm (compiles)
+    samples: dict[str, list] = {k: [] for k in measures}
+    for _ in range(reps):
+        for name, fn in measures.items():
+            t0 = time.time()
+            outs[name] = fn()
+            samples[name].append(time.time() - t0)
+    _pin(None)
+
+    per_dm_s = statistics.median(samples["per_dm"])
+    batched_s = statistics.median(samples["batched"])
+    plane_s = statistics.median(samples["plane"])
+    res_p, res_b = outs["per_dm"], outs["batched"]
+
+    # candidate parity: same winning (r, z) cells on both paths, and
+    # powers within FFT-batching tolerance (the two sides batch their
+    # FFTs differently, so the last-ulp reduction order differs; bins
+    # and z picks must not)
+    parity_ok = True
+    max_rel = 0.0
+    for h in res_b:
+        pv, pr, pz = res_p[h]
+        bv, br, bz = res_b[h]
+        if not (np.array_equal(pr, br) and np.array_equal(pz, bz)):
+            parity_ok = False
+        denom = np.maximum(np.abs(pv), 1e-6)
+        rel = float(np.max(np.abs(bv - pv) / denom))
+        max_rel = max(max_rel, rel)
+        if rel > 2e-4:
+            parity_ok = False
+
+    rec = {
+        "metric": "accel_ab_batched_dm_trials_per_sec",
+        "value": round(ndms / batched_s, 2),
+        "unit": "trials/s",
+        "vs_baseline": round((ndms / batched_s)
+                             / max(ndms / per_dm_s, 1e-9), 3),
+        "device": str(jax.devices()[0]),
+        "accel": {
+            "nbins": nbins, "ndms": ndms, "zmax": zmax, "nz": nz,
+            "numharm": numharm, "topk": topk, "reps": reps,
+            "native": bool(native.load() is not None),
+            "native_zsegs": bool(use_z),
+            "quantized_batch": plan.b,
+            "padded_rows": plan.padded_rows,
+            "nbatches": plan.nbatches,
+            "per_dm": {
+                "seconds": round(per_dm_s, 4),
+                "dm_trials_per_sec": round(ndms / per_dm_s, 2),
+            },
+            "batched": {
+                "seconds": round(batched_s, 4),
+                # the fused reduction's share is the batched total
+                # minus its measured plane construction
+                "plane_seconds": round(plane_s, 4),
+                "topk_seconds": round(max(batched_s - plane_s, 0.0),
+                                      4),
+                "dm_trials_per_sec": round(ndms / batched_s, 2),
+            },
+            "speedup": round(per_dm_s / batched_s, 3),
+            "parity_max_rel_err": max_rel,
+            "parity_ok": parity_ok,
+        },
+    }
+    _emit(rec)
+
+
 # --------------------------------------------------------------- serve bench
 
 def run_serve() -> None:
@@ -2097,6 +2278,9 @@ def main() -> None:
         return
     if "--dedisp" in sys.argv:
         run_dedisp()
+        return
+    if "--accel" in sys.argv:
+        run_accel_ab()
         return
     if "--fleet" in sys.argv:
         run_fleet()

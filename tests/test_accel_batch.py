@@ -1,0 +1,504 @@
+"""Batched-FDAS tests: the accel_batch planner's quantization, the
+batched path's candidate parity against the per-spectrum oracle
+across batch sizes (including the ragged tail), the bf16 plane
+tolerance path, the per-batch -> per-trial -> CPU-rescue degradation
+ladder under injected faults, and the quantized-signature regression
+(a ragged pass sweep must not out-compile the planner's signature
+set, which is exactly what the AOT registry gates)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpulsar.kernels import accel, accel_batch
+from tpulsar.resilience import faults
+
+
+def _specs(ndms, nbins=4096, seed=5):
+    rng = np.random.default_rng(seed)
+    s = (rng.normal(size=(ndms, nbins))
+         + 1j * rng.normal(size=(ndms, nbins))).astype(np.complex64)
+    s[:, nbins // 4] += 20.0       # a real detection, not only noise
+    return jnp.asarray(s)
+
+
+@pytest.fixture
+def clean_accel_state(monkeypatch):
+    """Every test here manipulates the process-global batch verdict
+    (and the breaker's cross-call refusal count) and/or the fault
+    registry; leave none of it behind."""
+    import tpulsar.kernels.accel as ak
+    ak._reset_batch_state()
+    yield
+    faults.reset()
+    ak._reset_batch_state()
+
+
+# ------------------------------------------------------- the planner
+
+def test_quanta_ladder_properties():
+    prev = None
+    for q in accel_batch.BATCH_QUANTA:
+        if prev is not None:
+            assert prev < q <= 2 * prev    # bounded quantize cost
+        prev = q
+    for n in range(1, 600):
+        qd = accel_batch.quantize_batch(n)
+        qu = accel_batch.quantize_rows_up(n)
+        assert qd <= n <= qu
+        assert qd in accel_batch.BATCH_QUANTA
+        assert qu in accel_batch.BATCH_QUANTA or qu == n
+        # quantizing down at most doubles the dispatch count;
+        # quantizing up at most doubles the padded rows
+        assert 2 * qd >= n or qd == accel_batch.BATCH_QUANTA[-1]
+        assert qu <= 2 * n
+
+
+def test_plan_batches_covers_all_rows_with_clamped_tail():
+    plan = accel_batch.plan_batches(57, 16)
+    assert plan.b == 16
+    covered = set()
+    for s0 in plan.starts:
+        # every dispatch fits inside the REAL rows: pad rows are
+        # shape stabilizers, never searched
+        assert 0 <= s0 <= plan.ndms - plan.b
+        covered.update(plan.rows_of(s0))
+    assert covered == set(range(57))
+    assert plan.padded_rows == accel_batch.quantize_rows_up(57) == 64
+    # a budget larger than the block quantizes DOWN (the ragged tail
+    # re-covers rows; it never traces a smaller program)
+    plan2 = accel_batch.plan_batches(5, 99)
+    assert plan2.b == 4
+    assert plan2.starts == (0, 1)
+
+
+# ------------------------------------------- parity vs the oracle
+
+def test_batched_candidates_match_per_dm_oracle_across_batch_sizes(
+        clean_accel_state):
+    """B in {1, ragged-tail, full}: byte-identical results regardless
+    of batching, and exact top-k bins/z against the single-spectrum
+    oracle program."""
+    from tpulsar.kernels.fourier import harmonic_stages
+
+    ndms = 5
+    specs = _specs(ndms)
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    outs = {}
+    for b in (1, 2, ndms):         # 2 -> ragged tail (5 % 2 == 1)
+        outs[b] = accel.accel_search_batch(
+            specs, bank, max_numharm=4, topk=8, dm_chunk=b)
+    for b in (1, 2):
+        for h in outs[ndms]:
+            for i in range(3):
+                np.testing.assert_array_equal(
+                    np.asarray(outs[b][h][i]),
+                    np.asarray(outs[ndms][h][i]))
+    bf = jnp.asarray(bank.bank_fft)
+    nz = len(bank.zs)
+    zs = np.asarray(bank.zs)
+    stages = harmonic_stages(4)
+    for r in range(ndms):
+        sv, sr, sz = accel._accel_plane_topk(
+            specs[r], bf, bank.seg, bank.step, bank.width, nz, 4, 8)
+        for si, h in enumerate(stages):
+            vv, rr, zv = outs[ndms][h]
+            np.testing.assert_array_equal(rr[r], np.asarray(sr)[si])
+            np.testing.assert_array_equal(zv[r],
+                                          zs[np.asarray(sz)[si]])
+            np.testing.assert_allclose(vv[r], np.asarray(sv)[si],
+                                       rtol=2e-4)
+
+
+def test_zpieces_native_consumer_bit_identical(clean_accel_state):
+    """The z-chunked native consumer (ZSegSrc pointer table — no
+    plane concatenate on either side) must be BIT-identical to the
+    fused XLA extraction: asserted un-toleranced."""
+    from tpulsar import native
+    from tpulsar.kernels.fourier import BLOCK_R, harmonic_stages
+
+    if not native.has_accel_zsegs():
+        pytest.skip("no native toolchain / z-chunked entrypoint")
+    nbins = 6000
+    specs = _specs(3, nbins=nbins, seed=11)
+    bank = accel.build_template_bank(20.0, seg=1 << 11)
+    nz = len(bank.zs)
+    bf = jnp.asarray(bank.bank_fft)
+    want = accel._accel_block_topk(specs, bf, bank.seg, bank.step,
+                                   bank.width, nz, 8, 16)
+    zp = accel._correlate_zpieces(specs, bf, seg=bank.seg,
+                                  step=bank.step, width=bank.width,
+                                  nz=nz)
+    got = native.accel_stage_topk_zsegs(
+        [np.asarray(p) for p in zp], bank.width, 2 * nbins,
+        harmonic_stages(8), BLOCK_R, 16)
+    assert got is not None
+    for i, w in enumerate(want):
+        np.testing.assert_array_equal(got[i], np.asarray(w))
+
+
+def test_bf16_plane_batched_within_tolerance(clean_accel_state,
+                                             monkeypatch):
+    """The bf16-plane opt-in through the BATCHED search surface: same
+    winning (r, z) cells as the f32 plane, powers within 1%."""
+    import importlib
+
+    import tpulsar.kernels.accel as ak
+
+    specs_host = np.asarray(_specs(3, seed=9))
+    bank_zmax, seg = 8.0, 1 << 11
+
+    def run_with(dtype_name):
+        monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", dtype_name)
+        mod = importlib.reload(ak)
+        bank = mod.build_template_bank(bank_zmax, seg=seg)
+        return mod.accel_search_batch(
+            jnp.asarray(specs_host), bank, max_numharm=2, topk=8)
+
+    try:
+        f32 = run_with("f32")
+        b16 = run_with("bf16")
+    finally:
+        monkeypatch.setenv("TPULSAR_ACCEL_PLANE_DTYPE", "f32")
+        importlib.reload(ak)
+
+    for h in f32:
+        # the strong injected tone's winning cell must agree; noise
+        # runners-up may reorder under the storage-dtype rounding
+        fv, fr, fz = (np.asarray(a) for a in f32[h])
+        bv, br, bz = (np.asarray(a) for a in b16[h])
+        assert np.array_equal(fr[:, 0], br[:, 0])
+        assert np.array_equal(fz[:, 0], bz[:, 0])
+        rel = np.abs(bv[:, 0] - fv[:, 0]) / np.maximum(fv[:, 0], 1e-6)
+        assert float(rel.max()) < 0.01
+
+
+# --------------------------------------- the degradation ladder
+
+def test_refused_batch_degrades_per_batch_only(clean_accel_state,
+                                               monkeypatch):
+    """An injected accel.chunk refusal on ONE batch (dispatch + its
+    sync retry) falls back to the per-trial path for THAT batch's
+    rows only — other batches stay batched, candidates are identical
+    to a clean run, and no rescue/loss is recorded because the row
+    dispatches are healthy."""
+    import tpulsar.kernels.accel as ak
+    from tpulsar.obs import telemetry
+    from tpulsar.search import degraded
+
+    monkeypatch.delenv("TPULSAR_ACCEL_BATCH", raising=False)
+    ndms = 6
+    specs = _specs(ndms, seed=21)
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    degraded.reset()
+    clean = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                     topk=8, dm_chunk=2)
+
+    # second batch dispatch refused, and refused again on the sync
+    # retry (after=1 clean fire, count=2 raising fires)
+    faults.configure("accel.chunk:unimplemented:after=1,count=2")
+    ak._reset_batch_state()
+    degraded.reset()
+    trials_base = {
+        p: telemetry.accel_batch_trials_total().value(path=p)
+        for p in ("batched", "per_dm", "rescued")}
+    out = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                   topk=8, dm_chunk=2)
+    faults.reset()
+
+    for h in clean:
+        for i in range(3):
+            np.testing.assert_array_equal(np.asarray(out[h][i]),
+                                          np.asarray(clean[h][i]))
+    snap = degraded.snapshot()
+    assert snap["accel_batches_refused"].startswith("1/3")
+    assert "accel_rows_zero_filled" not in snap
+    assert "accel_batch_downgraded" not in snap
+    trials = {
+        p: telemetry.accel_batch_trials_total().value(path=p)
+        - trials_base[p]
+        for p in ("batched", "per_dm", "rescued")}
+    assert trials == {"batched": 4, "per_dm": 2, "rescued": 0}
+    # the process verdict survives: one flaky batch must not pin the
+    # per-DM path for every later call
+    assert ak._BATCH_OK is not False
+
+
+def test_refused_clamped_tail_keeps_resolved_rows(clean_accel_state,
+                                                  monkeypatch):
+    """The clamped tail re-covers rows an earlier batch owns: with
+    ndms=5, b=2 the starts are (0, 2, 3) and the tail @3 overlaps
+    row 3 of the successful batch @2.  A refused tail must degrade
+    ONLY its unresolved row (4) — row 3 holds real delivered batched
+    powers and must be neither recomputed per-trial nor exposed to
+    the ladder's zero-fill rung."""
+    import tpulsar.kernels.accel as ak
+    from tpulsar.obs import telemetry
+    from tpulsar.search import degraded
+
+    monkeypatch.delenv("TPULSAR_ACCEL_BATCH", raising=False)
+    ndms = 5
+    specs = _specs(ndms, seed=29)
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    plan = accel_batch.plan_batches_explicit(ndms, 2)
+    assert plan.starts == (0, 2, 3)        # the overlapping tail
+    degraded.reset()
+    clean = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                     topk=8, dm_chunk=2)
+
+    # fires 1-2 (batches @0, @2) clean; fire 3 = the tail dispatch
+    # and fire 4 = its sync retry both refused
+    faults.configure("accel.chunk:unimplemented:after=2,count=2")
+    ak._reset_batch_state()
+    degraded.reset()
+    trials_base = {
+        p: telemetry.accel_batch_trials_total().value(path=p)
+        for p in ("batched", "per_dm", "rescued")}
+    out = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                   topk=8, dm_chunk=2)
+    faults.reset()
+
+    for h in clean:
+        for i in range(3):
+            np.testing.assert_array_equal(np.asarray(out[h][i]),
+                                          np.asarray(clean[h][i]))
+    trials = {
+        p: telemetry.accel_batch_trials_total().value(path=p)
+        - trials_base[p]
+        for p in ("batched", "per_dm", "rescued")}
+    # rows 0-3 are batched science (row 3 via the successful @2
+    # batch); ONLY row 4 rides the per-trial ladder
+    assert trials == {"batched": 4, "per_dm": 1, "rescued": 0}
+    snap = degraded.snapshot()
+    assert snap["accel_batches_refused"].startswith("1/3")
+    assert "accel_rows_zero_filled" not in snap
+
+
+def test_batch_breaker_pins_per_dm_path(clean_accel_state,
+                                        monkeypatch):
+    """TPULSAR_ACCEL_BATCH_BREAKER consecutive refused batches pin
+    the per-DM path (poisoned session); every row still resolves via
+    the per-trial ladder and candidates match the clean run."""
+    import tpulsar.kernels.accel as ak
+    from tpulsar.search import degraded
+
+    monkeypatch.delenv("TPULSAR_ACCEL_BATCH", raising=False)
+    monkeypatch.setenv("TPULSAR_ACCEL_BATCH_BREAKER", "2")
+    ndms = 6
+    specs = _specs(ndms, seed=23)
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    degraded.reset()
+    clean = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                     topk=8, dm_chunk=2)
+
+    faults.configure("accel.chunk:unimplemented:rate=1.0")
+    ak._reset_batch_state()
+    degraded.reset()
+    out = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                   topk=8, dm_chunk=2)
+    faults.reset()
+
+    for h in clean:
+        for i in range(3):
+            np.testing.assert_array_equal(np.asarray(out[h][i]),
+                                          np.asarray(clean[h][i]))
+    snap = degraded.snapshot()
+    assert "accel_batch_downgraded" in snap
+    assert "accel_rows_zero_filled" not in snap
+    assert ak._BATCH_OK is False
+
+
+def test_batch_breaker_accumulates_across_calls(clean_accel_state,
+                                                monkeypatch):
+    """The breaker is a PROCESS judgment: an executor pass hands the
+    kernel one DM chunk per call — often a single batch each — so the
+    consecutive-refusal count must survive across calls or a
+    persistently-refusing runtime burns the doomed dispatch + sync
+    retry on every chunk of every pass without ever pinning per-DM."""
+    import tpulsar.kernels.accel as ak
+    from tpulsar.search import degraded
+
+    monkeypatch.delenv("TPULSAR_ACCEL_BATCH", raising=False)
+    monkeypatch.setenv("TPULSAR_ACCEL_BATCH_BREAKER", "2")
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    # every batch dispatch refused, one batch per call (ndms == b)
+    faults.configure("accel.chunk:unimplemented:rate=1.0")
+    ak._reset_batch_state()
+    degraded.reset()
+    accel.accel_search_batch(_specs(2, seed=33), bank, max_numharm=2,
+                             topk=8, dm_chunk=2)
+    assert ak._BATCH_OK is not False       # one refused batch so far
+    accel.accel_search_batch(_specs(2, seed=34), bank, max_numharm=2,
+                             topk=8, dm_chunk=2)
+    faults.reset()
+    # the second call's refused batch is the threshold'th CONSECUTIVE
+    # refusal across the process: pinned
+    assert ak._BATCH_OK is False
+    assert "accel_batch_downgraded" in degraded.snapshot()
+
+
+def test_zsegs_rejects_oversized_last_chunk():
+    """A last chunk taller than zchunk would drive ZSegSrc::slab_at
+    past the pointer table: the wrapper must return None, never call
+    the kernel."""
+    from tpulsar import native
+
+    if not native.has_accel_zsegs():
+        pytest.skip("no native toolchain / z-chunked entrypoint")
+    from tpulsar.kernels.fourier import BLOCK_R, harmonic_stages
+
+    stages = harmonic_stages(2)
+    ok_a = np.zeros((1, 1, 4, 8), np.float32)
+    bad_b = np.zeros((1, 1, 7, 8), np.float32)   # taller than zchunk
+    assert native.accel_stage_topk_zsegs(
+        [ok_a, bad_b], 2, 16, stages, BLOCK_R, 4) is None
+    empty = np.zeros((1, 1, 0, 8), np.float32)   # zero-height chunk
+    assert native.accel_stage_topk_zsegs(
+        [ok_a, empty], 2, 16, stages, BLOCK_R, 4) is None
+
+
+def test_refused_batch_then_refused_rows_rescue(clean_accel_state,
+                                                monkeypatch):
+    """The full ladder: every batch refused, every per-trial row
+    dispatch refused too — the host rescue recomputes all rows with
+    the rescued-vs-lost taxonomy intact (all rescued, none lost)."""
+    import tpulsar.kernels.accel as ak
+    from tpulsar.obs import telemetry
+    from tpulsar.search import degraded
+
+    monkeypatch.delenv("TPULSAR_ACCEL_BATCH", raising=False)
+    ndms = 4
+    specs = _specs(ndms, seed=27)
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    degraded.reset()
+    clean = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                     topk=8, dm_chunk=2)
+
+    # both rungs armed: the batched gate must NOT pin per-DM (the
+    # chunk point is targeted as well), so the ladder actually runs
+    faults.configure("accel.chunk:unimplemented:rate=1.0;"
+                     "accel.row_dispatch:unimplemented:rate=1.0")
+    ak._reset_batch_state()
+    degraded.reset()
+    rescued_base = telemetry.rescue_rows_total().value(
+        outcome="rescued")
+    trials_base = telemetry.accel_batch_trials_total().value(
+        path="rescued")
+    sec_rescued_base = telemetry.accel_stage_seconds().series(
+        path="rescued")
+    sec_perdm_base = telemetry.accel_stage_seconds().series(
+        path="per_dm")
+    out = accel.accel_search_batch(specs, bank, max_numharm=2,
+                                   topk=8, dm_chunk=2)
+    assert faults.fired("accel.chunk") > 0
+    faults.reset()
+    # rescued rows recompute on the same CPU backend with the row
+    # program: bins/z exact, powers within the batched-vs-row FFT
+    # reduction tolerance
+    for h in clean:
+        np.testing.assert_allclose(np.asarray(out[h][0]),
+                                   np.asarray(clean[h][0]), rtol=2e-4)
+        np.testing.assert_array_equal(np.asarray(out[h][1]),
+                                      np.asarray(clean[h][1]))
+        np.testing.assert_array_equal(np.asarray(out[h][2]),
+                                      np.asarray(clean[h][2]))
+    assert telemetry.rescue_rows_total().value(
+        outcome="rescued") - rescued_base == ndms
+    assert telemetry.accel_batch_trials_total().value(
+        path="rescued") - trials_base == ndms
+    # seconds follow the trials: an all-rescued call books its whole
+    # wall time (recompute span + the doomed dispatch overhead) under
+    # the rescued path, ONE observation, and leaves the per_dm series
+    # untouched — rescued trials with zero rescued seconds (or a
+    # per_dm series holding the slow recompute span against zero
+    # per_dm trials) would skew the derived per-path rates
+    sec_rescued = telemetry.accel_stage_seconds().series(
+        path="rescued")
+    sec_perdm = telemetry.accel_stage_seconds().series(path="per_dm")
+    assert sec_rescued["count"] - sec_rescued_base["count"] == 1
+    assert sec_rescued["sum"] > sec_rescued_base["sum"]
+    assert sec_perdm["count"] == sec_perdm_base["count"]
+    snap = degraded.snapshot()
+    assert "accel_rows_zero_filled" not in snap
+    assert degraded.provenance_snapshot().get(
+        "accel_rows_rescued", "").startswith(f"{ndms}/{ndms}")
+
+
+# --------------------------------- quantized compile signatures
+
+def test_ragged_sweep_compiles_at_most_planner_signatures(
+        clean_accel_state, monkeypatch):
+    """A pass sweep over ragged DM-trial counts must compile no more
+    chunk-program signatures than the planner's quantized signature
+    set — the set the AOT registry gates.  Without row/batch
+    quantization every distinct count is its own compile."""
+    import tpulsar.kernels.accel as ak
+
+    monkeypatch.setenv("TPULSAR_ACCEL_NATIVE", "0")   # XLA chunk path
+    ak._BATCH_OK = True
+    nbins = 3000
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    nz = len(bank.zs)
+    big = np.asarray(_specs(13, nbins=nbins, seed=31))
+    sweep = (5, 6, 7, 9, 11, 12, 13)
+    before = ak.accel_chunk_topk._cache_size()
+    for ndms in sweep:
+        accel.accel_search_batch(jnp.asarray(big[:ndms]), bank,
+                                 max_numharm=2, topk=8)
+    compiled = ak.accel_chunk_topk._cache_size() - before
+    planned = {(accel_batch.quantize_rows_up(n),
+                accel_batch.batch_rows(n, nbins, nz))
+               for n in sweep}
+    assert compiled <= len(planned)
+    assert compiled < len(sweep)       # quantization actually dedupes
+    for _, b in planned:
+        assert b in accel_batch.BATCH_QUANTA
+
+
+def test_registry_gates_quantized_accel_signatures():
+    """The AOT gate's accel instances must use the SAME planner
+    arithmetic as the runtime: quantized nrows statics and quantized
+    spectra row counts, so a measured accel run compiles nothing the
+    gate did not."""
+    from tpulsar.aot import registry
+
+    ctx = registry.make_context(scale=0.02, accel=True)
+    seen = 0
+    for _hdr, insts in registry.gate_groups(ctx):
+        for inst in insts:
+            if inst.program == "accel.accel_chunk_topk":
+                seen += 1
+                assert inst.kwargs["nrows"] in accel_batch.BATCH_QUANTA
+                rows = inst.args[0].shape[0]
+                assert rows == accel_batch.quantize_rows_up(rows)
+    assert seen > 0
+
+
+def test_registry_native_instances_mirror_zsegs_branch(monkeypatch):
+    """The gate's native front-end instance must be the program the
+    runtime DISPATCHES: _correlate_zpieces when the library carries
+    the z-chunked entrypoint, the assembled-pieces _correlate_pieces
+    batch program on a loadable-but-stale library — gating on load()
+    alone would compile the former while every batch of a measured
+    run recompiles the latter in-line."""
+    import jax
+
+    from tpulsar import native
+    from tpulsar.aot import registry
+
+    if jax.default_backend() != "cpu" or native.load() is None:
+        pytest.skip("native CPU toolchain unavailable")
+    bank = accel.build_template_bank(8.0, seg=1 << 11)
+    nz = len(bank.zs)
+
+    monkeypatch.setattr(native, "has_accel_zsegs", lambda: True)
+    insts = registry._accel_native_instances(4, 3000, bank, nz,
+                                             label="t")
+    assert [i.program for i in insts] == ["accel._correlate_zpieces"]
+
+    monkeypatch.setattr(native, "has_accel_zsegs", lambda: False)
+    insts = registry._accel_native_instances(4, 3000, bank, nz,
+                                             label="t")
+    assert [i.program for i in insts] == ["accel._correlate_pieces"]
+    assert insts[0].args[0].shape == (4, 3000)

@@ -64,6 +64,9 @@ DEFAULT_KEYS = (
     ("dedisp.direct.dm_trials_per_sec", "higher"),
     ("dedisp.speedup", "higher"),
     ("dedisp.speedup_with_detrend", "higher"),
+    ("accel.batched.dm_trials_per_sec", "higher"),
+    ("accel.per_dm.dm_trials_per_sec", "higher"),
+    ("accel.speedup", "higher"),
     ("gateway.submit_to_result_p50_s", "lower"),
     ("gateway.submit_to_result_warm_s", "lower"),
     ("gateway.status_http_ms", "lower"),

@@ -178,6 +178,13 @@ PROGRAMS: tuple[Program, ...] = (
        ("seg", "step", "width", "nz", "max_numharm", "topk")),
     _k("accel", "_correlate_block", ("seg", "step", "width", "nz")),
     _k("accel", "_correlate_pieces", ("seg", "step", "width", "nz")),
+    _k("accel", "_correlate_zpieces", ("seg", "step", "width", "nz"),
+       doc="overlap-save powers still split by z-chunk (tuple, no "
+           "concatenate) — the native ZSegSrc consumer's input"),
+    _k("accel", "_pad_block", ("rows",),
+       doc="zero-pad a spectra block to a quantized row count "
+           "(accel_batch ladder) so ragged pass chunks reuse "
+           "chunk/row-program compile signatures"),
     _k("accel", "_accel_block_topk",
        ("seg", "step", "width", "nz", "max_numharm", "topk")),
     _k("accel", "accel_chunk_topk",
@@ -454,15 +461,21 @@ def _config_groups(ctx: GateContext,
                                       fr._block_edges(nbins)),
                           estimator=fr.whiten_estimator())),
         ]
+        from tpulsar.kernels import accel_batch as abp
+
         bank = ak.build_template_bank(200.0)
         nz = len(bank.zs)
-        dmc = min(ndms, ak.plane_dm_chunk(nbins, nz))
-        spec_sh = _sds((ndms, nbins), jnp.complex64)
+        # the batch planner's own arithmetic: quantized batch size,
+        # quantized padded block rows — the gate compiles the exact
+        # signatures accel_search_batch dispatches
+        dmc = abp.batch_rows(ndms, nbins, nz)
+        q_rows = abp.quantize_rows_up(ndms)
+        spec_sh = _sds((q_rows, nbins), jnp.complex64)
         bank_sh = _sds(bank.bank_fft.shape, jnp.complex64)
         i32 = _sds((), jnp.int32)
-        # accel_search_batch's chunk/row programs: full spectra
-        # argument + dynamic slice (the argument buffer is part of
-        # the gated footprint)
+        # accel_search_batch's chunk/row programs: full (quantized)
+        # spectra argument + dynamic slice (the argument buffer is
+        # part of the gated footprint)
         accel_insts = [
             Instance("accel.accel_chunk_topk", "accel_chunk_z200",
                      (spec_sh, bank_sh, i32),
@@ -475,9 +488,50 @@ def _config_groups(ctx: GateContext,
                           width=bank.width, nz=nz, max_numharm=16,
                           topk=64)),
         ]
+        if q_rows != ndms:
+            accel_insts.append(Instance(
+                "accel._pad_block", "accel_pad_z200",
+                (_sds((ndms, nbins), jnp.complex64),),
+                dict(rows=q_rows)))
+        accel_insts += _accel_native_instances(
+            dmc, nbins, bank, nz, label="z200")
         groups.append((f"accel z200 (nz={nz}, nbins={nbins}, "
                        f"dm_chunk={dmc}):", accel_insts))
     return groups
+
+
+def _accel_native_instances(dmc: int, nbins: int, bank, nz: int,
+                            label: str) -> list[Instance]:
+    """The CPU product path's jitted front end: on the CPU backend
+    with a native toolchain, accel_search_batch routes each batch
+    through _correlate_zpieces and the native ZSegSrc consumer — the
+    gate must compile that exact program or every batch of a CPU
+    measured run recompiles it in-line.  A loadable but STALE library
+    (no z-chunked entrypoint — the clock-skewed-copy case
+    native.has_accel_zsegs guards) makes the runtime fall back to the
+    assembled-pieces layout, so the gate mirrors the SAME branch and
+    registers _correlate_pieces at the batch shape instead: gating on
+    load() alone would compile a program the run never dispatches
+    while the one it does dispatch recompiles in-line on every batch.
+    Skipped on accelerator backends (the native path never engages
+    there) and when the native library cannot build."""
+    import jax
+
+    from tpulsar import native
+
+    if jax.default_backend() != "cpu" or native.load() is None:
+        return []
+    import jax.numpy as jnp
+
+    args = (_sds((dmc, nbins), jnp.complex64),
+            _sds(bank.bank_fft.shape, jnp.complex64))
+    statics = dict(seg=bank.seg, step=bank.step, width=bank.width,
+                   nz=nz)
+    if native.has_accel_zsegs():
+        return [Instance("accel._correlate_zpieces",
+                         f"accel_zpieces {label}", args, statics)]
+    return [Instance("accel._correlate_pieces",
+                     f"accel_pieces_batch {label}", args, statics)]
 
 
 def step_geometries(ctx: GateContext) -> list[tuple]:
@@ -626,10 +680,18 @@ def _headline_groups(ctx: GateContext,
             if ctx.accel:
                 # the hi stage runs at EVERY step geometry (the
                 # executor calls _hi_accel_pass inside the chunk
-                # loop of every pass), so each (rows, nbins) pair is
-                # its own program
-                dmc = min(rows, ak.plane_dm_chunk(nbins, nz))
-                spec_sh = _sds((rows, nbins), jnp.complex64)
+                # loop of every pass) — but the batch planner
+                # QUANTIZES both the batch size and the spectra
+                # block's row count (kernels/accel_batch.py), so the
+                # ragged pass-chunk row counts collapse onto the
+                # signature ladder here exactly as they do at
+                # runtime, and tests/test_accel_batch.py pins the
+                # sweep's compile count to this gate set
+                from tpulsar.kernels import accel_batch as abp
+
+                dmc = abp.batch_rows(rows, nbins, nz)
+                q_rows = abp.quantize_rows_up(rows)
+                spec_sh = _sds((q_rows, nbins), jnp.complex64)
                 insts += [
                     Instance("accel.accel_chunk_topk",
                              f"accel_chunk {tag}",
@@ -647,6 +709,13 @@ def _headline_groups(ctx: GateContext,
                                   max_numharm=_sp.hi_accel_numharm,
                                   topk=_sp.topk_per_stage)),
                 ]
+                if q_rows != rows:
+                    insts.append(Instance(
+                        "accel._pad_block", f"accel_pad {tag}",
+                        (_sds((rows, nbins), jnp.complex64),),
+                        dict(rows=q_rows)))
+                insts += _accel_native_instances(
+                    dmc, nbins, bank, nz, label=tag)
         groups.append(("", insts))
 
     # Refinement + fold prep: each fold-worthy candidate gets ONE

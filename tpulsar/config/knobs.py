@@ -48,6 +48,11 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
     _k("TPULSAR_ACCEL_BATCH", "enum(0|1)", "auto",
        "pin the hi-accel path: 0 = per-DM row dispatch, 1 = batched "
        "DM chunks; unset = probe-and-cache per backend"),
+    _k("TPULSAR_ACCEL_BATCH_BREAKER", "int", "4",
+       "consecutive refused batched hi-accel chunk dispatches before "
+       "the batched path is pinned off for the process; below it each "
+       "refused batch degrades alone (retry, then its rows ride the "
+       "per-trial ladder)"),
     _k("TPULSAR_ACCEL_BREAKER_THRESHOLD", "int", "8",
        "consecutive refused accel row dispatches before the circuit "
        "breaker opens and routes remaining rows to host rescue"),

@@ -343,6 +343,20 @@ def _breaker_threshold() -> int:
         v = 8
     return max(1, v)
 
+
+def _batch_breaker_threshold() -> int:
+    """TPULSAR_ACCEL_BATCH_BREAKER: consecutive refused BATCH
+    dispatches before the batched path is pinned off for the rest of
+    the process (the poisoned-session pattern at batch granularity).
+    Below the threshold each refused batch degrades alone — retried
+    once synchronously, then only ITS rows ride the per-trial ladder
+    while later batches keep dispatching batched."""
+    try:
+        v = int(os.environ.get("TPULSAR_ACCEL_BATCH_BREAKER", "4"))
+    except ValueError:
+        v = 4
+    return max(1, v)
+
 # z-templates correlated per inverse-FFT call in the batched path;
 # bounds the (nd*nsegs*z_chunk(), seg) intermediate.  Resolved lazily
 # per backend: 16 on CPU (25% faster at survey shapes — fewer, larger
@@ -518,6 +532,34 @@ def _correlate_pieces(specs: jnp.ndarray, bank_fft: jnp.ndarray,
     return jnp.concatenate(pieces, axis=2)   # (nd, nsegs, nz, 2*step)
 
 
+@partial(jax.jit, static_argnames=("seg", "step", "width", "nz"))
+def _correlate_zpieces(specs: jnp.ndarray, bank_fft: jnp.ndarray,
+                       seg: int, step: int, width: int,
+                       nz: int) -> tuple:
+    """Overlap-save correlation powers still SPLIT by z-chunk: the
+    per-z-chunk buffers of the correlate program's z loop, each
+    (nd, nsegs, zc, 2*step), as a tuple — no concatenate.  The native
+    z-chunked consumer (tpulsar.native.accel_stage_topk_zsegs)
+    addresses the chunks through a pointer table, so the full-plane
+    concatenate the assembled _correlate_pieces layout still paid
+    (~25% of the batched CPU plane construction at survey shapes)
+    never happens.  Same correlation math as _correlate_block."""
+    return tuple(_corr_piece_list(specs, bank_fft, seg, step, width,
+                                  nz))
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def _pad_block(specs: jnp.ndarray, rows: int) -> jnp.ndarray:
+    """Zero-pad a (ndms, nbins) spectra block to a QUANTIZED row
+    count (accel_batch.quantize_rows_up): the block's shape — an
+    argument shape, hence part of every downstream compile
+    signature — snaps to the ladder, so ragged pass-chunk row counts
+    dedupe to a handful of chunk/row-program signatures.  Pad rows
+    are shape stabilizers only: no BatchPlan start covers them, so
+    they are never correlated and never surface as candidates."""
+    return jnp.pad(specs, ((0, rows - specs.shape[0]), (0, 0)))
+
+
 @partial(jax.jit, static_argnames=("seg", "step", "width", "nz",
                                    "max_numharm", "topk"))
 def _accel_block_topk(specs, bank_fft, seg, step, width, nz,
@@ -554,6 +596,25 @@ def _accel_block_topk(specs, bank_fft, seg, step, width, nz,
 # forces the batched path (no gate, CI catches regressions); =0
 # forces per-DM.
 _BATCH_OK: bool | None = None
+
+# the batch breaker's consecutive-refusal count — MODULE state, like
+# the verdict above, because the breaker is a PROCESS judgment: an
+# executor pass hands accel_search_batch one DM chunk per call, often
+# a single batch each, so a call-local count would reset to zero
+# every call and a persistently-refusing runtime would burn the
+# doomed dispatch + sync retry (each up to the dispatch deadline) on
+# every chunk of every pass without ever pinning per-DM.  Any
+# successful batch drain resets it.
+_BATCH_REFUSALS = {"consec": 0, "pinned": False}
+
+
+def _reset_batch_state() -> None:
+    """Clear the process batch verdict AND the breaker's
+    consecutive-refusal state (tests / bench path pinning)."""
+    global _BATCH_OK
+    _BATCH_OK = None
+    _BATCH_REFUSALS["consec"] = 0
+    _BATCH_REFUSALS["pinned"] = False
 
 _SMOKE_SRC = """
 import numpy as np, jax, jax.numpy as jnp
@@ -713,58 +774,76 @@ def _native_cpu_path_usable() -> bool:
     return native.load() is not None
 
 
-def _accel_search_batch_native(spectra, bank: TemplateBank,
-                               max_numharm: int, topk: int,
-                               dm_chunk: int):
+def _np_view(dev_array):
+    """Zero-copy view of a CPU device buffer (np.asarray copies
+    ~0.5 GB per chunk); the device array must stay referenced while
+    the view is in use."""
+    try:
+        return np.from_dlpack(dev_array)
+    except Exception:
+        return np.asarray(dev_array)
+
+
+def _accel_search_batch_native(block, ndms: int, bank: TemplateBank,
+                               max_numharm: int, topk: int, plan):
     """CPU product path: the jitted overlap-save correlation emits
     raw pieces; the native host kernel does harmonic-stage sums,
     z-maxes, and block-max top-k at DRAM bandwidth, bit-identical to
     the XLA extraction (asserted by tests/test_accel.py).  ~2x the
     all-XLA CPU wall-clock at survey shapes: XLA's gather/transpose
-    lowering runs ~1 GB/s on data this streams."""
+    lowering runs ~1 GB/s on data this streams.
+
+    block: the (plan.padded_rows, nbins) quantized spectra block;
+    only rows < ndms are dispatched.  plan: the accel_batch.BatchPlan
+    the caller scheduled.  The pieces stay SPLIT by z-chunk
+    (_correlate_zpieces -> native ZSegSrc pointer table) when the
+    native library carries the z-chunked entrypoint, dropping the
+    full-plane concatenate from the jitted program; an older library
+    falls back to the assembled-pieces layout."""
     from tpulsar import native
     from tpulsar.kernels.fourier import BLOCK_R, harmonic_stages
 
     nz = len(bank.zs)
     bank_fft = jnp.asarray(bank.bank_fft)
-    ndms, nbins = spectra.shape
+    nbins = int(block.shape[1])
     from tpulsar.search.report import progress_beat
 
     stages = harmonic_stages(max_numharm)
     nstages = len(stages)
+    use_z = native.has_accel_zsegs()
     vals = np.empty((ndms, nstages, topk), np.float32)
     rbins = np.empty((ndms, nstages, topk), np.int32)
     zidx = np.empty((ndms, nstages, topk), np.int32)
-    for c0 in range(0, ndms, dm_chunk):
-        # clamp so the (possibly short) last chunk re-covers earlier
-        # rows instead of triggering a second compile signature
-        s0 = min(c0, ndms - dm_chunk)
+    for s0 in plan.starts:
         # per-chunk heartbeat WITH position: a full-scale hi stage can
         # run far longer than the stall supervisor's threshold inside
         # ONE executor stage, and a kill mid-stage must be able to say
         # how far the stage got (round-4 verdict: the one on-chip kill
         # carried no attribution)
         progress_beat(f"accel native dm {s0}/{ndms}")
-        block = jax.lax.dynamic_slice_in_dim(
-            spectra, np.int32(s0), dm_chunk, axis=0)
-        pieces_dev = _correlate_pieces(
-            block, bank_fft, seg=bank.seg, step=bank.step,
-            width=bank.width, nz=nz)
-        try:
-            # zero-copy view of the CPU buffer (np.asarray copies
-            # ~0.5 GB per chunk); pieces_dev stays referenced until
-            # the kernel below returns
-            pieces = np.from_dlpack(pieces_dev)
-        except Exception:
-            pieces = np.asarray(pieces_dev)
-        out = native.accel_stage_topk_segs(
-            pieces, bank.width, 2 * nbins, stages, BLOCK_R, topk)
-        del pieces, pieces_dev
+        sub = jax.lax.dynamic_slice_in_dim(
+            block, np.int32(s0), plan.b, axis=0)
+        if use_z:
+            zp_dev = _correlate_zpieces(
+                sub, bank_fft, seg=bank.seg, step=bank.step,
+                width=bank.width, nz=nz)
+            pieces = [_np_view(p) for p in zp_dev]
+            out = native.accel_stage_topk_zsegs(
+                pieces, bank.width, 2 * nbins, stages, BLOCK_R, topk)
+            del pieces, zp_dev
+        else:
+            pieces_dev = _correlate_pieces(
+                sub, bank_fft, seg=bank.seg, step=bank.step,
+                width=bank.width, nz=nz)
+            pieces = _np_view(pieces_dev)
+            out = native.accel_stage_topk_segs(
+                pieces, bank.width, 2 * nbins, stages, BLOCK_R, topk)
+            del pieces, pieces_dev
         if out is None:     # library vanished mid-run: caller falls
             return None     # back to the XLA path
-        vals[s0:s0 + dm_chunk] = out[0]
-        rbins[s0:s0 + dm_chunk] = out[1]
-        zidx[s0:s0 + dm_chunk] = out[2]
+        vals[s0:s0 + plan.b] = out[0]
+        rbins[s0:s0 + plan.b] = out[1]
+        zidx[s0:s0 + plan.b] = out[2]
     zs = np.asarray(bank.zs)
     return {h: (vals[:, i, :], rbins[:, i, :], zs[zidx[:, i, :]])
             for i, h in enumerate(stages)}
@@ -775,13 +854,31 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                        dm_chunk: int | None = None):
     """Acceleration-search a batch of whitened complex spectra.
 
-    spectra: (ndms, nbins) complex64.  DMs are processed `dm_chunk` at
-    a time, sized from the HBM budget so at most a few GB of
-    (nz, nbins) planes are live at once.  Returns
+    spectra: (ndms, nbins) complex64.  The host-side batch planner
+    (kernels/accel_batch.py) schedules the DM trials: the batch size
+    comes from the plane HBM budget / element cap (plane_dm_chunk)
+    QUANTIZED to the signature ladder, the spectra block is
+    zero-padded to a quantized row count so ragged pass chunks reuse
+    compile signatures, and the ragged batch tail re-covers earlier
+    rows at the same static shape.  An explicit ``dm_chunk`` is a
+    diagnostic/test control: the batch size is honoured exactly
+    (no quantization), only the block shape still snaps to the
+    ladder.  Returns
     {stage: (powers[ndms, topk], rbins[ndms, topk], zvals[ndms, topk])}.
+
+    Degradation ladder (the tunnel-flake story): a refused BATCH is
+    retried once synchronously, then only its rows fall to the
+    per-trial row path — which itself retries, then host-CPU-rescues,
+    then zero-fills — while later batches keep dispatching batched.
+    TPULSAR_ACCEL_BATCH_BREAKER consecutive refused batches pin the
+    per-DM path for the rest of the process (poisoned session).
     """
+    import time as _time
+
+    from tpulsar.kernels import accel_batch as abp
     from tpulsar.kernels.fourier import harmonic_stages
 
+    t_begin = _time.perf_counter()
     nz = len(bank.zs)
     # NB: the bank must be an explicit jit argument (a closed-over
     # device array baked in as an executable constant is rejected by
@@ -789,12 +886,20 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
     bank_fft = jnp.asarray(bank.bank_fft)
     ndms, nbins = spectra.shape
     if dm_chunk is None:
-        dm_chunk = plane_dm_chunk(nbins, nz)
-    dm_chunk = min(dm_chunk, ndms)
+        plan = abp.plan_batches(ndms, plane_dm_chunk(nbins, nz))
+    else:
+        plan = abp.plan_batches_explicit(ndms, dm_chunk)
+    block = spectra
+    if plan.padded_rows != ndms:
+        block = _pad_block(spectra, rows=plan.padded_rows)
     if _native_cpu_path_usable():
-        out = _accel_search_batch_native(spectra, bank, max_numharm,
-                                         topk, dm_chunk)
+        out = _accel_search_batch_native(block, ndms, bank,
+                                         max_numharm, topk, plan)
         if out is not None:
+            from tpulsar.obs import telemetry as _tm
+            _tm.accel_batch_trials_total().inc(ndms, path="batched")
+            _tm.accel_stage_seconds().observe(
+                _time.perf_counter() - t_begin, path="batched")
             return out
     from tpulsar.resilience import faults
     from tpulsar.resilience import policy as rpolicy
@@ -890,38 +995,139 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
         # completed on device (see the native path's note)
         progress_beat(f"accel window dm {done}/{ndms}")
 
+    refused_batches = 0
+    fallback: set[int] = set()            # rows degraded per-trial
+    resolved: set[int] = set()            # rows a batch REALLY wrote
     if use_batch:
         pending: list = []
-        try:
-            for c0 in range(0, ndms, dm_chunk):
-                # clamp so the (possibly short) last chunk re-covers
-                # earlier rows instead of triggering a second compile
-                s0 = min(c0, ndms - dm_chunk)
-                pending.append(
-                    (s0, dm_chunk, chunk_fn(spectra, bank_fft, s0,
-                                            dm_chunk)))
-                if len(pending) >= SYNC_WINDOW:
-                    _drain(pending)
-            _drain(pending)
-        except REFUSED as exc:
-            # The runtime rejected the batched shapes (the catchable
-            # failure mode, surfacing at dispatch or at the window
-            # sync; a hang is caught by the subprocess gate or, when
-            # TPULSAR_ACCEL_DISPATCH_DEADLINE_S is set, converted to
-            # DeadlineExceeded by the watchdog).  Downgrade for the
-            # rest of the process.
+        bstate = _BATCH_REFUSALS     # cross-call: see its definition
+        bthresh = _batch_breaker_threshold()
+
+        def _attempt(s0):
+            return (s0, plan.b, chunk_fn(block, bank_fft, s0, plan.b))
+
+        def _drain_ok(entries):
+            """_drain, then mark the entries' rows resolved — only a
+            SUCCESSFUL fetch writes vals, and only resolved rows may
+            be excused from the per-trial ladder.  Matters for the
+            clamped tail: its starts re-cover rows an earlier batch
+            already filled, and a refused tail must not send those
+            rows — real, delivered science — down a ladder whose
+            last rung zero-fills."""
+            snapshot = entries[:]
+            _drain(entries)
+            for s0, nr, _tup in snapshot:
+                resolved.update(range(s0, s0 + nr))
+
+        def _note_refused_batch(s0):
+            nonlocal refused_batches
+            fallback.update(plan.rows_of(s0))
+            refused_batches += 1
+            bstate["consec"] += 1
+            if bstate["consec"] >= bthresh:
+                bstate["pinned"] = True
+
+        def _drain_batches():
+            """Windowed drain with PER-BATCH recovery: a deferred
+            async refusal poisons the whole window, but most of its
+            batches finished on device — fetch each individually
+            (KB-scale top-k blocks), re-dispatch synchronously only
+            the batches whose own fetch refuses, and degrade ONLY the
+            batches refused twice to the per-trial ladder.  The batch
+            breaker bounds this path too: once `bthresh` consecutive
+            batches refused, remaining entries go straight to the
+            per-trial ladder instead of burning more dispatches on a
+            session already judged poisoned."""
+            if not pending:
+                # nothing drained is not a success signal: an empty
+                # flush between two dispatch-time refusals must not
+                # reset the consecutive-refusal count the breaker
+                # judges the session by
+                return
+            try:
+                _drain_ok(pending)
+                bstate["consec"] = 0
+                return
+            except REFUSED:
+                pass
+            stalled = pending[:]
+            pending.clear()
+            for s0, nr, tup in stalled:
+                if bstate["pinned"]:
+                    fallback.update(plan.rows_of(s0))
+                    continue
+                try:
+                    _drain_ok([(s0, nr, tup)])
+                    bstate["consec"] = 0
+                    continue
+                except REFUSED:
+                    pass
+                try:
+                    _drain_ok([_attempt(s0)])
+                    bstate["consec"] = 0
+                except REFUSED:
+                    _note_refused_batch(s0)
+
+        for s0 in plan.starts:
+            if bstate["pinned"]:
+                fallback.update(plan.rows_of(s0))
+                continue
+            try:
+                pending.append(_attempt(s0))
+            except REFUSED:
+                # a dispatch-time refusal may belong to a PRIOR async
+                # dispatch: flush the window, then one sync retry of
+                # THIS batch before degrading its rows
+                _drain_batches()
+                if bstate["pinned"]:
+                    fallback.update(plan.rows_of(s0))
+                    continue
+                try:
+                    _drain_ok([_attempt(s0)])
+                    bstate["consec"] = 0
+                except REFUSED:
+                    _note_refused_batch(s0)
+            if len(pending) >= SYNC_WINDOW:
+                _drain_batches()
+        _drain_batches()
+        from tpulsar.search import degraded
+        # count(), not note(): clean batched calls feed the
+        # denominator (n=0) so the recorded refusal fraction reflects
+        # actual batch coverage across the pass
+        degraded.count(
+            "accel_batches_refused", refused_batches, plan.nbatches,
+            extra="runtime refused these batched chunk dispatches "
+                  "(each retried once after a window flush); their "
+                  "rows degraded to the per-trial ladder")
+        if bstate["pinned"]:
             global _BATCH_OK
             _BATCH_OK = False
             use_batch = False
-            from tpulsar.search import degraded
-            degraded.note("accel_batch_downgraded",
-                          f"runtime rejected batched shapes: "
-                          f"{str(exc)[:160]}")
+            degraded.note(
+                "accel_batch_downgraded",
+                f"{bstate['consec']} consecutive batch dispatches "
+                "refused: batched path pinned off for this process "
+                "(per-DM accel path)")
             import warnings
-            warnings.warn("batched accel path rejected by the "
-                          f"runtime ({exc}); using per-DM fallback")
-    if not use_batch:
-        # Per-DM fallback: exactly the shapes of the proven
+            warnings.warn(
+                "batched accel path repeatedly refused by the "
+                "runtime; refused rows and later calls use the "
+                "per-DM fallback")
+    if use_batch or fallback:
+        # a degraded batch's rows ride the ladder ONLY if no other
+        # batch really wrote them: the clamped tail re-covers rows an
+        # earlier start owns (and vice versa when the tail succeeds
+        # after the earlier batch refused) — those rows hold real
+        # batched powers and must be neither recomputed nor exposed
+        # to the ladder's zero-fill rung
+        rows_todo = sorted(fallback - resolved)
+    else:
+        rows_todo = list(range(ndms))
+    rescued: dict[int, tuple] = {}
+    failed_rows: list[int] = []           # lost even after rescue
+    rescue_seconds = 0.0                  # host-recompute span
+    if rows_todo:
+        # Per-DM ladder: exactly the shapes of the proven
         # single-spectrum path ((nz, seg) iffts, no DM batch axis),
         # same windowed async dispatch.  Row dispatches can STILL be
         # rejected by the tunneled runtime (UNIMPLEMENTED observed
@@ -935,7 +1141,6 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
         # refuses many consecutive dispatches (poisoned-session
         # pattern) and routes the remaining rows straight to rescue.
         pending = []
-        failed_rows: list[int] = []       # lost even after rescue
         refused_rows: list[int] = []      # refused twice -> rescue
         undispatched = 0                  # breaker-skipped, never sent
         # named breaker: its open/closed transitions land in the
@@ -983,7 +1188,7 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                     except REFUSED:
                         pass
                     try:
-                        _drain([(r, nr, row_fn(spectra, bank_fft,
+                        _drain([(r, nr, row_fn(block, bank_fft,
                                                r))])
                         breaker.record_success()
                     except REFUSED:
@@ -1004,7 +1209,7 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
         row_retry = rpolicy.RetryPolicy(max_attempts=2,
                                         retry_on=REFUSED)
 
-        for i in range(ndms):
+        for i in rows_todo:
             if shortcut and not breaker.allow():
                 # the session refused `threshold` consecutive
                 # dispatches: classify the rest as refused without
@@ -1015,7 +1220,7 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                 continue
             try:
                 pending.append((i, 1, rpolicy.call(
-                    lambda: row_fn(spectra, bank_fft, i), row_retry,
+                    lambda: row_fn(block, bank_fft, i), row_retry,
                     breaker=breaker if shortcut else None,
                     on_retry=lambda k, e: _safe_drain(),
                     label="accel.row_dispatch")))
@@ -1025,13 +1230,14 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                 _safe_drain()
         _safe_drain()
 
-        rescued: dict[int, tuple] = {}
         recompute_ran = False
         if refused_rows:
             todo = sorted(set(refused_rows))
+            t_rescue = _time.perf_counter()
             rescued, recompute_ran = rescue_mod.rescue_accel_rows(
-                spectra, bank, todo, max_numharm=max_numharm,
+                block, bank, todo, max_numharm=max_numharm,
                 topk=topk)
+            rescue_seconds = _time.perf_counter() - t_rescue
             for r, tup in rescued.items():
                 vals[r], rbins[r], zidx[r] = tup
             _zero_fill([r for r in todo if r not in rescued])
@@ -1130,6 +1336,55 @@ def accel_search_batch(spectra: jnp.ndarray, bank: TemplateBank,
                 f"accel per-DM fallback: {len(rescued)}/{ndms} rows "
                 "refused by the runtime and recomputed on the host "
                 "CPU backend (provenance recorded; no science lost)")
+    # path-labelled throughput instruments: every DM trial whose
+    # powers are REAL (not a zero-fill placeholder) is counted once
+    # by the path that produced them — batched (fused DM-batch chunk
+    # program), per_dm (per-trial row dispatch), rescued (host-CPU
+    # recompute).  Zero-filled losses are visible in
+    # tpulsar_rescue_rows_total{outcome=lost} and the degraded
+    # ledger, never here.  With the stage-seconds histogram below
+    # this yields dm_trials_per_sec per dispatch path — the bench
+    # --accel A/B's headline, continuously exported.
+    from tpulsar.obs import telemetry as _tm
+    n_batched = ndms - len(rows_todo)
+    n_rescued = len(rescued)
+    n_perdm = len(rows_todo) - n_rescued - len(set(failed_rows))
+    if n_batched:
+        _tm.accel_batch_trials_total().inc(n_batched, path="batched")
+    if n_perdm:
+        _tm.accel_batch_trials_total().inc(n_perdm, path="per_dm")
+    if n_rescued:
+        _tm.accel_batch_trials_total().inc(n_rescued, path="rescued")
+    # Seconds follow the trials: the host-recompute span is observed
+    # under the rescued path only when the rescue DELIVERED rows
+    # (same discipline as the executor's chunk rescue), and the rest
+    # of the call under the path that produced the dispatched rows —
+    # seconds and trials must describe the same work or the derived
+    # per-path dm_trials_per_sec skews: rescued reading infinite
+    # against zero seconds, per_dm toward zero with the slow
+    # recompute span booked against trials it never produced.  A
+    # failed rescue's span stays in the dispatching path's bucket.
+    if not n_rescued:
+        rescue_seconds = 0.0
+    if n_batched:
+        primary = "batched"
+    elif n_perdm:
+        primary = "per_dm"
+    else:
+        # nothing delivered batched or per-DM (all-refused ->
+        # all-rescued; an all-lost call raised above): the residual
+        # dispatch overhead is part of the cost of the rescued rows,
+        # not a phantom per_dm series
+        primary = "rescued"
+    residual = _time.perf_counter() - t_begin - rescue_seconds
+    if primary == "rescued":
+        _tm.accel_stage_seconds().observe(rescue_seconds + residual,
+                                          path="rescued")
+    else:
+        if n_rescued:
+            _tm.accel_stage_seconds().observe(rescue_seconds,
+                                              path="rescued")
+        _tm.accel_stage_seconds().observe(residual, path=primary)
     zs = np.asarray(bank.zs)
     return {h: (vals[:, si_, :], rbins[:, si_, :], zs[zidx[:, si_, :]])
             for si_, h in enumerate(stages)}

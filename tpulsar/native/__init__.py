@@ -119,6 +119,21 @@ def load() -> ctypes.CDLL | None:
             i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             f32p, i32p, i32p]
         lib.tpulsar_accel_stage_topk_segs.restype = None
+        # z-chunked pieces entrypoint: guarded — a library built from
+        # an older source tree (mtime equal after a clock-skewed
+        # copy) simply lacks the symbol and callers fall back to the
+        # assembled-pieces layout
+        try:
+            zfn = lib.tpulsar_accel_stage_topk_zsegs
+            zfn.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+                ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, i32p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, f32p, i32p, i32p]
+            zfn.restype = None
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -214,6 +229,68 @@ def accel_stage_topk_segs(pieces: np.ndarray, width: int, nr: int,
     lib.tpulsar_accel_stage_topk_segs(
         pieces, nd, nsegs, nz, two_step, int(width), int(nr),
         stages, ns, int(block_r), int(topk), vals, rbins, zidx)
+    return vals, rbins, zidx
+
+
+def has_accel_zsegs() -> bool:
+    """True when the library is loadable AND carries the z-chunked
+    pieces entrypoint (a stale build without it falls back to the
+    assembled-pieces layout instead of failing mid-run)."""
+    lib = load()
+    return lib is not None and hasattr(lib,
+                                       "tpulsar_accel_stage_topk_zsegs")
+
+
+def accel_stage_topk_zsegs(pieces: list, width: int, nr: int,
+                           stages, block_r: int, topk: int):
+    """accel_stage_topk over pieces still SPLIT by z-chunk: one
+    (nd, nsegs, zc, 2*step) float32 buffer per chunk of the jitted
+    correlate program's z loop (kernels/accel._correlate_zpieces),
+    addressed through a pointer table — the full-plane concatenate
+    never happens on either side.  All chunks share zc except the
+    last, which holds the ragged nz remainder.  Returns
+    (vals, rbins, zidx) each (nd, nstages, topk), or None if the
+    library (or the entrypoint) is unavailable or the layout is
+    inconsistent."""
+    if not has_accel_zsegs():
+        return None
+    lib = load()
+    stages = np.ascontiguousarray(stages, dtype=np.int32)
+    if stages.size == 0 or stages[0] != 1:
+        return None     # the kernel seeds its accumulator at stage 1
+    if not pieces:
+        return None
+    arrs = [np.ascontiguousarray(p) for p in pieces]
+    first = arrs[0]
+    if first.dtype != np.float32 or first.ndim != 4:
+        return None
+    nd, nsegs, zchunk, two_step = first.shape
+    nz = 0
+    for i, p in enumerate(arrs):
+        if (p.dtype != np.float32 or p.ndim != 4
+                or p.shape[0] != nd or p.shape[1] != nsegs
+                or p.shape[3] != two_step):
+            return None
+        # every chunk but the last must be full-height; the last
+        # holds the ragged remainder, 1..zchunk rows — taller and
+        # ZSegSrc::slab_at's q = zi / zchunk would index past the
+        # pointer table
+        if i < len(arrs) - 1 and p.shape[2] != zchunk:
+            return None
+        if not 1 <= p.shape[2] <= zchunk:
+            return None
+        nz += p.shape[2]
+    ns = int(stages.size)
+    vals = np.empty((nd, ns, topk), np.float32)
+    rbins = np.empty((nd, ns, topk), np.int32)
+    zidx = np.empty((nd, ns, topk), np.int32)
+    import ctypes as _ct
+    table = (_ct.c_void_p * len(arrs))(
+        *[p.ctypes.data for p in arrs])
+    lib.tpulsar_accel_stage_topk_zsegs(
+        table, len(arrs), int(zchunk), nd, nsegs, int(nz),
+        int(two_step), int(width), int(nr), stages, ns, int(block_r),
+        int(topk), vals, rbins, zidx)
     return vals, rbins, zidx
 
 

@@ -17,7 +17,7 @@
 //     block index (lax.top_k semantics), -inf padding for the ragged
 //     tail block, zero padding when there are fewer blocks than k.
 //
-// Two plane layouts share the tiled core via the Src template:
+// Three plane layouts share the tiled core via the Src template:
 //   * PlaneSrc — the assembled (nd, nz, nr) plane (what the jitted
 //     _correlate_block emits after its transpose/concat/pad);
 //   * SegSrc — the raw overlap-save pieces (nd, nsegs, nz, 2*step)
@@ -27,6 +27,12 @@
 //     the zero pad.  Consuming this layout lets the jitted correlate
 //     program skip its transpose+concat+pad — three full-plane
 //     copies per DM chunk.
+//   * ZSegSrc — the same pieces still SPLIT by z-chunk: one buffer
+//     per z-chunk of the correlate program's z loop, each
+//     (nd, nsegs, zc_q, 2*step), addressed through a pointer table.
+//     Consuming the chunks directly drops the remaining full-plane
+//     concatenate inside the jitted pieces program (~25% of the
+//     batched CPU plane construction at survey shapes).
 //
 // The TPU path never calls this: on device the same math runs as the
 // jitted _accel_block_topk program.  (Replaces the compute PRESTO's
@@ -63,8 +69,12 @@ static inline int rowmap(int hh, int zi, int nz) {
 
 // Assembled plane: row-contiguous (nz, nr) per DM.
 struct PlaneSrc {
+  const float* base;
+  size_t per_dm;
   const float* P;   // this DM's (nz, nr) plane
   int64_t nr;
+
+  void select_dm(int64_t d) { P = base + (size_t)d * per_dm; }
 
   // dst[0..w) = plane[zi, c0 .. c0+w)
   void seed(int zi, int64_t c0, int64_t w, float* dst) const {
@@ -78,17 +88,19 @@ struct PlaneSrc {
   }
 };
 
-// Raw overlap-save pieces: (nsegs, nz, two_step) per DM, plane col c
-// = pieces[(c - width) / two_step, zi, (c - width) % two_step], and
-// zero for c < width (the XLA path's left pad).
-struct SegSrc {
-  const float* P;   // this DM's (nsegs, nz, two_step) pieces
-  int nz;
+// Raw overlap-save pieces addressed as slabs: plane col c =
+// slab((c - width) / two_step, zi)[(c - width) % two_step], zero for
+// c < width (the XLA path's left pad).  CRTP base so the slab lookup
+// is the ONLY difference between the contiguous (SegSrc) and
+// z-chunked (ZSegSrc) layouts — the seed/accum arithmetic (and so
+// the bit-exact float addition order) is one copy.
+template <class Derived>
+struct SegAddressed {
   int64_t two_step;
   int64_t width;
 
   inline const float* slab(int64_t s, int zi) const {
-    return P + ((size_t)s * nz + zi) * two_step;
+    return static_cast<const Derived*>(this)->slab_at(s, zi);
   }
 
   void seed(int zi, int64_t c0, int64_t w, float* dst) const {
@@ -125,9 +137,47 @@ struct SegSrc {
   }
 };
 
+// One contiguous (nsegs, nz, two_step) buffer per DM.
+struct SegSrc : SegAddressed<SegSrc> {
+  const float* base;
+  size_t per_dm;
+  const float* P;
+  int nz;
+
+  void select_dm(int64_t d) { P = base + (size_t)d * per_dm; }
+
+  inline const float* slab_at(int64_t s, int zi) const {
+    return P + ((size_t)s * nz + zi) * two_step;
+  }
+};
+
+// Pieces still split by z-chunk: chunk q holds z rows
+// [q*zchunk, q*zchunk + zdim(q)) as (nd, nsegs, zdim, two_step);
+// the select_dm offset is recomputed per chunk because the last
+// chunk's zdim is the ragged nz remainder.
+struct ZSegSrc : SegAddressed<ZSegSrc> {
+  const float* const* chunks;
+  int nchunks;
+  int zchunk;
+  int nz;
+  int64_t nsegs;
+  int64_t dm;
+
+  void select_dm(int64_t d) { dm = d; }
+
+  inline int zdim(int q) const {
+    return q == nchunks - 1 ? nz - q * zchunk : zchunk;
+  }
+
+  inline const float* slab_at(int64_t s, int zi) const {
+    const int q = zi / zchunk, lz = zi - q * zchunk;
+    return chunks[q]
+        + (((size_t)dm * nsegs + s) * zdim(q) + lz) * two_step;
+  }
+};
+
 template <class Src>
 void stage_topk_core(const Src& src_proto,
-                     const float* base, size_t per_dm,
                      int64_t nd, int nz, int64_t nr,
                      const int* stages, int nstages, int block_r,
                      int topk, float* vals, int32_t* rbins,
@@ -165,7 +215,7 @@ void stage_topk_core(const Src& src_proto,
 
   for (int64_t d = 0; d < nd; ++d) {
     Src src = src_proto;
-    src.P = base + (size_t)d * per_dm;
+    src.select_dm(d);
     for (int s = 0; s < nstages; ++s) {
       bmax[s].assign((size_t)plan[s].nb, NEG_INF);
       bcol[s].assign((size_t)plan[s].nb, 0);
@@ -268,10 +318,12 @@ void tpulsar_accel_stage_topk(
     const int* stages, int nstages, int block_r, int topk,
     float* vals, int32_t* rbins, int32_t* zidx) {
   PlaneSrc proto;
+  proto.base = plane;
+  proto.per_dm = (size_t)nz * nr;
   proto.P = nullptr;
   proto.nr = nr;
-  stage_topk_core(proto, plane, (size_t)nz * nr, nd, nz, nr, stages,
-                  nstages, block_r, topk, vals, rbins, zidx);
+  stage_topk_core(proto, nd, nz, nr, stages, nstages, block_r, topk,
+                  vals, rbins, zidx);
 }
 
 // pieces: (nd, nsegs, nz, two_step) float32 — the overlap-save
@@ -284,13 +336,40 @@ void tpulsar_accel_stage_topk_segs(
     const int* stages, int nstages, int block_r, int topk,
     float* vals, int32_t* rbins, int32_t* zidx) {
   SegSrc proto;
+  proto.base = pieces;
+  proto.per_dm = (size_t)nsegs * nz * two_step;
   proto.P = nullptr;
   proto.nz = nz;
   proto.two_step = two_step;
   proto.width = width;
-  stage_topk_core(proto, pieces, (size_t)nsegs * nz * two_step, nd,
-                  nz, nr, stages, nstages, block_r, topk, vals, rbins,
-                  zidx);
+  stage_topk_core(proto, nd, nz, nr, stages, nstages, block_r, topk,
+                  vals, rbins, zidx);
+}
+
+// chunks: nchunks buffers, chunk q = (nd, nsegs, zdim(q), two_step)
+// float32 — the overlap-save powers still SPLIT by z-chunk, exactly
+// as the jitted z loop produces them (no concatenate anywhere).
+// zchunk is the z height of every chunk but the last (which holds
+// the ragged nz remainder).  Same math, same float order, same
+// tie-breaking as the other two layouts: only slab addressing
+// differs (ZSegSrc::slab_at).
+void tpulsar_accel_stage_topk_zsegs(
+    const float* const* chunks, int nchunks, int zchunk,
+    int64_t nd, int64_t nsegs, int nz, int64_t two_step,
+    int64_t width, int64_t nr,
+    const int* stages, int nstages, int block_r, int topk,
+    float* vals, int32_t* rbins, int32_t* zidx) {
+  ZSegSrc proto;
+  proto.chunks = chunks;
+  proto.nchunks = nchunks;
+  proto.zchunk = zchunk;
+  proto.nz = nz;
+  proto.nsegs = nsegs;
+  proto.dm = 0;
+  proto.two_step = two_step;
+  proto.width = width;
+  stage_topk_core(proto, nd, nz, nr, stages, nstages, block_r, topk,
+                  vals, rbins, zidx);
 }
 
 }  // extern "C"

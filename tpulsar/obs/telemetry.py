@@ -125,6 +125,33 @@ def rescue_rows_total() -> metrics.Counter:
         labelnames=("outcome",))
 
 
+def accel_batch_trials_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_accel_batch_trials_total",
+        "hi-accel DM trials by the dispatch path that produced their "
+        "final powers — batched (the fused DM-batch chunk program or "
+        "its native CPU consumer), per_dm (the per-trial row "
+        "dispatch a degraded batch fell back to), rescued (host-CPU "
+        "recompute of refused rows).  Disjoint, and only REAL powers "
+        "count: zero-filled losses live in "
+        "tpulsar_rescue_rows_total{outcome=lost} and the degraded "
+        "ledger, never here — with "
+        "tpulsar_accel_stage_seconds this yields dm_trials_per_sec "
+        "per dispatch path",
+        labelnames=("path",))
+
+
+def accel_stage_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_accel_stage_seconds",
+        "wall seconds per hi-accel stage call, by path: batched = "
+        "at least one fused DM-batch dispatch resolved rows (the "
+        "healthy route), per_dm = the per-trial ladder handled the "
+        "whole call, rescued = the executor's whole-chunk host "
+        "rescue after the runtime refused every dispatch",
+        labelnames=("path",), buckets=STAGE_BUCKETS)
+
+
 def accel_undispatched_rows_total() -> metrics.Counter:
     return metrics.counter(
         "tpulsar_accel_undispatched_rows_total",

@@ -1242,15 +1242,25 @@ def _hi_accel_pass(wspec, dm_chunk, T_s, params: SearchParams
         # when no rescue is possible does the chunk's hi stage skip
         # loudly: the beam keeps its SP, lo, fold, and other chunks'
         # hi science instead of dying with nothing recorded.
+        import time as _time
+
         from tpulsar.obs import telemetry
         from tpulsar.resilience import rescue
         chunk_res = None
+        t_rescue = _time.perf_counter()
         if not getattr(exc, "rescue_exhausted", False):
             with telemetry.trace.span("accel_chunk_rescue",
                                       n=len(dm_chunk)):
                 chunk_res = rescue.rescue_accel_chunk(
                     wspec, bank, max_numharm=params.hi_accel_numharm,
                     topk=params.topk_per_stage)
+        if chunk_res is not None:
+            # observed only when the rescue DELIVERED rows — the
+            # trials counter and this histogram must describe the
+            # same calls or the derived per-path dm_trials_per_sec
+            # skews toward zero on a fleet with failing rescues
+            telemetry.accel_stage_seconds().observe(
+                _time.perf_counter() - t_rescue, path="rescued")
         if chunk_res is None:
             degraded.count("accel_hi_chunk_skipped", len(dm_chunk),
                            len(dm_chunk), extra=str(exc)[:160])
@@ -1262,6 +1272,11 @@ def _hi_accel_pass(wspec, dm_chunk, T_s, params: SearchParams
         res, lost_rows = chunk_res
         n_ok = len(dm_chunk) - len(lost_rows)
         telemetry.rescue_rows_total().inc(n_ok, outcome="rescued")
+        if n_ok:
+            # the kernel raised before its own trials accounting, so
+            # the chunk-rescued rows are counted HERE, once
+            telemetry.accel_batch_trials_total().inc(n_ok,
+                                                     path="rescued")
         if lost_rows:
             telemetry.rescue_rows_total().inc(len(lost_rows),
                                               outcome="lost")
