@@ -1436,6 +1436,167 @@ def run_serve() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_beambatch() -> None:
+    """``bench.py --beambatch``: B=1 serial vs B=N coalesced
+    batch-of-beams throughput (executor.search_beam vs
+    executor.search_beam_batch) on N identical-geometry synthetic
+    beams — the number that justifies batched admission for
+    small-beam surveys (per-dispatch overhead, not per-beam compute,
+    dominates their wall clock; the hi-accel FDAS stage alone is ~80%
+    of a warm tiny beam and coalesces across beams).
+
+    Both sides run the FULL per-beam path (read + RFI + plan loop +
+    sift/refine/fold + artifacts) warm: one untimed warmup cycle per
+    side compiles both paths' programs, then ``reps`` interleaved
+    measurements (order alternating per rep so shared-host capacity
+    drift cannot masquerade as the contrast) and medians are
+    reported.  Per-beam candidate parity between the paths is
+    asserted BIT-EXACT (same candidates, same float bits, same SP
+    events) — `parity_ok` rides the record and CI gates it
+    un-toleranced.  Emits one bench/v2 record with an additive
+    ``beambatch`` key."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from tpulsar.io import synth
+    from tpulsar.search import executor
+
+    nbeams = int(os.environ.get("TPULSAR_BEAMBATCH_NBEAMS", "8"))
+    nchan = int(os.environ.get("TPULSAR_BEAMBATCH_NCHAN", "32"))
+    nsamp = int(os.environ.get("TPULSAR_BEAMBATCH_NSAMP",
+                               str(1 << 11)))
+    # a survey-realistic DM depth: the deeper the DM range, the more
+    # small per-chunk dispatches each SOLO beam pays for (the batched
+    # side coalesces them B-wide), so shallow dm_max UNDERSTATES the
+    # coalescing win the admission batch exists for
+    dm_max = float(os.environ.get("TPULSAR_BEAMBATCH_DM_MAX", "120"))
+    accel = os.environ.get("TPULSAR_BEAMBATCH_ACCEL", "1") == "1"
+    reps = int(os.environ.get("TPULSAR_BEAMBATCH_REPS", "3"))
+    # the small-beam-survey device shape: a modest z range and a
+    # tight per-chunk DM budget (the HBM-constrained regime batching
+    # exists for) — solo dispatches are SMALL, which is exactly what
+    # the coalesced path amortizes
+    zmax = int(os.environ.get("TPULSAR_BEAMBATCH_ZMAX", "20"))
+    dm_chunk = int(os.environ.get("TPULSAR_BEAMBATCH_CHUNK", "19"))
+    base = tempfile.mkdtemp(prefix="tpulsar_beambatch_")
+    os.environ.setdefault("TPULSAR_CACHE_DIR",
+                          os.path.join(base, "cache"))
+    _aot_cachedir.activate()
+
+    psr = synth.PulsarSpec(period_s=0.05, dm=20.0,
+                           snr_per_sample=1.5)
+    beams = []
+    for i in range(nbeams):
+        spec = synth.BeamSpec(nchan=nchan, nsamp=nsamp, nsblk=64,
+                              nbits=4, tsamp_s=5.24288e-4,
+                              scan=100 + i)
+        beams.append(synth.synth_beam(
+            os.path.join(base, f"data{i}"), spec, pulsars=[psr],
+            merged=True))
+    params = executor.SearchParams(dm_max=dm_max,
+                                   run_hi_accel=accel,
+                                   hi_accel_zmax=zmax,
+                                   max_dms_per_chunk=dm_chunk,
+                                   sp_threshold=float(os.environ.get(
+                                       "TPULSAR_BEAMBATCH_SP_THRESH",
+                                       "8")),
+                                   max_cands_to_fold=1,
+                                   make_plots=False)
+    seq = [0]
+
+    def run_solo():
+        seq[0] += 1
+        outs = []
+        t0 = time.time()
+        for i, fns in enumerate(beams):
+            outs.append(executor.search_beam(
+                fns, os.path.join(base, f"w{seq[0]}_{i}"),
+                os.path.join(base, f"r{seq[0]}_{i}"), params))
+        return time.time() - t0, outs
+
+    def run_batched():
+        seq[0] += 1
+        specs = [executor.BeamSpec(
+            fns=fns, workdir=os.path.join(base, f"w{seq[0]}_{i}"),
+            resultsdir=os.path.join(base, f"r{seq[0]}_{i}"))
+            for i, fns in enumerate(beams)]
+        t0 = time.time()
+        res = executor.search_beam_batch(specs, params)
+        dt = time.time() - t0
+        bad = [(r.path, r.fallout, str(r.error)[:120]) for r in res
+               if r.path != "batched" or r.error is not None]
+        if bad:
+            raise RuntimeError(f"beams fell out of the batch: {bad}")
+        return dt, [r.outcome for r in res], sorted(
+            {r.group_size for r in res})
+
+    _log(f"beambatch warmup: {nbeams} beams nchan={nchan} "
+         f"nsamp={nsamp} dm_max={dm_max:g} accel={accel}")
+    _, solo_ref = run_solo()
+    _, bat_ref, group_sizes = run_batched()
+
+    fields = ("r", "z", "sigma", "power", "numharm", "dm",
+              "period_s", "freq_hz")
+    parity_beams = 0
+    parity_ok = True
+    for s, b in zip(solo_ref, bat_ref):
+        beam_ok = (s.num_dm_trials == b.num_dm_trials
+                   and len(s.candidates) == len(b.candidates)
+                   and all(getattr(cs, f) == getattr(cb, f)
+                           for cs, cb in zip(s.candidates,
+                                             b.candidates)
+                           for f in fields)
+                   and s.sp_events.tobytes() == b.sp_events.tobytes())
+        parity_ok &= beam_ok
+        parity_beams += int(beam_ok)
+
+    solo_s: list[float] = []
+    bat_s: list[float] = []
+    for rep in range(reps):
+        if rep % 2 == 0:
+            tb, _, _ = run_batched()
+            ts, _ = run_solo()
+        else:
+            ts, _ = run_solo()
+            tb, _, _ = run_batched()
+        solo_s.append(round(ts, 3))
+        bat_s.append(round(tb, 3))
+        _log(f"beambatch rep{rep}: solo {ts:.2f} s "
+             f"batched {tb:.2f} s ({ts / max(tb, 1e-9):.2f}x)")
+
+    solo_med = statistics.median(solo_s)
+    bat_med = statistics.median(bat_s)
+    result = {
+        "metric": "beambatch_beams_per_sec",
+        "value": round(nbeams / max(bat_med, 1e-9), 4),
+        "unit": "beams/s",
+        "beambatch": {
+            "nbeams": nbeams, "nchan": nchan, "nsamp": nsamp,
+            "dm_max": dm_max, "accel": accel, "reps": reps,
+            "solo": {
+                "seconds": solo_med,
+                "seconds_reps": solo_s,
+                "beams_per_sec": round(nbeams / max(solo_med, 1e-9),
+                                       4),
+            },
+            "batched": {
+                "seconds": bat_med,
+                "seconds_reps": bat_s,
+                "beams_per_sec": round(nbeams / max(bat_med, 1e-9),
+                                       4),
+                "group_sizes": group_sizes,
+            },
+            "speedup": round(solo_med / max(bat_med, 1e-9), 3),
+            "parity_ok": parity_ok,
+            "parity_beams": parity_beams,
+        },
+    }
+    _emit(result)
+    if os.environ.get("TPULSAR_BEAMBATCH_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def run_gateway() -> None:
     """``bench.py --gateway``: push N synthetic beams through the
     HTTP front door (tpulsar/frontdoor/) backed by one resident warm
@@ -2281,6 +2442,9 @@ def main() -> None:
         return
     if "--accel" in sys.argv:
         run_accel_ab()
+        return
+    if "--beambatch" in sys.argv:
+        run_beambatch()
         return
     if "--fleet" in sys.argv:
         run_fleet()

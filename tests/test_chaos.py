@@ -478,3 +478,88 @@ def test_chaos_cli_verify_flags_violations(tmp_path, capsys):
     rc = cli_main(["chaos", "verify", "--spool", spool2])
     assert rc == 0
     assert "PASS" in capsys.readouterr().out
+
+
+def test_scenario_batch_field_validates_and_reaches_worker_cmd(
+        tmp_path):
+    with pytest.raises(ValueError, match="batch"):
+        scenario.from_dict({"workload": {"beams": 1}, "batch": 0})
+    sc = scenario.from_dict({"workload": {"beams": 1}, "batch": 3})
+    r = runner.ChaosRunner(sc, str(tmp_path / "s"))
+    cmd = r._worker_cmd("w0")
+    assert "--batch" in cmd and cmd[cmd.index("--batch") + 1] == "3"
+    # batch 1 = single-ticket claims, no flag
+    sc1 = scenario.from_dict({"workload": {"beams": 1}})
+    assert "--batch" not in runner.ChaosRunner(
+        sc1, str(tmp_path / "s1"))._worker_cmd("w0")
+
+
+def test_mid_batch_sigkill_requeues_each_batchmate_exactly_once(
+        tmp_path):
+    """The satellite case: a worker dies (hard exit, SIGKILL
+    footprint) after finishing the FIRST beam of a 3-ticket batch.
+    Its remaining batchmates must be requeued INDIVIDUALLY by the
+    janitor (one takeover strike each), finished by a second batch
+    worker, and the journal must satisfy every invariant at 0
+    violations — exactly-once and attempts-monotone hold under batch
+    claims."""
+    import subprocess
+    spool = str(tmp_path / "spool")
+    for i in range(5):
+        protocol.write_ticket(spool, f"t{i}", ["f"],
+                              str(tmp_path / f"out{i}"), beam_s=0.05)
+    p = subprocess.run(
+        [sys.executable, "-m", "tpulsar.chaos.worker", "--spool",
+         spool, "--worker-id", "w0", "--batch", "3",
+         "--crash-mid-batch", "--beam-s", "0.05", "--once"],
+        timeout=120)
+    assert p.returncode == 70
+    # one durable result (the finished first beam), two held claims
+    assert protocol.state_count(spool, "done") == 1
+    assert protocol.claimed_count(spool) == 2
+    requeued = protocol.requeue_stale_claims(spool)
+    assert sorted(requeued) == ["t1", "t2"]
+    p2 = subprocess.run(
+        [sys.executable, "-m", "tpulsar.chaos.worker", "--spool",
+         spool, "--worker-id", "w1", "--batch", "3", "--beam-s",
+         "0.05", "--once"], timeout=120)
+    assert p2.returncode == 0
+    assert sorted(protocol.list_tickets(spool, "done")) \
+        == [f"t{i}" for i in range(5)]
+    evs = journal.read_events(spool)
+    bd = [e for e in evs if e["event"] == "batch_dispatch"]
+    assert bd and all(e["beams"] >= 1 and e["tickets"] for e in bd)
+    # the requeued batchmates carry exactly one strike each
+    takeovers = [e for e in evs if e["event"] == "takeover"]
+    assert sorted(e["ticket"] for e in takeovers) == ["t1", "t2"]
+    assert all(e["attempt"] == 1 for e in takeovers)
+    report = invariants.verify(spool)
+    assert report["ok"], report["violations"]
+    assert report["checked"]["terminal"] == 5
+
+
+def test_batch_admission_storm_passes_invariants(tmp_path):
+    """A live 2-worker storm with batch admission enabled (the
+    acceptance smoke): batched claims + a SIGKILL mid-backlog, every
+    beam terminal exactly once, verifier at 0 violations."""
+    spool = str(tmp_path / "spool")
+    sc = scenario.from_dict({
+        "name": "mini-batch", "seed": 7, "duration_s": 60.0,
+        "workers": 2, "worker_kind": "stub", "beam_s": 0.15,
+        "batch": 3, "poll_s": 0.2,
+        "workload": {"beams": 8, "interval_s": 0.05},
+        "timeline": [
+            {"t": 0.5, "action": "kill_worker", "worker": "w0",
+             "signal": "KILL"},
+        ],
+        "quiesce_timeout_s": 40.0})
+    manifest = runner.run_scenario(sc, spool)
+    assert manifest["quiesced"], manifest
+    for tid in manifest["tickets"]:
+        rec = protocol.read_result(spool, tid)
+        assert rec is not None and rec["status"] == "done", (tid, rec)
+    evs = journal.read_events(spool)
+    assert any(e["event"] == "batch_dispatch" for e in evs)
+    report = invariants.verify(spool, max_attempts=sc.max_attempts)
+    assert report["ok"], report["violations"]
+    assert report["checked"]["terminal"] == 8

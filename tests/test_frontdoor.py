@@ -498,3 +498,64 @@ def test_router_rotates_ties():
     router = _router({"a": 4, "b": 4})
     seen = {router.choose().name for _ in range(4)}
     assert seen == {"a", "b"}
+
+
+# --------------------------------------------------------------------
+# batched claims (contract extension: claim_batch on both backends)
+# --------------------------------------------------------------------
+
+def test_contract_claim_batch_compat_grouping_exactly_once(q):
+    """One ordering pass claims up to N COMPATIBLE tickets; a
+    mismatching compat stays pending IN PLACE, and every member is an
+    individually owner-stamped exclusive claim."""
+    for i in range(6):
+        q.submit(f"b{i}", ["/x"], "/o",
+                 compat="K" if i % 2 == 0 else "L")
+    got = q.claim_batch(4, "w0")
+    assert [r["ticket"] for r in got] == ["b0", "b2", "b4"]
+    assert all(r["claimed_by"] == os.getpid()
+               and r["claimed_by_worker"] == "w0" for r in got)
+    assert q.pending_count() == 3
+    # the skipped L tickets are claimable next, in order
+    got2 = q.claim_batch(4, "w1")
+    assert [r["ticket"] for r in got2] == ["b1", "b3", "b5"]
+    assert q.pending_count() == 0
+    # exactly-once: nothing doubled, nothing lost
+    claimed = {r["ticket"] for r in got + got2}
+    assert len(claimed) == 6
+
+
+def test_contract_claim_batch_pinned_compat_and_empty(q):
+    q.submit("x0", ["/x"], "/o", compat="K")
+    q.submit("x1", ["/x"], "/o", compat="L")
+    got = q.claim_batch(4, "w0", compat="L")
+    assert [r["ticket"] for r in got] == ["x1"]
+    assert q.claim_batch(0, "w0") == []
+    assert q.ticket_state("x0") == "incoming"
+
+
+def test_contract_batch_claims_respect_quota_and_priority(q):
+    """Satellite acceptance: batched claims respect tenant
+    max_inflight quotas and priority across the WHOLE batch — a
+    low-priority tenant's batchmates never displace a high-priority
+    single, and the batch cannot overshoot the quota."""
+    pol = tenancy.TenantPolicy({
+        "bulk": {"priority": "low", "max_inflight": 2},
+        "vip": {"priority": "high"}})
+    for i in range(5):
+        q.submit(f"bulk{i}", ["/x"], "/o", tenant="bulk")
+    q.submit("vip0", ["/x"], "/o", tenant="vip")
+    got = q.claim_batch(4, "w0", policy=pol)
+    names = [r["ticket"] for r in got]
+    # the high-priority single leads the batch (priority ordering
+    # spans the batch), and bulk contributes at most its quota
+    assert names[0] == "vip0"
+    assert [n for n in names if n.startswith("bulk")] \
+        == ["bulk0", "bulk1"]
+    assert len(names) == 3
+    # bulk is at max_inflight: a second batch claim gets nothing
+    assert q.claim_batch(4, "w1", policy=pol) == []
+    # releasing one bulk beam frees exactly one quota slot
+    q.write_result("bulk0", "done", worker="w0")
+    got3 = q.claim_batch(4, "w1", policy=pol)
+    assert [r["ticket"] for r in got3] == ["bulk2"]

@@ -419,3 +419,95 @@ def test_get_queue_manager_registers_warm(tmp_path):
     for m in ("submit", "can_submit", "is_running", "delete",
               "status", "had_errors", "get_errors"):
         assert callable(getattr(qm, m))
+
+
+# ------------------------------------------------------ batched admission
+
+def test_serve_batch_mode_coalesces_and_finishes_each_ticket(
+        tmp_path, cfg):
+    """serve --batch N: one claim_batch admission pass, ONE
+    batch_dispatch journal event naming the members, per-ticket
+    search_start and durable results — per-beam discipline unchanged
+    by coalesced dispatch."""
+    from tpulsar.obs import journal
+
+    spool = tmp_path / "spool"
+    beams = _beam_files(tmp_path, 3)
+    for i, fns in enumerate(beams):
+        protocol.write_ticket(str(spool), f"b{i}", fns,
+                              str(tmp_path / f"out{i}"), job_id=i)
+    batches = []
+
+    def batch_stub(prepared_list):
+        assert all(p.ppfns and os.path.exists(p.ppfns[0])
+                   for p in prepared_list)     # really staged
+        batches.append([p.ticket_id for p in prepared_list])
+        return [("done", _fake_outcome(), "batched")
+                for _ in prepared_list]
+
+    srv = _server(spool, cfg, batch_size=3, batch_linger_s=0.2,
+                  batch_fn=batch_stub)
+    assert srv.serve(once=True) == 0
+    assert sorted(t for b in batches for t in b) == ["b0", "b1", "b2"]
+    for i in range(3):
+        rec = protocol.read_result(str(spool), f"b{i}")
+        assert rec["status"] == "done", rec
+        assert rec["batch_path"] == "batched"
+    evs = journal.read_events(str(spool))
+    bd = [e for e in evs if e["event"] == "batch_dispatch"]
+    assert bd and sum(e["beams"] for e in bd) == 3
+    assert len([e for e in evs
+                if e["event"] == "search_start"]) == 3
+
+
+def test_serve_batch_partial_dispatches_after_linger(tmp_path, cfg):
+    """A partial batch must dispatch after the bounded linger window
+    instead of starving: 2 tickets, batch size 3."""
+    spool = tmp_path / "spool"
+    beams = _beam_files(tmp_path, 2)
+    for i, fns in enumerate(beams):
+        protocol.write_ticket(str(spool), f"p{i}", fns,
+                              str(tmp_path / f"out{i}"), job_id=i)
+    sizes = []
+
+    def batch_stub(prepared_list):
+        sizes.append(len(prepared_list))
+        return [("done", _fake_outcome(), "batched")
+                for _ in prepared_list]
+
+    srv = _server(spool, cfg, batch_size=3, batch_linger_s=0.2,
+                  batch_fn=batch_stub)
+    assert srv.serve(once=True) == 0
+    assert sizes == [2]
+    assert all(protocol.read_result(str(spool), f"p{i}")["status"]
+               == "done" for i in range(2))
+
+
+def test_serve_batch_per_beam_failure_isolated(tmp_path, cfg):
+    """A beam that fails inside the batch fails ITS ticket only —
+    batchmates finish normally (the executor's per-beam degradation
+    surfaces as a per-job failed tuple, never an exception)."""
+    spool = tmp_path / "spool"
+    beams = _beam_files(tmp_path, 2)
+    for i, fns in enumerate(beams):
+        protocol.write_ticket(str(spool), f"f{i}", fns,
+                              str(tmp_path / f"out{i}"), job_id=i)
+
+    def batch_stub(prepared_list):
+        out = []
+        for k, p in enumerate(sorted(prepared_list,
+                                     key=lambda p: p.ticket_id)):
+            out.append(("done", _fake_outcome(), "batched") if k == 0
+                       else ("failed", RuntimeError("poisoned beam"),
+                             "solo"))
+        return out
+
+    srv = _server(spool, cfg, batch_size=2, batch_linger_s=0.2,
+                  batch_fn=batch_stub)
+    assert srv.serve(once=True) == 0
+    recs = {i: protocol.read_result(str(spool), f"f{i}")
+            for i in range(2)}
+    statuses = sorted(r["status"] for r in recs.values())
+    assert statuses == ["done", "failed"]
+    failed = next(r for r in recs.values() if r["status"] == "failed")
+    assert "poisoned beam" in failed["error"]

@@ -67,6 +67,9 @@ DEFAULT_KEYS = (
     ("accel.batched.dm_trials_per_sec", "higher"),
     ("accel.per_dm.dm_trials_per_sec", "higher"),
     ("accel.speedup", "higher"),
+    ("beambatch.batched.beams_per_sec", "higher"),
+    ("beambatch.solo.beams_per_sec", "higher"),
+    ("beambatch.speedup", "higher"),
     ("gateway.submit_to_result_p50_s", "lower"),
     ("gateway.submit_to_result_warm_s", "lower"),
     ("gateway.status_http_ms", "lower"),

@@ -193,6 +193,19 @@ PROGRAMS: tuple[Program, ...] = (
            "persistent-cache key (see module docstring)"),
     _k("accel", "accel_row_topk",
        ("seg", "step", "width", "nz", "max_numharm", "topk")),
+    # ---- kernels/beam_batch.py (batch-of-beams; lazy factory so the
+    # host planner imports without touching a backend)
+    Program(
+        name="beam_batch.dd_beams_scan",
+        module="tpulsar.kernels.beam_batch",
+        attr="_get_dd_beams_scan",
+        site="tpulsar/kernels/beam_batch.py::_get_dd_beams_scan",
+        statics=("pad",),
+        factory=True,
+        doc="coalesced stage-2 dedispersion: the solo scan with a "
+            "leading beam axis (bit-equal per beam); beam-group "
+            "sizes ride the shared BATCH_QUANTA ladder so the "
+            "signature set stays bounded"),
     # ---- search/refine.py (lazy factory: the module imports jax-free)
     Program(
         name="refine.gather",
@@ -323,10 +336,14 @@ class GateContext:
     plan: list
     params: "object"         # executor.SearchParams
     blk_dtype: "object"      # jnp dtype
+    #: > 1 = also gate the batch-of-beams coalesced programs at this
+    #: admission batch size (group sizes ride BATCH_QUANTA)
+    nbeams: int = 0
 
 
 def make_context(scale: float = 1.0, accel: bool = False,
-                 plan_name: str = "pdev") -> GateContext:
+                 plan_name: str = "pdev",
+                 nbeams: int = 0) -> GateContext:
     import numpy as np
 
     from tpulsar.plan import ddplan
@@ -341,6 +358,7 @@ def make_context(scale: float = 1.0, accel: bool = False,
         plan=ddplan.survey_plan(plan_name),
         params=ex.SearchParams(run_hi_accel=accel),
         blk_dtype=block_dtype(),
+        nbeams=nbeams,
     )
 
 
@@ -358,6 +376,88 @@ def gate_groups(ctx: GateContext, config: int = 0,
         groups += _config_groups(ctx, config)
     else:
         groups += _headline_groups(ctx, fast=fast)
+    if ctx.nbeams > 1:
+        groups += _beam_batch_groups(ctx)
+    return groups
+
+
+def _beam_batch_groups(ctx: GateContext
+                       ) -> list[tuple[str, list[Instance]]]:
+    """The batch-of-beams coalesced signatures an ``nbeams``-wide
+    admission batch dispatches: beam-group sizes from the SAME
+    plan_beam_groups ladder decomposition the executor runs, stage
+    1/2 with the beam axis folded in (stage 1 = the registered
+    _form_subbands_jit at nsub' = B*nsub; stage 2 = the
+    beam_batch scan program), and the row-batched spectral stages at
+    B x chunk rows — the gate-vs-runtime lockstep discipline, one
+    axis up."""
+    import jax.numpy as jnp
+
+    from tpulsar.kernels import beam_batch as bb
+    from tpulsar.kernels import singlepulse as sp_k
+    from tpulsar.kernels import fourier as fr
+
+    _sp = ctx.params
+    rungs = sorted({len(g) for g in bb.plan_beam_groups(
+        ctx.nbeams).groups if len(g) > 1})
+    groups: list[tuple[str, list[Instance]]] = []
+    geoms = step_geometries(ctx)
+    for B in rungs:
+        blk = _sds((B * NCHAN, ctx.nsamp), ctx.blk_dtype)
+        insts: list[Instance] = []
+        for step, T_ds, ndms, pad_pairs, nfft, chunk in geoms:
+            nbins = nfft // 2 + 1
+            for pad1, pad2 in sorted(pad_pairs):
+                insts += [
+                    Instance("dedisperse._form_subbands_jit",
+                             f"bb_form_subbands B={B} "
+                             f"ds={step.downsamp} pad={pad1}",
+                             (blk, _sds((B * NCHAN,), jnp.int32)),
+                             dict(nsub=B * step.numsub,
+                                  downsamp=step.downsamp, pad=pad1)),
+                ]
+            sizes = [min(chunk, ndms)]
+            if chunk < ndms and ndms % chunk:
+                sizes.append(ndms % chunk)
+            for rows in sizes:
+                for pad1, pad2 in sorted(pad_pairs):
+                    insts.append(Instance(
+                        "beam_batch.dd_beams_scan",
+                        f"bb_dd_scan B={B} ds={step.downsamp} "
+                        f"rows={rows} pad={pad2}",
+                        (_sds((B, step.numsub, T_ds), jnp.float32),
+                         _sds((rows, step.numsub), jnp.int32)),
+                        dict(pad=pad2)))
+                sers = _sds((B * rows, T_ds), jnp.float32)
+                tag = f"B={B} ds={step.downsamp} rows={rows}"
+                insts += [
+                    Instance("singlepulse.normalize_series",
+                             f"bb_sp_normalize {tag}", (sers,),
+                             dict(estimator=sp_k.detrend_estimator())),
+                    Instance("singlepulse.boxcar_search",
+                             f"bb_sp_boxcars {tag}",
+                             (sers, tuple(_sp.sp_widths),
+                              sp_k.DEFAULT_TOPK), {}),
+                    Instance("fourier.whitened_spectrum",
+                             f"bb_whitened_spectrum {tag}", (sers,),
+                             dict(nfft=nfft)),
+                    # the zaplist path: the batch loop passes a 2-D
+                    # per-ROW keep mask (batchmates share a zap
+                    # digest but baryv — which shapes the mask — is
+                    # per-beam), unlike the solo loop's 1-D (nbins,)
+                    Instance("fourier.whitened_spectrum_masked",
+                             f"bb_whitened_spectrum_masked {tag}",
+                             (sers, _sds((B * rows, nbins),
+                                         jnp.bool_)),
+                             dict(nfft=nfft)),
+                    Instance("fourier.lo_stage_candidates",
+                             f"bb_lo_stages {tag}",
+                             (_sds((B * rows, nbins), jnp.complex64),
+                              tuple(fr.harmonic_stages(
+                                  _sp.lo_accel_numharm)),
+                              _sp.topk_per_stage), {}),
+                ]
+        groups.append((f"beam-batch B={B}:", insts))
     return groups
 
 

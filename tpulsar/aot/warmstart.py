@@ -237,7 +237,7 @@ def _selected(inst, only: tuple[str, ...]) -> bool:
 def run_gate(scale: float = 1.0, accel: bool = False, config: int = 0,
              fast: bool = False, deadline: float = 0.0,
              only: tuple[str, ...] = (), verify: bool = False,
-             echo=print) -> int:
+             nbeams: int = 0, echo=print) -> int:
     """Compile (or verify) the registered gate program set.  See the
     module docstring for the exit-code contract."""
     t0 = time.monotonic()
@@ -265,7 +265,8 @@ def run_gate(scale: float = 1.0, accel: bool = False, config: int = 0,
 
     from tpulsar.aot import registry
 
-    ctx = registry.make_context(scale=scale, accel=accel)
+    ctx = registry.make_context(scale=scale, accel=accel,
+                                nbeams=nbeams)
     groups = registry.gate_groups(ctx, config=config, fast=fast)
 
     manifest = load_manifest()
@@ -277,7 +278,8 @@ def run_gate(scale: float = 1.0, accel: bool = False, config: int = 0,
         manifest = _new_manifest(cache_dir)
     manifest["updated"] = time.time()
     manifest["profile"] = {"scale": scale, "accel": accel,
-                           "config": config, "fast": fast}
+                           "config": config, "fast": fast,
+                           "nbeams": nbeams}
 
     failures: list[str] = []
     deferred: list[str] = []

@@ -62,19 +62,21 @@ class ChaosRunner:
 
     def _worker_cmd(self, worker_id: str) -> list[str]:
         import sys
+        batch = (["--batch", str(self.sc.batch)]
+                 if self.sc.batch > 1 else [])
         if self.sc.worker_kind == "stub":
             return [sys.executable, "-m", "tpulsar.chaos.worker",
                     "--spool", self.spool, "--worker-id", worker_id,
                     "--beam-s", str(self.sc.beam_s),
                     "--max-attempts", str(self.sc.max_attempts),
-                    *self.worker_extra_args]
+                    *batch, *self.worker_extra_args]
         argv = [sys.executable, "-m", "tpulsar.cli"]
         cfgpath = os.environ.get("TPULSAR_CONFIG")
         if cfgpath:
             argv += ["--config", cfgpath]
         argv += ["serve", "--spool", self.spool,
                  "--worker-id", worker_id, "--no-warmstart",
-                 *self.worker_extra_args]
+                 *batch, *self.worker_extra_args]
         return argv
 
     def _worker_env(self, worker_id: str) -> dict:

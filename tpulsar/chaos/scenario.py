@@ -129,6 +129,11 @@ class Scenario:
     max_attempts: int = 3
     max_worker_restarts: int = 5
     gateway: bool = False
+    #: > 1 = batched admission: every worker claims up to `batch`
+    #: compatible tickets per ordering pass (protocol.claim_batch)
+    #: and journals a batch_dispatch per coalesced group — the storm
+    #: then audits exactly-once/attempts under batch claims too
+    batch: int = 1
     tenants: dict = dataclasses.field(default_factory=dict)
     #: non-empty = run the fleet ELASTIC: the dict is an
     #: autoscale.AutoscaleConfig (validated at load, same loud
@@ -222,6 +227,8 @@ def from_dict(doc: dict) -> Scenario:
                          f"{WORKER_KINDS}")
     if sc.workers < 1:
         raise ValueError("workers must be >= 1")
+    if sc.batch < 1:
+        raise ValueError("batch must be >= 1")
     if sc.gateway is False and wl.via == "gateway":
         raise ValueError("workload.via=gateway needs gateway: true")
     if sc.worker_kind == "serve" and wl.datafiles is None:

@@ -178,6 +178,19 @@ def main(argv=None) -> int:
                         "N-th pass (0 = never): a deterministic "
                         "kill-mid-beam with the claim in place and "
                         "the checkpoint store holding N artifacts")
+    p.add_argument("--batch", type=int, default=1,
+                   help="batched admission: claim up to N compatible "
+                        "tickets per protocol.claim_batch ordering "
+                        "pass, journal ONE batch_dispatch naming the "
+                        "members, and finish each beam with its own "
+                        "durable result (1 = single-ticket claims)")
+    p.add_argument("--crash-mid-batch", action="store_true",
+                   help="os._exit(70) after finishing the FIRST beam "
+                        "of the first >=2-ticket batch: the "
+                        "deterministic mid-batch SIGKILL footprint — "
+                        "one durable result, the remaining "
+                        "batchmates' claims held for the janitor to "
+                        "requeue individually")
     args = p.parse_args(argv)
 
     if args.exit_rc >= 0:
@@ -222,25 +235,11 @@ def main(argv=None) -> int:
         pass
     beat(force=True)
 
-    claims = 0
-    while not draining:
-        try:
-            rec = protocol.claim_next_ticket(
-                spool, wid, policy=policy,
-                worker_class=args.worker_class)
-        except OSError:
-            beat()
-            time.sleep(args.poll_s)
-            continue
-        if rec is None:
-            if args.once and protocol.pending_count(spool) == 0 \
-                    and protocol.claimed_count(spool) == 0:
-                break
-            beat()
-            time.sleep(args.poll_s)
-            continue
-        claims += 1
-        if args.crash_after and claims >= args.crash_after:
+    claims = [0]
+
+    def process_ticket(rec: dict) -> None:
+        claims[0] += 1
+        if args.crash_after and claims[0] >= args.crash_after:
             os._exit(70)
         tid = rec.get("ticket", "?")
         att = int(rec.get("attempts", 0))
@@ -289,6 +288,43 @@ def main(argv=None) -> int:
             # checkpoint litter out of the quiesced-spool audit
             from tpulsar import checkpoint as ckpt
             ckpt.clean(ckpt.default_root(rec["outdir"]))
+
+    while not draining:
+        try:
+            if args.batch > 1:
+                recs = protocol.claim_batch(
+                    spool, args.batch, wid, policy=policy,
+                    worker_class=args.worker_class)
+            else:
+                one = protocol.claim_next_ticket(
+                    spool, wid, policy=policy,
+                    worker_class=args.worker_class)
+                recs = [one] if one is not None else []
+        except OSError:
+            beat()
+            time.sleep(args.poll_s)
+            continue
+        if not recs:
+            if args.once and protocol.pending_count(spool) == 0 \
+                    and protocol.claimed_count(spool) == 0:
+                break
+            beat()
+            time.sleep(args.poll_s)
+            continue
+        if args.batch > 1:
+            # the batch-dispatch evidence (fleet-level, no ticket
+            # key): the members' own chains carry claim/result
+            journal.record(spool, "batch_dispatch", worker=wid,
+                           beams=len(recs),
+                           tickets=[r.get("ticket", "?")
+                                    for r in recs])
+        for bi, rec in enumerate(recs):
+            process_ticket(rec)
+            if args.crash_mid_batch and len(recs) >= 2 and bi == 0:
+                # mid-batch SIGKILL footprint: first beam's result is
+                # durable, every remaining batchmate's claim is held
+                # — the janitor must requeue each individually
+                os._exit(70)
         beat()
     if draining:
         try:

@@ -274,7 +274,9 @@ def cmd_serve(args):
         warm_boot=not args.no_warmstart,
         warm_boot_scale=args.warmstart_scale,
         heartbeat_interval_s=cfg.jobpooler.serve_heartbeat_interval_s,
-        prefetch_depth=args.prefetch_depth)
+        prefetch_depth=args.prefetch_depth,
+        batch_size=args.batch,
+        batch_linger_s=args.batch_linger)
     server.install_signal_handlers()
     print(f"serve: spool {server.spool} "
           + (f"worker {args.worker_id} " if args.worker_id else "")
@@ -282,6 +284,8 @@ def cmd_serve(args):
              else "")
           + f"(depth {server.max_queue_depth}, "
           f"warm boot {'on' if server.warm_boot else 'off'}"
+          + (f", batch {args.batch} linger {args.batch_linger:g} s"
+             if args.batch > 1 else "")
           + (f", beam deadline {args.beam_deadline:g} s"
              if args.beam_deadline else "") + ")")
     try:
@@ -1019,7 +1023,7 @@ def cmd_aot(args):
     return warmstart.run_gate(
         scale=args.scale, accel=args.accel, config=args.aot_config,
         fast=args.fast, deadline=args.deadline, only=only,
-        verify=args.aot_cmd == "verify")
+        nbeams=args.beams, verify=args.aot_cmd == "verify")
 
 
 def cmd_doctor(args):
@@ -1279,6 +1283,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "requeue attempt-neutrally off the "
                          "scale-down ledger, checkpoint resume "
                          "salvages durable passes)")
+    sp.add_argument("--batch", type=int, default=1,
+                    help="batched admission: claim up to N "
+                         "compatible tickets per ordering pass and "
+                         "search them as ONE coalesced batch-of-"
+                         "beams dispatch (1 = per-beam admission); "
+                         "per-beam results, checkpoints, and "
+                         "exactly-once semantics are unchanged")
+    sp.add_argument("--batch-linger", type=float, default=2.0,
+                    help="bounded wait (s) a partial batch lingers "
+                         "for late-arriving compatible tickets "
+                         "before dispatching partial")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(
@@ -1566,6 +1581,11 @@ def build_parser() -> argparse.ArgumentParser:
         ap.add_argument("--only", default="",
                         help="comma-separated program/label "
                              "substrings to gate")
+        ap.add_argument("--beams", type=int, default=0,
+                        help="also gate the batch-of-beams coalesced "
+                             "programs for this serve --batch size "
+                             "(group-size rungs, coalesced stage "
+                             "1/2, B*chunk spectral rows)")
         ap.set_defaults(fn=cmd_aot)
     ap = asub.add_parser("ls", help="list the program registry, "
                                     "exemptions, and manifest state")

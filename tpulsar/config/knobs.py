@@ -79,6 +79,17 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
     _k("TPULSAR_ACCEL_Z_CHUNK", "int [1,64]", "auto",
        "forced z-axis chunk height of the accel correlation "
        "programs (plane-memory / dispatch-count trade)"),
+    _k("TPULSAR_BEAM_BATCH", "int", "0 (planner budget)",
+       "pin the largest coalesced beam group of the batch-of-beams "
+       "search (kernels/beam_batch.py): 1 = coalescing off (every "
+       "beam runs the solo path), 0/unset = the working-set budget "
+       "decides; group sizes snap to the BATCH_QUANTA ladder either "
+       "way"),
+    _k("TPULSAR_BEAM_BATCH_BYTES", "int (bytes)",
+       "8589934592 (8 GiB)",
+       "coalesced working-set budget the beam-batch planner sizes B "
+       "against (B resident channel blocks + B*chunk spectral "
+       "transients, x2 chunks in flight)"),
     _k("TPULSAR_BENCH_DTYPE", "str", "uint8",
        "synthetic-beam sample dtype the AOT registry's program "
        "signatures assume (shared by bench.py so the gate compiles "

@@ -75,6 +75,18 @@ class TicketQueue:
                    policy=None) -> dict | None:
         raise NotImplementedError
 
+    def claim_batch(self, n: int, worker_id: str = "", policy=None,
+                    compat: str | None = None) -> list[dict]:
+        """Claim up to ``n`` compatible tickets in ONE policy
+        ordering pass (contract extension for batched admission):
+        the first claim fixes the batch's declared ``compat`` key
+        unless ``compat`` pins one, mismatching tickets stay pending
+        IN PLACE, each member is an individually exclusive,
+        owner-stamped, journaled claim, and the policy's quota
+        budgeting spans the whole batch — a low-priority tenant's
+        batchmates never displace a high-priority single."""
+        raise NotImplementedError
+
     def requeue_stale_claims(
             self, max_attempts: int = protocol.DEFAULT_MAX_ATTEMPTS
     ) -> list[str]:
@@ -185,6 +197,10 @@ class FilesystemSpoolQueue(TicketQueue):
     def claim_next(self, worker_id="", policy=None):
         return protocol.claim_next_ticket(self.spool, worker_id,
                                           policy=policy)
+
+    def claim_batch(self, n, worker_id="", policy=None, compat=None):
+        return protocol.claim_batch(self.spool, n, worker_id,
+                                    policy=policy, compat=compat)
 
     def requeue_stale_claims(
             self, max_attempts=protocol.DEFAULT_MAX_ATTEMPTS):
@@ -308,44 +324,73 @@ class MemoryTicketQueue(TicketQueue):
 
     # --------------------------------------------------------- claims
 
+    def _order_locked(self, policy) -> list[str]:
+        pending = list(self._states["incoming"].values())
+        if policy is None or getattr(policy, "is_trivial", False):
+            return [r["ticket"] for r in sorted(
+                pending, key=lambda r: (r.get("submitted_at", 0.0),
+                                        r["ticket"]))]
+        return policy.claim_order(pending, self.inflight_by_tenant())
+
+    def _claim_locked(self, tid: str, worker_id: str) -> dict | None:
+        rec = self._states["incoming"].pop(tid, None)
+        if rec is None:
+            return None
+        rec = dict(rec)
+        rec["claimed_at"] = time.time()
+        rec["claimed_by"] = os.getpid()
+        # this backend's claimers are threads of one process,
+        # so pid-liveness alone would make every claim read
+        # live forever — the thread ident is the in-memory
+        # analogue of the spool backend's owner pid
+        rec["claimed_by_thread"] = threading.get_ident()
+        if worker_id:
+            rec["claimed_by_worker"] = worker_id
+        self._states["claimed"][tid] = rec
+        self.record_event(
+            "claimed", ticket=tid, worker=worker_id,
+            pid=os.getpid(),
+            attempt=int(rec.get("attempts", 0)),
+            trace_id=rec.get("trace_id", ""),
+            queue_wait_s=round(
+                rec["claimed_at"]
+                - rec.get("submitted_at", rec["claimed_at"]),
+                3),
+            tenant=rec.get("tenant", ""))
+        return rec
+
     def claim_next(self, worker_id="", policy=None):
         with self._lock:
-            pending = list(self._states["incoming"].values())
-            if policy is None or getattr(policy, "is_trivial",
-                                         False):
-                order = [r["ticket"] for r in sorted(
-                    pending, key=lambda r: (r.get("submitted_at", 0.0),
-                                            r["ticket"]))]
-            else:
-                order = policy.claim_order(pending,
-                                           self.inflight_by_tenant())
-            for tid in order:
-                rec = self._states["incoming"].pop(tid, None)
-                if rec is None:
-                    continue
-                rec = dict(rec)
-                rec["claimed_at"] = time.time()
-                rec["claimed_by"] = os.getpid()
-                # this backend's claimers are threads of one process,
-                # so pid-liveness alone would make every claim read
-                # live forever — the thread ident is the in-memory
-                # analogue of the spool backend's owner pid
-                rec["claimed_by_thread"] = threading.get_ident()
-                if worker_id:
-                    rec["claimed_by_worker"] = worker_id
-                self._states["claimed"][tid] = rec
-                self.record_event(
-                    "claimed", ticket=tid, worker=worker_id,
-                    pid=os.getpid(),
-                    attempt=int(rec.get("attempts", 0)),
-                    trace_id=rec.get("trace_id", ""),
-                    queue_wait_s=round(
-                        rec["claimed_at"]
-                        - rec.get("submitted_at", rec["claimed_at"]),
-                        3),
-                    tenant=rec.get("tenant", ""))
-                return rec
+            for tid in self._order_locked(policy):
+                rec = self._claim_locked(tid, worker_id)
+                if rec is not None:
+                    return rec
             return None
+
+    def claim_batch(self, n, worker_id="", policy=None, compat=None):
+        # same contract as protocol.claim_batch: one ordering pass,
+        # the first claim (or the pinned ``compat``) fixes the key,
+        # mismatching tickets stay pending in place
+        if n < 1:
+            return []
+        claimed: list[dict] = []
+        with self._lock:
+            for tid in self._order_locked(policy):
+                if len(claimed) >= n:
+                    break
+                rec0 = self._states["incoming"].get(tid)
+                if rec0 is None:
+                    continue
+                if compat is not None or claimed:
+                    want = compat if compat is not None \
+                        else str(claimed[0].get("compat", "") or "")
+                    if str(rec0.get("compat", "") or "") \
+                            != str(want or ""):
+                        continue
+                rec = self._claim_locked(tid, worker_id)
+                if rec is not None:
+                    claimed.append(rec)
+        return claimed
 
     def _requeue(self, verdict_fn, max_attempts: int,
                  neutral_reason: str) -> list[str]:

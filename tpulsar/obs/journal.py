@@ -128,6 +128,10 @@ EVENTS = {
                                "checkpoint artifact write failed: "
                                "that one artifact is skipped and "
                                "recomputed on resume (key, errno)",
+    "batch_dispatch": "a worker coalesced N claimed tickets into one "
+                      "batched dispatch (worker, beams, tickets "
+                      "list; no ticket key — each member's own chain "
+                      "carries its claim/result)",
     "result": "TERMINAL: the durable done/ record landed (status, "
               "rc, worker, attempt)",
     "takeover": "a janitor stole the claim from a DEAD owner "

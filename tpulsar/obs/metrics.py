@@ -391,6 +391,43 @@ def diff_snapshots(now: dict, base: dict) -> dict:
     return out
 
 
+def merge_deltas(a: dict, b: dict) -> dict:
+    """Sum two ``diff_snapshots`` results into one interval view:
+    counter series add, histogram counts/sum/count add (quantiles
+    recomputed over the summed counts), gauges take ``b``'s reading
+    when both carry one (the later point in time).  Used by the
+    batch-of-beams finish phase to compose a beam's metrics artifact
+    from the group-shared plan-loop delta plus that beam's own
+    sift/fold/finalize delta — without it, sequential per-beam
+    finishes against one base snapshot would attribute every earlier
+    batchmate's finish-phase counters to the later beams."""
+    out: dict = {}
+    for name in set(a) | set(b):
+        arec, brec = a.get(name), b.get(name)
+        if arec is None or brec is None:
+            rec = arec or brec
+            out[name] = dict(rec, series=dict(rec["series"]))
+            continue
+        series: dict = dict(arec["series"])
+        for key, bval in brec["series"].items():
+            aval = series.get(key)
+            if aval is None or arec["type"] == "gauge":
+                series[key] = bval
+            elif arec["type"] == "histogram":
+                counts = [x + y for x, y in zip(aval["counts"],
+                                                bval["counts"])]
+                val = {"counts": counts,
+                       "sum": aval["sum"] + bval["sum"],
+                       "count": aval["count"] + bval["count"],
+                       "quantiles": _hist_quantiles(
+                           tuple(arec["buckets"]), counts)}
+                series[key] = val
+            else:
+                series[key] = aval + bval
+        out[name] = dict(arec, series=series)
+    return out
+
+
 #: the process-wide default registry every pipeline layer records into
 REGISTRY = Registry()
 

@@ -152,6 +152,37 @@ def accel_stage_seconds() -> metrics.Histogram:
         labelnames=("path",), buckets=STAGE_BUCKETS)
 
 
+def beam_batch_beams_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_beam_batch_beams_total",
+        "beams searched by dispatch path: batched = inside a "
+        "coalesced multi-beam group (kernels/beam_batch.py), solo = "
+        "the single-beam path (no batchmates, resume state, an "
+        "operator cap of 1, a ragged group remainder, or per-beam "
+        "degradation out of a failed group).  Disjoint: together "
+        "they count every beam a batch entry point searched",
+        labelnames=("path",))
+
+
+def beam_batch_occupancy() -> metrics.Gauge:
+    return metrics.gauge(
+        "tpulsar_beam_batch_occupancy",
+        "beams in the most recent coalesced dispatch group (a "
+        "BATCH_QUANTA rung; compare against the serve worker's "
+        "--batch admission size to see how full batches actually "
+        "run)")
+
+
+def beam_batch_trials_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_beam_batch_trials_total",
+        "DM trials searched through a batch-of-beams entry point by "
+        "path: batched trials rode coalesced B-beam dispatches, solo "
+        "trials a beam that fell out of (or never joined) a batch — "
+        "the beams/dispatch occupancy story in trial units",
+        labelnames=("path",))
+
+
 def accel_undispatched_rows_total() -> metrics.Counter:
     return metrics.counter(
         "tpulsar_accel_undispatched_rows_total",

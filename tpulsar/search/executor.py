@@ -216,26 +216,7 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     — carries ``resume`` / ``pass_complete`` / ``checkpoint_invalid``
     / ``checkpoint_disabled`` events.
     """
-    import tpulsar
-
-    # JAX_PLATFORMS must win over a sitecustomize-registered
-    # accelerator plugin, and it must win BEFORE the first jnp use
-    # below initializes the backend — a library caller pinned to CPU
-    # would otherwise initialize the accelerator (and hang forever on
-    # a wedged chip).  search_block callers hold device arrays
-    # already, so this is the earliest library point where the pin
-    # can still take effect.
-    tpulsar.apply_platform_env()
-    # every in-line XLA compile during this search emits
-    # compile_cache_hit/miss counters and a backend_compile trace
-    # event — a recompile the AOT gate should have absorbed can no
-    # longer hide inside a stage timing (round-5: 160.6 s of a
-    # 176.5 s child spent recompiling gated HLO, invisibly)
-    from tpulsar.aot import cachedir as _cachedir
-    from tpulsar.aot import warmstart as _warmstart
-
-    _cachedir.activate_if_configured()
-    _warmstart.install_runtime_monitor()
+    _activate_runtime()
     params = params or SearchParams()
     if trace_mod.enabled():
         # one trace file per beam: clear events at beam start so the
@@ -250,6 +231,38 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     os.makedirs(workdir, exist_ok=True)
     os.makedirs(resultsdir, exist_ok=True)
 
+    obj, si, basenm, plan, nsub, baryv, data_id = _beam_geometry(
+        fns, params, plan, baryv)
+    timers = StageTimers()
+    store = None
+    if checkpoint_dir:
+        # opened HERE (not in search_block) so the RFI mask and the
+        # fold artifacts checkpoint too, not just the pass loop
+        store = _open_checkpoint(
+            checkpoint_dir,
+            _ckpt_fingerprint(plan, params, zaplist, baryv, nsub,
+                              data_id=data_id),
+            checkpoint_journal)
+
+    data, mask = _read_and_mask(si, params, basenm, resultsdir,
+                                store, timers)
+
+    result = search_block(data, si.freqs, si.dt, plan, params,
+                          zaplist=zaplist, baryv=baryv, nsub=nsub,
+                          timers=timers, checkpoint=store, mesh=mesh)
+    final, folded, sp_events, num_trials = result
+    return _finalize_results(
+        resultsdir, basenm, obj, si, plan, params, zaplist, baryv,
+        data, mask, final, folded, sp_events, num_trials, timers,
+        metrics_base)
+
+
+def _beam_geometry(fns, params, plan, baryv):
+    """Header-derived per-beam facts every path (solo and batched)
+    needs before any device work: the data object, the DDplan, the
+    effective nsub, the barycentric velocity, and the checkpoint
+    data_id (file names/sizes/MJD + block shape — another beam's
+    dumps must never be resumed)."""
     obj = datafile.autogen_dataobj(fns)
     si = obj.specinfo
     if baryv is None:
@@ -260,8 +273,6 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
             f"{params.low_T_to_search_s:.1f} s "
             f"(reference PALFA2_presto_search.py:450)")
     basenm = os.path.splitext(os.path.basename(sorted(fns)[0]))[0]
-    timers = StageTimers()
-
     nsub = params.nsub if si.num_channels % params.nsub == 0 else \
         ddplan.largest_divisor_leq(si.num_channels, params.nsub)
     if plan is None:
@@ -269,23 +280,43 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
             si, lodm=params.dm_min,
             hidm=params.dm_max if params.dm_max > 0 else 1000.0,
             numsub=params.nsub)
-
+    shape_id = (f"({si.num_channels}, {int(si.N)})|{si.dt!r}|"
+                f"{si.freqs[0]!r}|{si.freqs[-1]!r}")
     data_id = ";".join(
         f"{os.path.basename(fn)}:{os.path.getsize(fn)}" for fn in
-        sorted(fns)) + f"|mjd={float(si.start_MJD[0])!r}"
-    store = None
-    if checkpoint_dir:
-        # opened HERE (not in search_block) so the RFI mask and the
-        # fold artifacts checkpoint too, not just the pass loop
-        shape_id = (f"({si.num_channels}, {int(si.N)})|{si.dt!r}|"
-                    f"{si.freqs[0]!r}|{si.freqs[-1]!r}")
-        store = _open_checkpoint(
-            checkpoint_dir,
-            _ckpt_fingerprint(plan, params, zaplist, baryv, nsub,
-                              data_id=data_id + "|" + shape_id),
-            checkpoint_journal)
+        sorted(fns)) + f"|mjd={float(si.start_MJD[0])!r}" \
+        + "|" + shape_id
+    return obj, si, basenm, plan, nsub, baryv, data_id
 
-    # ---------------------------------------------------------- read + RFI
+
+def _activate_runtime() -> None:
+    """One-time runtime activation every beam entry point shares.
+
+    JAX_PLATFORMS must win over a sitecustomize-registered
+    accelerator plugin, and it must win BEFORE the first jnp use
+    initializes the backend — a library caller pinned to CPU would
+    otherwise initialize the accelerator (and hang forever on a
+    wedged chip).  The persistent-cache monitor is installed in the
+    same breath so every in-line XLA compile emits
+    compile_cache_hit/miss counters and a backend_compile trace
+    event — a recompile the AOT gate should have absorbed can no
+    longer hide inside a stage timing."""
+    import tpulsar
+
+    tpulsar.apply_platform_env()
+    from tpulsar.aot import cachedir as _cachedir
+    from tpulsar.aot import warmstart as _warmstart
+
+    _cachedir.activate_if_configured()
+    _warmstart.install_runtime_monitor()
+
+
+def _read_and_mask(si, params, basenm, resultsdir, store, timers):
+    """Read the beam block and apply the RFI mask (checkpoint-aware):
+    returns the masked (nchan, T) device array and the RFIMask.  The
+    mask artifact lands in resultsdir and — when a store is open — in
+    the checkpoint manifest, so a resumed beam rewrites the
+    byte-identical mask file without recomputing find_rfi."""
     f32_bytes = int(si.N) * si.num_channels * 4
     quantize = (params.block_quantize == "on"
                 or (params.block_quantize == "auto"
@@ -327,13 +358,18 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
         data = rfi_k.apply_mask_chan(
             data, jnp.asarray(mask.full_mask()),
             jnp.asarray(mask.chan_fill), mask.block_len)
+    return data, mask
 
-    result = search_block(data, si.freqs, si.dt, plan, params,
-                          zaplist=zaplist, baryv=baryv, nsub=nsub,
-                          timers=timers, checkpoint=store, mesh=mesh)
-    final, folded, sp_events, num_trials = result
 
-    # ----------------------------------------------------------- artifacts
+def _finalize_results(resultsdir, basenm, obj, si, plan, params,
+                      zaplist, baryv, data, mask, final, folded,
+                      sp_events, num_trials, timers,
+                      metrics_base, metrics_extra=None
+                      ) -> SearchOutcome:
+    """Write the per-beam results directory (artifacts, provenance,
+    report, telemetry delta, tarballs) and build the SearchOutcome —
+    shared verbatim by the solo and the batch-of-beams paths, so a
+    beam's output layout cannot depend on which path searched it."""
     accelcands.write_candlist(
         final, os.path.join(resultsdir, f"{basenm}.accelcands"),
         baryv=baryv)
@@ -395,6 +431,11 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
     import json as _json
     mdelta = telemetry.metrics.diff_snapshots(
         telemetry.metrics.REGISTRY.snapshot(), metrics_base)
+    if metrics_extra is not None:
+        # batch path: the group-shared plan-loop delta composed with
+        # this beam's own finish-phase delta (metrics_base was taken
+        # at the START of this beam's finish, not the group's)
+        mdelta = telemetry.metrics.merge_deltas(metrics_extra, mdelta)
     with open(os.path.join(resultsdir, "metrics.json"), "w") as fh:
         _json.dump(mdelta, fh, indent=1)
     _tar_result_classes(resultsdir, basenm)
@@ -412,6 +453,499 @@ def search_beam(fns: list[str], workdir: str, resultsdir: str,
                              "tpulsar_compile_cache_hits_total"),
                          compile_misses=_counter_total(
                              "tpulsar_compile_cache_misses_total"))
+
+
+# ------------------------------------------------------ batch of beams
+
+@dataclasses.dataclass
+class BeamSpec:
+    """One beam's inputs to :func:`search_beam_batch` — exactly the
+    arguments :func:`search_beam` takes, as data."""
+    fns: list[str]
+    workdir: str
+    resultsdir: str
+    zaplist: np.ndarray | None = None
+    baryv: float | None = None
+    checkpoint_dir: str | None = None
+    checkpoint_journal: object = None
+    #: ticket id / display label for telemetry and error reporting
+    label: str = ""
+
+
+@dataclasses.dataclass
+class BeamBatchResult:
+    """Per-beam outcome of a batch dispatch: the SearchOutcome (or the
+    per-beam error — one beam's failure never fails its batchmates),
+    plus which path actually searched it."""
+    spec: BeamSpec
+    outcome: SearchOutcome | None = None
+    error: BaseException | None = None
+    path: str = "solo"             # "batched" | "solo"
+    group_size: int = 1
+    fallout: str = ""              # why a beam left the batch
+
+
+def search_beam_batch(specs: list[BeamSpec],
+                      params: SearchParams | None = None,
+                      cap: int = 0,
+                      progress_cb=None) -> list[BeamBatchResult]:
+    """Search B beams, coalescing compatibility-keyed groups into one
+    dispatch stream (kernels/beam_batch.py): RFI-masked subbanding and
+    dedispersion run with a folded beam axis, and the spectral stages
+    (fused SP detrend, FFT/whiten, lo harmonic stages, the batched
+    FDAS) see ``B x chunk`` beam-major rows per dispatch — the
+    accel_batch recipe one axis up.
+
+    Per-beam results discipline is preserved: every beam keeps its own
+    results directory, checkpoint store (pass artifacts sliced out of
+    the batched arrays — byte-identical to a solo run's), journal
+    chain, and SearchOutcome.  Per-beam degradation: a beam that
+    cannot ride the batch (checkpoint resume state, incompatible
+    geometry, an unreadable input, or any failure inside the coalesced
+    section) falls out to the proven single-beam path — it never fails
+    its batchmates.  ``cap`` pins the largest coalesced group (0 =
+    TPULSAR_BEAM_BATCH, then the working-set budget); group sizes are
+    quantized to the shared BATCH_QUANTA ladder either way."""
+    from tpulsar.kernels import beam_batch as bb
+
+    _activate_runtime()
+    params = params or SearchParams()
+    results = [BeamBatchResult(spec=s) for s in specs]
+
+    preludes: dict[int, tuple] = {}
+    solo: dict[int, str] = {}
+    groups: dict[str, list[int]] = {}
+    for i, spec in enumerate(specs):
+        try:
+            pre = _beam_geometry(spec.fns, params, None, spec.baryv)
+        except Exception:
+            # unreadable header / too-short beam: the solo path will
+            # surface the same error (or clean skip) attributably;
+            # KeyboardInterrupt/SystemExit propagate — an interrupt
+            # aborts the batch, it is not a per-beam defect
+            solo[i] = "prelude_failed"
+            continue
+        preludes[i] = pre
+        if spec.checkpoint_dir and _has_resume_state(
+                spec.checkpoint_dir):
+            # resume state binds the beam to the solo path: resuming
+            # means SKIPPING completed passes, and a coalesced group
+            # runs every pass for every member
+            solo[i] = "resume"
+            continue
+        obj, si, basenm, plan, nsub, baryv, data_id = pre
+        key = bb.compat_key(si.num_channels, int(si.N), float(si.dt),
+                            float(si.freqs[0]), float(si.freqs[-1]),
+                            nsub, plan, params,
+                            zap_digest=bb.zaplist_digest(spec.zaplist))
+        groups.setdefault(key, []).append(i)
+
+    cap = cap or bb.beam_batch_cap()
+    for key, idxs in groups.items():
+        if cap == 1 or len(idxs) == 1:
+            for i in idxs:
+                solo.setdefault(i, "no_batchmates" if len(idxs) == 1
+                                else "cap_1")
+            continue
+        obj, si, basenm, plan, nsub, baryv, data_id = preludes[idxs[0]]
+        eff_cap = min(cap or len(idxs),
+                      _budget_beam_cap(si, plan, params))
+        gplan = bb.plan_beam_groups(len(idxs), cap=eff_cap)
+        for members in gplan.groups:
+            sub = [idxs[m] for m in members]
+            if len(sub) == 1:
+                solo.setdefault(sub[0], "ragged_remainder")
+                continue
+            entries = [{"spec": specs[i], "pre": preludes[i]}
+                       for i in sub]
+            try:
+                outcomes = _search_group(entries, params,
+                                         progress_cb=progress_cb)
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"coalesced {len(sub)}-beam group failed "
+                    f"({e}); every member degrades to the solo "
+                    f"path")
+                for i in sub:
+                    solo.setdefault(i, "group_failed")
+                continue
+            for i, out in zip(sub, outcomes):
+                results[i].outcome = out
+                results[i].path = "batched"
+                results[i].group_size = len(sub)
+                telemetry.beam_batch_beams_total().inc(path="batched")
+
+    for i, reason in sorted(solo.items()):
+        spec = specs[i]
+        results[i].fallout = reason
+        try:
+            results[i].outcome = search_beam(
+                spec.fns, spec.workdir, spec.resultsdir, params,
+                zaplist=spec.zaplist, baryv=spec.baryv,
+                checkpoint_dir=spec.checkpoint_dir,
+                checkpoint_journal=spec.checkpoint_journal)
+        except Exception as e:
+            results[i].error = e
+        telemetry.beam_batch_beams_total().inc(path="solo")
+        if results[i].outcome is not None:
+            telemetry.beam_batch_trials_total().inc(
+                results[i].outcome.num_dm_trials, path="solo")
+    return results
+
+
+def _has_resume_state(checkpoint_dir: str) -> bool:
+    from tpulsar import checkpoint as ckpt
+    try:
+        return ckpt.progress_marker(checkpoint_dir) > 0
+    except OSError:
+        return False
+
+
+def _budget_beam_cap(si, plan, params: SearchParams) -> int:
+    """How many beams the coalesced working set affords for this
+    geometry (beam_batch.budget_beams with the executor's own block /
+    chunk arithmetic)."""
+    from tpulsar.kernels import beam_batch as bb
+
+    f32_bytes = int(si.N) * si.num_channels * 4
+    quantize = (params.block_quantize == "on"
+                or (params.block_quantize == "auto"
+                    and f32_bytes > params.block_quantize_min))
+    block_bytes = f32_bytes // 4 if quantize else f32_bytes
+    step0 = plan[0]
+    nfft = ddplan.choose_n(int(si.N) // step0.downsamp)
+    chunk_rows = pass_chunk_size(int(step0.dms_per_pass), nfft, params)
+    return bb.budget_beams(block_bytes, chunk_rows, nfft)
+
+
+def _search_group(entries: list[dict], params: SearchParams,
+                  progress_cb=None) -> list[SearchOutcome]:
+    """One coalesced group end to end.  All entries share a compat
+    key, so the plan geometry, nsub, dt, and channel table are
+    identical; what stays per-beam is the data block, the RFI mask,
+    the zaplist/baryv-derived keep mask, the checkpoint store, and
+    everything after the plan loop (sift/refine/fold/artifacts) —
+    which runs through the exact helpers the solo path runs."""
+    from tpulsar.kernels import beam_batch as bb
+
+    B = len(entries)
+    specs = [e["spec"] for e in entries]
+    pres = [e["pre"] for e in entries]
+    _obj0, si0, _b0, plan, nsub, _bv0, _id0 = pres[0]
+    freqs, dt = si0.freqs, si0.dt
+
+    degraded.reset()
+    if trace_mod.enabled():
+        trace_mod.start(clear=True)
+    metrics_base = telemetry.metrics.REGISTRY.snapshot()
+    timers = StageTimers()
+
+    stores, datas, masks = [], [], []
+    for spec, pre in zip(specs, pres):
+        obj, si, basenm, _plan, _nsub, baryv, data_id = pre
+        os.makedirs(spec.workdir, exist_ok=True)
+        os.makedirs(spec.resultsdir, exist_ok=True)
+        store = None
+        if spec.checkpoint_dir:
+            store = _open_checkpoint(
+                spec.checkpoint_dir,
+                _ckpt_fingerprint(plan, params, spec.zaplist, baryv,
+                                  nsub, data_id=data_id),
+                spec.checkpoint_journal)
+        data, mask = _read_and_mask(si, params, basenm,
+                                    spec.resultsdir, store, timers)
+        stores.append(store)
+        datas.append(data)
+        masks.append(mask)
+
+    telemetry.beam_batch_occupancy().set(B)
+    with trace_mod.span("search_beam_batch", nbeams=B,
+                        npasses=sum(s.numpasses for s in plan)):
+        per = _group_plan_loop(datas, freqs, dt, plan, params,
+                               [s.zaplist for s in specs],
+                               [p[5] for p in pres], nsub, timers,
+                               stores, progress_cb)
+
+        # per-beam attribution past this point: the plan loop's delta
+        # is SHARED (one coalesced dispatch stream served the whole
+        # group — every member's artifact carries it), but each
+        # beam's sift/fold/finalize runs sequentially, so its
+        # counters and stage seconds must land only in ITS results
+        # directory, not every later batchmate's
+        group_delta = telemetry.metrics.diff_snapshots(
+            telemetry.metrics.REGISTRY.snapshot(), metrics_base)
+        outcomes = []
+        for b, (spec, pre) in enumerate(zip(specs, pres)):
+            obj, si, basenm, _plan, _nsub, baryv, _id = pre
+            finish_base = telemetry.metrics.REGISTRY.snapshot()
+            timers_b = StageTimers()
+            timers_b.times = dict(timers.times)
+            final, folded, sp_events, num_trials = _sift_fold_finish(
+                datas[b], freqs, dt, params, spec.zaplist, baryv,
+                nsub, timers_b, stores[b], per[b]["cands"],
+                per[b]["sp"], per[b]["ntr"], None, plan)
+            outcomes.append(_finalize_results(
+                spec.resultsdir, basenm, obj, si, plan, params,
+                spec.zaplist, baryv, datas[b], masks[b], final,
+                folded, sp_events, num_trials, timers_b, finish_base,
+                metrics_extra=group_delta))
+    return outcomes
+
+
+def _group_plan_loop(datas, freqs, dt, plan, params, zaplists, baryvs,
+                     nsub, timers, stores, progress_cb):
+    """The coalesced plan loop: every pass's stage 1/2 carries a
+    folded beam axis (XLA path) or runs per beam (tree/Pallas solo
+    formulations — bit-parity bounds what may coalesce), and the
+    spectral stages always see B*chunk beam-major rows.  Chunk
+    boundaries are the SOLO pass_chunk_size, so per-beam candidate
+    ordering — and therefore the per-pass checkpoint artifacts sliced
+    out at the end of each pass — are byte-identical to a solo run."""
+    from tpulsar.kernels import beam_batch as bb
+
+    B = len(datas)
+    per = [{"cands": [], "sp": [], "ntr": 0} for _ in range(B)]
+    npasses = sum(s.numpasses for s in plan)
+    pass_idx = -1
+    coalesce_dd = bb.coalesce_dd_ok()
+    hi = params.run_hi_accel and params.hi_accel_zmax > 0
+    sp_est = sp_k.detrend_estimator(params.sp_detrend)
+
+    for step_idx, step in enumerate(plan):
+        for ppass in step.passes():
+            pass_idx += 1
+            starts = [(len(per[b]["cands"]), len(per[b]["sp"]),
+                       per[b]["ntr"]) for b in range(B)]
+            dms = np.asarray(ppass.dms)
+            with timers.timing("subbanding"):
+                chan_shifts, sub_shifts = dd.plan_pass_shifts(
+                    freqs, nsub, ppass.subdm, dms, dt, step.downsamp)
+                if coalesce_dd:
+                    subb_all = bb.form_subbands_beams(
+                        bb.stack_blocks(datas), chan_shifts, B, nsub,
+                        step.downsamp)           # (B*nsub, T')
+                    subs = None
+                    T_ds = int(subb_all.shape[1])
+                else:
+                    subs = [dd.form_subbands(d,
+                                             jnp.asarray(chan_shifts),
+                                             nsub, step.downsamp)
+                            for d in datas]
+                    subb_all = None
+                    T_ds = int(subs[0].shape[1])
+            dt_ds = dt * step.downsamp
+            chunk_sz = pass_chunk_size(len(dms), ddplan.choose_n(T_ds),
+                                       params)
+            t_dd0 = timers.times.get("dedispersing", 0.0)
+            tree_plan = tree_dd.plan_for_pass(sub_shifts, T=T_ds)
+            tree_parts = None
+            if tree_plan is not None:
+                # per-beam levels: the exact solo programs, so the
+                # tree family's summation order (the parity contract)
+                # is untouched — only the residual outputs coalesce
+                if subs is None:
+                    subs = [subb_all[b * nsub:(b + 1) * nsub]
+                            for b in range(B)]
+                with timers.timing("dedispersing"):
+                    tree_parts = [tree_dd.tree_levels(s, tree_plan)
+                                  for s in subs]
+                    trace_mod.fence(tree_parts)
+                telemetry.dedisp_tree_depth().set(tree_plan.depth)
+                telemetry.dedisp_residual_fraction().set(
+                    round(tree_plan.residual_fraction, 4))
+
+            # per-beam keep masks for this pass's spectrum length
+            nfft = ddplan.choose_n(T_ds)
+            nbins = nfft // 2 + 1
+            T_s = nfft * dt_ds
+            keeps = None
+            if any(z is not None for z in zaplists):
+                keeps = [fr.zap_mask(nbins, T_s, z, bv)
+                         if z is not None else np.ones(nbins, bool)
+                         for z, bv in zip(zaplists, baryvs)]
+
+            pending: list[tuple] = []
+            for lo in range(0, len(dms), chunk_sz):
+                if len(pending) >= 2:
+                    # same two-chunks-in-flight bound as the solo
+                    # loop: block on the chunk-before-last's LO
+                    # output — the last consumer of its wspec — not
+                    # the earlier SP pair, or 3+ coalesced chunks'
+                    # B-wide series/wspec could be enqueued at once
+                    with timers.timing("pipeline-wait"):
+                        jax.block_until_ready(pending[-2][4])
+                dm_chunk = dms[lo: lo + chunk_sz]
+                n = len(dm_chunk)
+                with trace_mod.span("beam_batch_chunk",
+                                    pass_idx=pass_idx, lo=int(lo),
+                                    n=int(n), nbeams=B):
+                    norm = None
+                    with timers.timing("dedispersing"):
+                        if tree_parts is not None:
+                            pairs = [tree_dd.residual_series(
+                                tp, tree_plan, lo, n, T=T_ds,
+                                fuse=True, estimator=sp_est)
+                                for tp in tree_parts]
+                            series = jnp.concatenate(
+                                [p[0] for p in pairs], axis=0)
+                            norm = jnp.concatenate(
+                                [p[1] for p in pairs], axis=0)
+                        elif coalesce_dd:
+                            series = bb.dedisperse_beams(
+                                subb_all, sub_shifts[lo: lo + n], B)
+                        else:
+                            series = jnp.concatenate(
+                                [dd.dedisperse_subbands(
+                                    s, jnp.asarray(
+                                        sub_shifts[lo: lo + n]))
+                                 for s in subs], axis=0)
+                        trace_mod.fence(series if norm is None
+                                        else (series, norm))
+                    with timers.timing("single-pulse"):
+                        if norm is not None:
+                            sp_pair = sp_k.boxcar_search(
+                                norm, tuple(params.sp_widths),
+                                sp_k.DEFAULT_TOPK)
+                        else:
+                            sp_pair = sp_k.device_search(
+                                series, tuple(params.sp_widths),
+                                estimator=params.sp_detrend)
+                        trace_mod.fence(sp_pair)
+                    with timers.timing("FFT"):
+                        if keeps is not None:
+                            keep_rows = np.concatenate(
+                                [np.broadcast_to(k, (n, nbins))
+                                 for k in keeps])
+                            wspec = fr.whitened_spectrum_masked(
+                                series, jnp.asarray(keep_rows),
+                                nfft=nfft)
+                        else:
+                            wspec = fr.whitened_spectrum(series,
+                                                         nfft=nfft)
+                        trace_mod.fence(wspec)
+                    with timers.timing("lo-accelsearch"):
+                        res = fr.lo_stage_candidates(
+                            wspec,
+                            tuple(fr.harmonic_stages(
+                                params.lo_accel_numharm)),
+                            params.topk_per_stage)
+                        trace_mod.fence(res)
+                    hi_by_beam = None
+                    if hi:
+                        with timers.timing("hi-accelsearch"):
+                            hi_by_beam = _hi_accel_group(
+                                wspec, dm_chunk, B, T_s, params)
+                    del wspec
+                    pending.append((dm_chunk, nbins, T_s, sp_pair,
+                                    res, hi_by_beam))
+
+            with timers.timing("pipeline-drain"):
+                sp_host = jax.device_get([p[3] for p in pending])
+                lo_host = jax.device_get([p[4] for p in pending])
+            for (dm_chunk, nbins, T_s, _sp, _res, hi_by_beam), \
+                    (snrs, idx), res_h in zip(pending, sp_host,
+                                              lo_host):
+                n = len(dm_chunk)
+                for b in range(B):
+                    sl = slice(b * n, (b + 1) * n)
+                    with timers.timing("single-pulse"):
+                        ev = sp_k.events_from_topk(
+                            snrs[:, sl], idx[:, sl], dm_chunk, dt_ds,
+                            threshold=params.sp_threshold,
+                            widths=tuple(params.sp_widths))
+                        if len(ev):
+                            per[b]["sp"].append(ev)
+                    with timers.timing("lo-accelsearch"):
+                        res_b = {h: tuple(np.asarray(a)[sl]
+                                          for a in t)
+                                 for h, t in res_h.items()}
+                        per[b]["cands"].extend(sifting.make_candidates(
+                            res_b, dm_chunk, T_s, _lo_sigma_fn(nbins),
+                            sigma_min=params.sifting.sigma_threshold,
+                            bin_scale=0.5))
+                    if hi_by_beam is not None:
+                        per[b]["cands"].extend(hi_by_beam[b])
+                    per[b]["ntr"] += n
+            del pending
+            if subb_all is not None:
+                del subb_all
+            if subs is not None:
+                del subs
+            fam = "tree" if tree_parts is not None else "direct"
+            del tree_parts
+            telemetry.dedisp_trials_total().inc(B * len(dms),
+                                                family=fam)
+            telemetry.dedisp_stage_seconds().observe(
+                timers.times.get("dedispersing", 0.0) - t_dd0,
+                family=fam)
+            telemetry.passes_total().inc(B)
+            telemetry.dm_trials_total().inc(B * len(dms))
+            telemetry.beam_batch_trials_total().inc(B * len(dms),
+                                                    path="batched")
+            for b, store in enumerate(stores):
+                if store is None:
+                    continue
+                c0, s0, t0 = starts[b]
+                ntr_pass = per[b]["ntr"] - t0
+                durable = store.save(
+                    f"pass_{pass_idx:04d}",
+                    _encode_pass(
+                        per[b]["cands"][c0:],
+                        (np.concatenate(per[b]["sp"][s0:])
+                         if len(per[b]["sp"]) > s0 else _EMPTY_SP),
+                        ntr_pass),
+                    kind="pass", ext=".npz", pass_idx=pass_idx)
+                if durable:
+                    store.journal("pass_complete", pass_idx=pass_idx,
+                                  npasses=npasses, ntrials=ntr_pass)
+            if progress_cb is not None:
+                progress_cb({
+                    "pass_idx": pass_idx + 1, "npasses": npasses,
+                    "step_idx": step_idx, "nbeams": B,
+                    "ntrials_done": per[0]["ntr"],
+                    "ncands": sum(len(p["cands"]) for p in per),
+                    "stage_s": {k: round(v, 2)
+                                for k, v in timers.times.items()
+                                if v},
+                })
+    return per
+
+
+def _hi_accel_group(wspec, dm_chunk, nbeams: int, T_s,
+                    params: SearchParams) -> list[list]:
+    """The hi-accel FDAS stage over B beams' stacked spectra rows —
+    kernels/accel_batch.py's plan sees ``B x chunk`` rows, extending
+    the DM-trial batch axis across beams.  Per-row results are
+    B-invariant (the accel_batch parity contract), so the per-beam
+    slices are bit-identical to solo calls.  A refused stacked
+    dispatch degrades PER BEAM: each beam's rows ride the proven solo
+    chunk path (retry -> host rescue -> zero-fill) independently, so
+    one beam's poisoned spectra never cost a batchmate its hi-accel
+    science."""
+    bank = _get_bank(params.hi_accel_zmax)
+    n = len(dm_chunk)
+    try:
+        res = accel_k.accel_search_batch(
+            wspec, bank, max_numharm=params.hi_accel_numharm,
+            topk=params.topk_per_stage)
+    except accel_k.AccelStageRefused:
+        return [_hi_accel_pass(wspec[b * n:(b + 1) * n], dm_chunk,
+                               T_s, params) for b in range(nbeams)]
+    out = []
+    sigma_fn = _hi_sigma_fn(wspec.shape[-1], len(bank.zs))
+    for b in range(nbeams):
+        sl = slice(b * n, (b + 1) * n)
+        res_b = {h: tuple(np.asarray(a)[sl] for a in t)
+                 for h, t in res.items()}
+        # clean chunks feed the loss ledger's denominator per beam,
+        # exactly as the solo path does per chunk
+        degraded.count("accel_hi_chunk_skipped", 0, n)
+        out.append(sifting.make_candidates(
+            res_b, dm_chunk, T_s, sigma_fn,
+            sigma_min=params.sifting.sigma_threshold,
+            z_min_abs=accel_k.DZ / 2, bin_scale=0.5))
+    return out
 
 
 def _budget_dm_chunk(nfft: int, hi: bool, budget: int) -> int:
@@ -786,6 +1320,18 @@ def _search_block_inner(data, freqs, dt, plan, params, zaplist, baryv,
                                 for k, v in timers.times.items() if v},
                 })
 
+    return _sift_fold_finish(data, freqs, dt, params, zaplist, baryv,
+                             nsub, timers, store, all_cands, sp_chunks,
+                             num_trials, sifted_state, plan)
+
+
+def _sift_fold_finish(data, freqs, dt, params, zaplist, baryv, nsub,
+                      timers, store, all_cands, sp_chunks, num_trials,
+                      sifted_state, plan):
+    """Everything after the plan loop — sift, refine, checkpoint the
+    sifted list, fold (checkpoint-aware) — shared verbatim by the solo
+    pass loop and the batch-of-beams group loop, so the per-beam tail
+    is identical-by-construction whichever loop fed it."""
     nfft_full = ddplan.choose_n(data.shape[1])
     T_s_full = nfft_full * dt
     _series_for = _BoundedCache(

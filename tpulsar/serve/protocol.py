@@ -358,124 +358,190 @@ def claim_next_ticket(spool: str, worker_id: str = "",
     staging was stolen — a lost claim is abandoned, never
     fabricated."""
     grace = orphan_sidefile_grace()
-
-    def _journal_claim(rec: dict) -> None:
-        journal.record(
-            spool, "claimed", ticket=rec.get("ticket", "?"),
-            worker=worker_id, pid=os.getpid(),
-            attempt=int(rec.get("attempts", 0)),
-            trace_id=rec.get("trace_id", ""),
-            queue_wait_s=round(
-                rec["claimed_at"] - rec.get("submitted_at",
-                                            rec["claimed_at"]), 3),
-            # the tenant rides the claim event so per-tenant inflight
-            # can be reconstructed from the journal alone (the chaos
-            # verifier's quota invariant)
-            **({"tenant": rec["tenant"]} if rec.get("tenant")
-               else {}),
-            # the worker CLASS rides it too: a spot worker's claims
-            # are expected to be SIGKILLed by the autoscaler, and the
-            # no_elastic_strike audit wants that context in-band
-            **({"worker_class": rec["claimed_by_class"]}
-               if rec.get("claimed_by_class") else {}))
-
-    if policy is None or getattr(policy, "is_trivial", False):
-        # a trivial policy (no tenants configured) IS FIFO: skip the
-        # ordering pass rather than re-deriving FIFO from it
-        order = list_tickets(spool, "incoming")
-    else:
-        order = policy.claim_order(pending_records(spool),
-                                   inflight_by_tenant(spool))
-    for tid in order:
-        src = ticket_path(spool, tid, "incoming")
-        dst = ticket_path(spool, tid, "claimed")
-        staging = f"{dst}.claiming.{os.getpid()}"
-        held_at = time.time()
-        try:
-            _rename_held(src, staging)
-        except OSError:
-            continue            # lost the race; try the next ticket
-        rec = _read_json(staging)
-        if rec is None:
-            try:
-                os.unlink(staging)   # torn/garbage ticket: drop it
-            except OSError:
-                pass
-            continue
-        if time.time() - held_at > grace / 2:
-            # we stalled mid-claim: a janitor may be about to judge
-            # (or has judged) our staging file abandoned — withdraw
-            # instead of racing it
-            try:
-                os.rename(staging, src)
-            except OSError:
-                pass            # already stolen: the ticket is safe
-            continue
-        rec["claimed_at"] = time.time()
-        rec["claimed_by"] = os.getpid()
-        if worker_id:
-            rec["claimed_by_worker"] = worker_id
-        if worker_class:
-            # spot vs on-demand: elasticity context the requeue
-            # machinery and the journal audit read off the claim
-            rec["claimed_by_class"] = worker_class
-        try:
-            _atomic_write_json(staging, rec)
-        except OSError:
-            # the stamp write failed (ENOSPC, injected spool.io):
-            # withdraw the claim CLEANLY — the ticket goes straight
-            # back to incoming instead of idling in its .claiming
-            # side-file until the grace-window recovery notices it
-            try:
-                os.rename(staging, src)
-            except OSError:
-                pass         # stolen meanwhile: the ticket is safe
-            raise
-        # the replace above refreshed the staging mtime, so from here
-        # we hold a fresh full grace window — but if we stalled BEFORE
-        # it, the write may have re-created a path a janitor already
-        # recovered; the ticket existing anywhere else proves the
-        # theft, and our staging copy is the duplicate to discard
-        if time.time() - held_at > grace / 2 \
-                and _ticket_exists_elsewhere(spool, tid):
-            try:
-                os.unlink(staging)
-            except OSError:
-                pass
-            continue
-        try:
-            os.link(staging, dst)
-        except FileExistsError:
-            # a co-claimer (fed by a janitor's requeue of this very
-            # ticket) promoted first: theirs is the claim, ours is
-            # the duplicate
-            try:
-                os.unlink(staging)
-            except OSError:
-                pass
-            continue
-        except FileNotFoundError:
-            continue            # stolen while we stalled post-stamp
-        except OSError:
-            # hard links unsupported here (some network/FUSE mounts:
-            # EPERM/ENOTSUP): promote by plain rename — losing only
-            # the refuse-to-clobber hardening, never stranding the
-            # ticket in its .claiming side-file for the grace window
-            try:
-                os.rename(staging, dst)
-            except OSError:
-                continue
-            _invalidate_capacity(spool)
-            _journal_claim(rec)
+    for tid in _claim_order(spool, policy):
+        rec = _try_claim_one(spool, tid, worker_id, worker_class,
+                             grace)
+        if rec is not None:
             return rec
+    return None
+
+
+def claim_batch(spool: str, n: int, worker_id: str = "",
+                policy=None, worker_class: str = "",
+                compat: str | None = None) -> list[dict]:
+    """Claim up to ``n`` COMPATIBLE tickets in ONE tenant-policy
+    ordering pass — the batched admission primitive behind ``serve
+    --batch N``.
+
+    Batchmates are picked inside the existing claim ordering: the
+    first claimable ticket fixes the batch's compatibility key (its
+    declared ``compat`` field, ``""`` when unstamped) unless
+    ``compat`` pins one; subsequent tickets are claimed only when
+    their declared key matches, and mismatching tickets are SKIPPED
+    in place — left pending for the next (solo or batch) claimer,
+    never displaced out of their priority slot.  Unstamped tickets
+    batch with other unstamped tickets: the executor's batch entry
+    point re-derives the true key from each beam's header and
+    degrades any liar (or stranger) to the solo path, so a declared
+    key is an admission OPTIMIZATION, never a correctness input.
+
+    Each member is still claimed by the same exclusive two-rename as
+    :func:`claim_next_ticket` and journaled individually, so
+    exactly-once, owner stamping, attempts accounting, work-stealing,
+    and quarantine are untouched — the only new property is the
+    shared ordering pass, which makes an N-beam claim O(backlog)
+    instead of O(N x backlog).  The policy's quota budgeting already
+    spans the whole ordered list, so a batch cannot overshoot a
+    tenant's max_inflight either."""
+    if n < 1:
+        return []
+    grace = orphan_sidefile_grace()
+    claimed: list[dict] = []
+    for tid in _claim_order(spool, policy):
+        if len(claimed) >= n:
+            break
+        if compat is not None or claimed:
+            want = compat if compat is not None \
+                else str(claimed[0].get("compat", "") or "")
+            rec0 = _read_json(ticket_path(spool, tid, "incoming"))
+            if rec0 is None:
+                continue     # raced away; the rename would fail too
+            if str(rec0.get("compat", "") or "") != str(want or ""):
+                continue     # incompatible: stays pending, in place
+        rec = _try_claim_one(spool, tid, worker_id, worker_class,
+                             grace)
+        if rec is not None:
+            claimed.append(rec)
+    return claimed
+
+
+def _claim_order(spool: str, policy) -> list[str]:
+    """The ONE ordering pass single and batch claims share: FIFO for
+    a trivial policy (no tenants configured — skip the per-pending
+    parse entirely), else the TenantPolicy's priority/quota ordering
+    over the parsed backlog.  Factored out so an N-ticket batch claim
+    parses the backlog once, not once per member."""
+    if policy is None or getattr(policy, "is_trivial", False):
+        return list_tickets(spool, "incoming")
+    return policy.claim_order(pending_records(spool),
+                              inflight_by_tenant(spool))
+
+
+def _journal_claim(spool: str, rec: dict, worker_id: str) -> None:
+    journal.record(
+        spool, "claimed", ticket=rec.get("ticket", "?"),
+        worker=worker_id, pid=os.getpid(),
+        attempt=int(rec.get("attempts", 0)),
+        trace_id=rec.get("trace_id", ""),
+        queue_wait_s=round(
+            rec["claimed_at"] - rec.get("submitted_at",
+                                        rec["claimed_at"]), 3),
+        # the tenant rides the claim event so per-tenant inflight
+        # can be reconstructed from the journal alone (the chaos
+        # verifier's quota invariant)
+        **({"tenant": rec["tenant"]} if rec.get("tenant")
+           else {}),
+        # the worker CLASS rides it too: a spot worker's claims
+        # are expected to be SIGKILLed by the autoscaler, and the
+        # no_elastic_strike audit wants that context in-band
+        **({"worker_class": rec["claimed_by_class"]}
+           if rec.get("claimed_by_class") else {}))
+
+
+def _try_claim_one(spool: str, tid: str, worker_id: str,
+                   worker_class: str, grace: float) -> dict | None:
+    """One ticket's exclusive two-rename claim (the contract
+    narrative lives on claim_next_ticket): returns the stamped
+    record, or None when the ticket was lost to a race or theft --
+    the caller just moves on to the next id in its ordering."""
+    src = ticket_path(spool, tid, "incoming")
+    dst = ticket_path(spool, tid, "claimed")
+    staging = f"{dst}.claiming.{os.getpid()}"
+    held_at = time.time()
+    try:
+        _rename_held(src, staging)
+    except OSError:
+        return None          # lost the race; try the next ticket
+    rec = _read_json(staging)
+    if rec is None:
+        try:
+            os.unlink(staging)   # torn/garbage ticket: drop it
+        except OSError:
+            pass
+        return None
+    if time.time() - held_at > grace / 2:
+        # we stalled mid-claim: a janitor may be about to judge
+        # (or has judged) our staging file abandoned — withdraw
+        # instead of racing it
+        try:
+            os.rename(staging, src)
+        except OSError:
+            pass            # already stolen: the ticket is safe
+        return None
+    rec["claimed_at"] = time.time()
+    rec["claimed_by"] = os.getpid()
+    if worker_id:
+        rec["claimed_by_worker"] = worker_id
+    if worker_class:
+        # spot vs on-demand: elasticity context the requeue
+        # machinery and the journal audit read off the claim
+        rec["claimed_by_class"] = worker_class
+    try:
+        _atomic_write_json(staging, rec)
+    except OSError:
+        # the stamp write failed (ENOSPC, injected spool.io):
+        # withdraw the claim CLEANLY — the ticket goes straight
+        # back to incoming instead of idling in its .claiming
+        # side-file until the grace-window recovery notices it
+        try:
+            os.rename(staging, src)
+        except OSError:
+            pass         # stolen meanwhile: the ticket is safe
+        raise
+    # the replace above refreshed the staging mtime, so from here
+    # we hold a fresh full grace window — but if we stalled BEFORE
+    # it, the write may have re-created a path a janitor already
+    # recovered; the ticket existing anywhere else proves the
+    # theft, and our staging copy is the duplicate to discard
+    if time.time() - held_at > grace / 2 \
+            and _ticket_exists_elsewhere(spool, tid):
         try:
             os.unlink(staging)
         except OSError:
             pass
+        return None
+    try:
+        os.link(staging, dst)
+    except FileExistsError:
+        # a co-claimer (fed by a janitor's requeue of this very
+        # ticket) promoted first: theirs is the claim, ours is
+        # the duplicate
+        try:
+            os.unlink(staging)
+        except OSError:
+            pass
+        return None
+    except FileNotFoundError:
+        return None          # stolen while we stalled post-stamp
+    except OSError:
+        # hard links unsupported here (some network/FUSE mounts:
+        # EPERM/ENOTSUP): promote by plain rename — losing only
+        # the refuse-to-clobber hardening, never stranding the
+        # ticket in its .claiming side-file for the grace window
+        try:
+            os.rename(staging, dst)
+        except OSError:
+            return None
         _invalidate_capacity(spool)
-        _journal_claim(rec)
+        _journal_claim(spool, rec, worker_id)
         return rec
-    return None
+    try:
+        os.unlink(staging)
+    except OSError:
+        pass
+    _invalidate_capacity(spool)
+    _journal_claim(spool, rec, worker_id)
+    return rec
 
 
 def cancel_ticket(spool: str, ticket_id: str) -> bool:

@@ -49,7 +49,8 @@ from tpulsar.obs import journal, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.resilience import faults, policy
 from tpulsar.serve import protocol
-from tpulsar.serve.stagein import PreparedBeam, StageInPipeline
+from tpulsar.serve.stagein import (BatchStageInPipeline, PreparedBatch,
+                                   PreparedBeam, StageInPipeline)
 
 
 class SearchServer:
@@ -65,7 +66,9 @@ class SearchServer:
                  poll_s: float = 0.5,
                  heartbeat_interval_s: float = 10.0,
                  claim_policy=None,
-                 beam_fn=None, logger=None):
+                 batch_size: int = 1,
+                 batch_linger_s: float = 2.0,
+                 beam_fn=None, batch_fn=None, logger=None):
         if cfg is None:
             from tpulsar.config import settings
             cfg = settings()
@@ -100,14 +103,33 @@ class SearchServer:
         #: injectable for tests: the fleet.worker fault's hard process
         #: exit (a crash leaves claims in place — no drain, no result)
         self._crash = os._exit
-        self.pipeline = StageInPipeline(
-            claim=lambda: protocol.claim_next_ticket(
-                self.spool, self.worker_id,
-                policy=self.claim_policy,
-                worker_class=self.worker_class),
-            workdir_base=cfg.processing.base_working_directory,
-            cfg=cfg, depth=prefetch_depth, poll_s=poll_s,
-            logger=self.log, journal=self._journal)
+        #: batched admission (``serve --batch N``): claim up to N
+        #: compatible tickets per ordering pass and dispatch them as
+        #: one coalesced batch through executor.search_beam_batch —
+        #: a per-beam error, resume state, or a lying compat stamp
+        #: degrades THAT beam to the solo path, never its batchmates
+        self.batch_size = max(1, int(batch_size))
+        self.batch_fn = batch_fn or self._search_batch
+        if self.batch_size > 1:
+            self.pipeline = BatchStageInPipeline(
+                claim_batch=lambda n, compat: protocol.claim_batch(
+                    self.spool, n, self.worker_id,
+                    policy=self.claim_policy,
+                    worker_class=self.worker_class, compat=compat),
+                workdir_base=cfg.processing.base_working_directory,
+                cfg=cfg, batch=self.batch_size,
+                linger_s=batch_linger_s, depth=prefetch_depth,
+                poll_s=poll_s, logger=self.log,
+                journal=self._journal)
+        else:
+            self.pipeline = StageInPipeline(
+                claim=lambda: protocol.claim_next_ticket(
+                    self.spool, self.worker_id,
+                    policy=self.claim_policy,
+                    worker_class=self.worker_class),
+                workdir_base=cfg.processing.base_working_directory,
+                cfg=cfg, depth=prefetch_depth, poll_s=poll_s,
+                logger=self.log, journal=self._journal)
         self._drain = threading.Event()
         self._stopped = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -241,7 +263,10 @@ class SearchServer:
                     pass
                 prepared = self.pipeline.next(timeout=self.poll_s)
                 if prepared is not None:
-                    self._process(prepared)
+                    if isinstance(prepared, PreparedBatch):
+                        self._process_batch(prepared)
+                    else:
+                        self._process(prepared)
                     continue
                 if once and protocol.pending_count(self.spool) == 0 \
                         and protocol.claimed_count(self.spool) == 0:
@@ -383,6 +408,134 @@ class SearchServer:
                          compile_hits=outcome.compile_hits,
                          candidates=len(outcome.candidates),
                          dm_trials=outcome.num_dm_trials)
+
+    # ------------------------------------------------------------ one batch
+
+    def _search_batch(self, beams: list[PreparedBeam]):
+        """The real batch runner: search_job.run_search_batch over
+        the staged members — same library layering as _search_one, so
+        each beam's results directory is layout-identical whichever
+        admission mode claimed it."""
+        from tpulsar.cli import search_job
+        from tpulsar.search import executor
+
+        for prepared in beams:
+            faults.fire("serve.beam",
+                        detail=f"ticket {prepared.ticket_id}")
+        params = executor.SearchParams.from_config(self.cfg.searching)
+        jobs = []
+        for prepared in beams:
+            t = prepared.ticket
+            jobs.append({
+                "ppfns": prepared.ppfns, "workdir": prepared.workdir,
+                "outdir": t["outdir"], "zap": prepared.zaplist,
+                "label": prepared.ticket_id,
+                "journal": (lambda event, _t=t, **extra:
+                            self._journal(event, _t, **extra)),
+            })
+        return search_job.run_search_batch(
+            jobs, params,
+            log=lambda msg: self.log.info("[batch] %s", msg))
+
+    def _process_batch(self, batch: PreparedBatch) -> None:
+        t0 = time.time()
+        if faults.targets("fleet.worker"):
+            try:
+                faults.fire(
+                    "fleet.worker",
+                    detail=f"batch {batch.ticket_ids} worker "
+                           f"{self.worker_id or '-'}")
+            except BaseException:
+                # same crash footprint as the solo path: every
+                # member's claim stays in place with no result — the
+                # mid-batch kill the janitor must requeue per ticket
+                self.log.error("fleet.worker fault: crashing on "
+                               "batch %s", batch.ticket_ids)
+                self._crash(70)
+                return          # unreachable with the real os._exit
+        ok: list[PreparedBeam] = []
+        for prepared in batch.beams:
+            att = int(prepared.ticket.get("attempts", 0))
+            if prepared.error:
+                # a poisoned input fails ITS ticket only — the rest
+                # of the batch dispatches without it
+                self.log.error(
+                    "ticket %s stage-in failed: %s",
+                    prepared.ticket_id,
+                    prepared.error.splitlines()[0]
+                    if prepared.error else "?")
+                self._finish(prepared.ticket_id, "failed", t0,
+                             prepared.ticket.get("outdir", ""),
+                             error=prepared.error, attempts=att)
+                continue
+            ok.append(prepared)
+        if not ok:
+            return
+        # the batch-dispatch evidence: ONE fleet-level journal event
+        # naming the members (their own chains carry claim/result),
+        # plus per-beam search_start so every chain stays well-formed
+        journal.record(self.spool, "batch_dispatch",
+                       worker=self.worker_id, beams=len(ok),
+                       tickets=[p.ticket_id for p in ok])
+        telemetry.beam_batch_occupancy().set(len(ok))
+        for prepared in ok:
+            telemetry.trace.instant("serve_beam_start",
+                                    ticket=prepared.ticket_id)
+            self._journal("search_start", prepared.ticket)
+        misses0 = self._compile_misses_total()
+        try:
+            # the per-beam deadline scales with the batch: B beams of
+            # device work ride one dispatch stream
+            results = policy.run_with_deadline(
+                lambda: self.batch_fn(ok),
+                self.beam_deadline_s * len(ok),
+                label=f"serve batch x{len(ok)}")
+        except policy.DeadlineExceeded as e:
+            self.log.error(
+                "batch of %d exceeded its %.0f s deadline; workdirs "
+                "left to the abandoned runner", len(ok),
+                self.beam_deadline_s * len(ok))
+            d_miss = self._compile_misses_total() - misses0
+            for prepared in ok:
+                self._finish(
+                    prepared.ticket_id, "failed", t0,
+                    prepared.ticket.get("outdir", ""), error=str(e),
+                    attempts=int(prepared.ticket.get("attempts", 0)),
+                    compile_misses=d_miss)
+            return
+        except Exception as e:
+            import traceback
+            self.log.exception("batch of %d failed", len(ok))
+            err = f"{e}\n{traceback.format_exc()}"[:4000]
+            d_miss = self._compile_misses_total() - misses0
+            for prepared in ok:
+                prepared.cleanup()
+                self._finish(
+                    prepared.ticket_id, "failed", t0,
+                    prepared.ticket.get("outdir", ""), error=err,
+                    attempts=int(prepared.ticket.get("attempts", 0)),
+                    compile_misses=d_miss)
+            return
+        for prepared, (status, payload, path) in zip(ok, results):
+            prepared.cleanup()
+            att = int(prepared.ticket.get("attempts", 0))
+            outdir = prepared.ticket.get("outdir", "")
+            if status == "failed":
+                self._finish(prepared.ticket_id, "failed", t0, outdir,
+                             error=str(payload)[:4000], attempts=att,
+                             batch_path=path)
+            elif status == "skipped":
+                self._finish(prepared.ticket_id, "skipped", t0,
+                             outdir, attempts=att, batch_path=path)
+            else:
+                self._finish(prepared.ticket_id, "done", t0, outdir,
+                             attempts=att,
+                             compile_misses=payload.compile_misses,
+                             compile_hits=payload.compile_hits,
+                             candidates=len(payload.candidates),
+                             dm_trials=payload.num_dm_trials,
+                             batch_path=path,
+                             batch_beams=len(ok))
 
     @staticmethod
     def _compile_misses_total() -> int:
