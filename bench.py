@@ -2129,6 +2129,126 @@ def run_autoscale() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_queue() -> None:
+    """``bench.py --queue``: the spool vs sqlite TicketQueue A/B —
+    claim/finish throughput under N contending worker processes.
+
+    The same ticket set (zero-length stub beams: every worker-second
+    is queue protocol, not science) drains through each backend in
+    turn: N ``tpulsar.chaos.worker`` processes hammer
+    claim→result→release until the queue is empty.  Throughput is
+    measured from the journal's own evidence — first ``claimed`` to
+    last ``result`` — so process startup does not pollute the rate,
+    and exactly-once is asserted from the same stream (one terminal
+    result per ticket, no losses; ``duplicate_results`` /
+    ``lost_tickets`` must be 0).  Emits one bench/v2 record with an
+    additive ``queue`` key; headline ``value`` is the sqlite
+    backend's tickets/s under contention — the number the WAL +
+    transactional-CAS design must not regress.  Knobs:
+    TPULSAR_QBENCH_NTICKETS (default 120) / WORKERS (default 4) /
+    KEEP=1 keeps the scratch spools."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    from tpulsar.obs import journal
+
+    nticks = int(os.environ.get("TPULSAR_QBENCH_NTICKETS", "120"))
+    nworkers = int(os.environ.get("TPULSAR_QBENCH_WORKERS", "4"))
+    base = tempfile.mkdtemp(prefix="tpulsar_queuebench_")
+
+    def one(tag: str) -> dict:
+        spool = os.path.join(base, f"spool_{tag}")
+        os.makedirs(spool, exist_ok=True)
+        url = (f"sqlite:{os.path.join(spool, 'queue.db')}"
+               if tag == "sqlite" else f"spool:{spool}")
+        q = get_ticket_queue(url)
+        for i in range(nticks):
+            q.submit(f"qb-{i:04d}", ["bench://synthetic"],
+                     os.path.join(base, f"out_{tag}", f"{i:04d}"),
+                     job_id=i, beam_s=0.0)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        logdir = os.path.join(base, f"logs_{tag}")
+        os.makedirs(logdir, exist_ok=True)
+        _log(f"queue bench [{tag}]: {nworkers} workers contending "
+             f"for {nticks} tickets on {url} ...")
+        procs = []
+        for w in range(nworkers):
+            logf = open(os.path.join(logdir, f"qb{w}.log"), "w")
+            procs.append((subprocess.Popen(
+                [sys.executable, "-m", "tpulsar.chaos.worker",
+                 "--spool", spool, "--queue", url,
+                 "--worker-id", f"qb{w}", "--beam-s", "0",
+                 "--poll-s", "0.01", "--heartbeat-s", "5",
+                 "--no-checkpoint", "--once"],
+                env=env, stdout=logf, stderr=subprocess.STDOUT),
+                logf))
+        rcs = []
+        for p, logf in procs:
+            rcs.append(p.wait(timeout=600))
+            logf.close()
+        # rate from journal truth (first claim -> last result), so
+        # interpreter startup is not charged to the backend
+        events = journal.read_events(spool)
+        claims = [e["t"] for e in events
+                  if e.get("event") == "claimed"]
+        res = [e for e in events if e.get("event") == "result"]
+        per_ticket: dict = {}
+        for e in res:
+            per_ticket[e.get("ticket")] = \
+                per_ticket.get(e.get("ticket"), 0) + 1
+        wall = (max(e["t"] for e in res) - min(claims)
+                if res and claims else -1.0)
+        return {
+            "url": url,
+            "wall_s": round(wall, 3),
+            "tickets_per_s": (round(nticks / wall, 3)
+                              if wall > 0 else -1.0),
+            "done": q.state_count("done"),
+            "duplicate_results": sum(n - 1
+                                     for n in per_ticket.values()
+                                     if n > 1),
+            "lost_tickets": nticks - len(per_ticket),
+            "worker_rcs": rcs,
+        }
+
+    spool_side = one("spool")
+    sqlite_side = one("sqlite")
+    ratio = (round(sqlite_side["tickets_per_s"]
+                   / spool_side["tickets_per_s"], 3)
+             if spool_side["tickets_per_s"] > 0
+             and sqlite_side["tickets_per_s"] > 0 else -1.0)
+    clean = all(s["duplicate_results"] == 0 and s["lost_tickets"] == 0
+                and s["done"] == nticks and not any(s["worker_rcs"])
+                for s in (spool_side, sqlite_side))
+    _log(f"queue throughput ({nworkers} workers, {nticks} tickets): "
+         f"spool {spool_side['tickets_per_s']}/s, sqlite "
+         f"{sqlite_side['tickets_per_s']}/s "
+         f"({ratio if ratio >= 0 else '?'}x); exactly-once "
+         f"{'clean' if clean else 'VIOLATED'}")
+    _emit({
+        "metric": "queue_sqlite_tickets_per_s",
+        "value": sqlite_side["tickets_per_s"],
+        "unit": "/s",
+        "queue": {
+            "tickets": nticks, "workers": nworkers,
+            "spool": spool_side, "sqlite": sqlite_side,
+            "sqlite_vs_spool": ratio,
+            # the correctness rows: MUST be 0 (CI asserts them
+            # un-toleranced; the gate skips zero-valued keys)
+            "duplicate_results": (spool_side["duplicate_results"]
+                                  + sqlite_side["duplicate_results"]),
+            "lost_tickets": (spool_side["lost_tickets"]
+                             + sqlite_side["lost_tickets"]),
+            "exactly_once_ok": clean,
+        },
+    })
+    if os.environ.get("TPULSAR_QBENCH_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _usable_cpus() -> list:
     """The CPU ids this process may actually run on, for taskset
     pinning (a cgroup cpuset need not start at 0 or be contiguous)."""
@@ -2460,6 +2580,9 @@ def main() -> None:
         return
     if "--autoscale" in sys.argv:
         run_autoscale()
+        return
+    if "--queue" in sys.argv:
+        run_queue()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

@@ -83,6 +83,8 @@ DEFAULT_KEYS = (
     ("autoscale.cost_per_beam_ws", "lower"),
     ("autoscale.queue_wait_p95_s", "lower"),
     ("autoscale.cost_saving", "higher"),
+    ("queue.spool.tickets_per_s", "higher"),
+    ("queue.sqlite.tickets_per_s", "higher"),
 )
 
 

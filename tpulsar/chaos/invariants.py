@@ -79,8 +79,28 @@ import json
 import os
 import time
 
+from tpulsar.frontdoor import queue as queue_mod
 from tpulsar.obs import journal
 from tpulsar.serve import protocol
+
+
+def _resolve(spool_or_queue):
+    """Accept a spool path, a backend URL, or a TicketQueue instance
+    and return ``(queue, journal_root)`` — the auditor judges every
+    backend against the same list through the backend's own verifier
+    surface (``ticket_presence`` / ``read_result`` /
+    ``orphan_sweep``), while the journal, fleet.json, and checkpoint
+    litter stay physical reads at the journal root."""
+    if isinstance(spool_or_queue, queue_mod.TicketQueue):
+        q = spool_or_queue
+    else:
+        q = queue_mod.get_ticket_queue(str(spool_or_queue))
+    root = q.journal_root
+    if not root:
+        raise ValueError(
+            f"chaos verify needs a journal-backed queue, not "
+            f"{q.backend!r} — the evidence IS the on-disk journal")
+    return q, root
 
 #: invariant name -> one-line contract (docs/operations.md renders
 #: this table; keep the names stable — they are the report API)
@@ -152,13 +172,6 @@ def _ticket_tenant(events: list[dict]) -> str:
     return "default"
 
 
-def _spool_presence(spool: str, tid: str) -> dict:
-    """Which states physically hold the ticket right now."""
-    out = {}
-    for state in ("incoming", "claimed", "done", "quarantine"):
-        out[state] = os.path.exists(
-            protocol.ticket_path(spool, tid, state))
-    return out
 
 
 def _audit_chain(tid: str, events: list[dict], presence: dict,
@@ -507,22 +520,14 @@ def _elastic_sweep(events: list[dict]) -> list[dict]:
     return out
 
 
-def _sidefile_sweep(spool: str) -> list[dict]:
-    out = []
-    for state in ("incoming", "claimed", "done", "quarantine"):
-        d = os.path.join(spool, state)
-        try:
-            names = os.listdir(d)
-        except OSError:
-            continue
-        for name in names:
-            if name.endswith(".tmp") or ".json.claiming." in name \
-                    or ".json.takeover." in name:
-                out.append(_v(
-                    "no_orphan_sidefiles",
-                    name.split(".json")[0],
-                    f"{state}/{name} survived quiesce"))
-    return out
+def _sidefile_sweep(q) -> list[dict]:
+    # the backend's own accounting of transaction transients: the
+    # spool reports surviving .tmp/.claiming/.takeover side-files,
+    # the sqlite backend has none by construction
+    return [_v("no_orphan_sidefiles", o.get("ticket", ""),
+               f"{o.get('state', '?')}/{o.get('name', '?')} "
+               f"survived quiesce")
+            for o in q.orphan_sweep()]
 
 
 def _capacity_check(spool: str) -> list[dict]:
@@ -548,22 +553,26 @@ def _capacity_check(spool: str) -> list[dict]:
 def verify(spool: str, *, tenants: dict | None = None,
            max_attempts: int = protocol.DEFAULT_MAX_ATTEMPTS,
            quiesced: bool = True) -> dict:
-    """Run every invariant over the spool's journal + state.
+    """Run every invariant over the queue's journal + state.
 
-    ``quiesced=False`` (a live or aborted run) skips the judgments
-    that only hold after drain: lost-ticket (it may still be in
-    flight), leftover side-files, and done-but-still-claimed.
+    ``spool`` is a spool path, a backend URL (``sqlite:<path>``), or
+    a TicketQueue instance — state questions go through the backend's
+    verifier surface, so every backend is judged against exactly this
+    list.  ``quiesced=False`` (a live or aborted run) skips the
+    judgments that only hold after drain: lost-ticket (it may still
+    be in flight), leftover side-files, and done-but-still-claimed.
     Returns ``{"ok", "violations", "invariants", "checked"}``."""
+    q, root = _resolve(spool)
     bad_lines: list = []
     violations: list[dict] = []
-    events = journal.read_events(spool, bad_lines=bad_lines)
+    events = journal.read_events(root, bad_lines=bad_lines)
     for bad in bad_lines:
         violations.append(_v(
             "journal_integrity", "",
             f"unparseable mid-file line {bad['line']} of "
             f"{os.path.basename(bad['path'])}: {bad['text'][:80]!r}"))
     per_ticket = journal.iter_tickets(events)
-    done_recs = {tid: protocol.read_result(spool, tid) or {}
+    done_recs = {tid: q.read_result(tid) or {}
                  for tid in per_ticket}
 
     traces: dict[str, set] = {}
@@ -576,7 +585,7 @@ def verify(spool: str, *, tenants: dict | None = None,
               "scale_downs": sum(1 for e in events
                                  if e.get("event") == "scale_down")}
     for tid, evs in sorted(per_ticket.items()):
-        presence = _spool_presence(spool, tid)
+        presence = q.ticket_presence(tid)
         violations.extend(_audit_chain(tid, evs, presence,
                                        max_attempts, quiesced,
                                        done_rec=done_recs.get(tid)))
@@ -616,16 +625,16 @@ def verify(spool: str, *, tenants: dict | None = None,
     violations.extend(_quota_sweep(per_ticket, done_recs, tenants))
     violations.extend(_elastic_sweep(events))
     if quiesced:
-        violations.extend(_sidefile_sweep(spool))
+        violations.extend(_sidefile_sweep(q))
         violations.extend(_checkpoint_litter_sweep(per_ticket))
-    violations.extend(_capacity_check(spool))
+    violations.extend(_capacity_check(root))
 
     by_inv = {name: 0 for name in INVARIANTS}
     for v in violations:
         by_inv[v["invariant"]] = by_inv.get(v["invariant"], 0) + 1
     return {"ok": not violations, "violations": violations,
             "invariants": by_inv, "checked": counts,
-            "spool": spool, "quiesced": quiesced}
+            "spool": root, "quiesced": quiesced}
 
 
 # ------------------------------------------------------------ live tail
@@ -640,6 +649,7 @@ def tail_verify(spool: str, *, tenants: dict | None = None,
     event, the optional timeout, Ctrl-C — or ``_stop()`` returning
     True (tests) — then runs one full ``verify`` (quiesced iff the
     run announced its end) and returns its report."""
+    q, root = _resolve(spool)
     offset = 0
     seen: set[tuple] = set()
     ended = False
@@ -658,7 +668,7 @@ def tail_verify(spool: str, *, tenants: dict | None = None,
         while True:
             try:
                 new, offset = journal.read_events(
-                    spool, after_offset=offset)
+                    root, after_offset=offset)
             except journal.JournalCorrupt as e:
                 echo(f"[journal_integrity] {e}")
                 break
@@ -676,7 +686,7 @@ def tail_verify(spool: str, *, tenants: dict | None = None,
                     touched.add(tid)
             for tid in sorted(touched):
                 evs = per_ticket[tid]
-                presence = _spool_presence(spool, tid)
+                presence = q.ticket_presence(tid)
                 for v in _audit_chain(tid, evs, presence,
                                       max_attempts, quiesced=False):
                     _report(v)
@@ -701,7 +711,7 @@ def tail_verify(spool: str, *, tenants: dict | None = None,
             time.sleep(poll_s)
     except KeyboardInterrupt:
         pass
-    return verify(spool, tenants=tenants, max_attempts=max_attempts,
+    return verify(q, tenants=tenants, max_attempts=max_attempts,
                   quiesced=ended)
 
 
@@ -841,8 +851,12 @@ def render_verify(report: dict) -> str:
 
 def render_report(spool: str) -> str:
     """The post-run digest: the conductor's manifest, the journal's
-    per-status counts, recovery timing, and the invariant verdict."""
+    per-status counts, recovery timing, and the invariant verdict.
+    A manifest that names a ``queue_url`` routes the verify through
+    that backend (a sqlite run's report works from the spool path
+    alone)."""
     from tpulsar.chaos import scenario as scenario_mod
+    _, spool = _resolve(spool)
     lines = [f"chaos report: {spool}"]
     manifest = protocol._read_json(scenario_mod.run_path(spool))
     if manifest:
@@ -874,7 +888,8 @@ def render_report(spool: str) -> str:
             f"wasted compute "
             f"{k.get('wasted_compute_s') if k.get('wasted_compute_s') is not None else '-'} s")
     tenants = (manifest or {}).get("tenants") or {}
-    report = verify(spool, tenants=tenants,
+    target = (manifest or {}).get("queue_url") or spool
+    report = verify(target, tenants=tenants,
                     quiesced=bool((manifest or {}).get("quiesced",
                                                        True)))
     lines.append(render_verify(report))

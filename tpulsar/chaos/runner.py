@@ -29,6 +29,7 @@ import threading
 import time
 
 from tpulsar.chaos import scenario as scenario_mod
+from tpulsar.frontdoor.queue import get_ticket_queue
 from tpulsar.obs import journal, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.serve import protocol
@@ -39,10 +40,22 @@ _SIGNALS = {"KILL": signal.SIGKILL, "TERM": signal.SIGTERM,
 
 class ChaosRunner:
     def __init__(self, sc: scenario_mod.Scenario, spool: str, *,
+                 queue_url: str = "",
                  worker_extra_args: tuple[str, ...] = (),
                  logger=None, sleeper=time.sleep):
         self.sc = sc
         self.spool = protocol.ensure_spool(spool)
+        #: the ticket backend the WHOLE storm rides — conductor
+        #: submissions, the controller's janitor, the gateway, and
+        #: every worker subprocess (via --queue on its command
+        #: line).  A corrupt sqlite db refuses loudly right here,
+        #: before any process is spawned.
+        self.queue_url = sc.effective_queue_url(self.spool,
+                                                override=queue_url)
+        self.q = get_ticket_queue(self.queue_url)
+        #: journal root: == spool for the spool backend and for the
+        #: 'sqlite' token's queue.db-inside-the-run layout
+        self.jroot = self.q.journal_root or self.spool
         self.worker_extra_args = tuple(worker_extra_args)
         self.log = logger or get_logger("chaos")
         self.sleeper = sleeper
@@ -67,6 +80,7 @@ class ChaosRunner:
         if self.sc.worker_kind == "stub":
             return [sys.executable, "-m", "tpulsar.chaos.worker",
                     "--spool", self.spool, "--worker-id", worker_id,
+                    "--queue", self.queue_url,
                     "--beam-s", str(self.sc.beam_s),
                     "--max-attempts", str(self.sc.max_attempts),
                     *batch, *self.worker_extra_args]
@@ -76,6 +90,7 @@ class ChaosRunner:
             argv += ["--config", cfgpath]
         argv += ["serve", "--spool", self.spool,
                  "--worker-id", worker_id, "--no-warmstart",
+                 "--queue", self.queue_url,
                  *batch, *self.worker_extra_args]
         return argv
 
@@ -96,6 +111,7 @@ class ChaosRunner:
                if self.sc.autoscale else None)
         self._ctrl = FleetController(
             self.spool, workers=self.sc.workers,
+            queue=self.q,
             worker_cmd=self._worker_cmd,
             worker_env=self._worker_env,
             max_worker_restarts=self.sc.max_worker_restarts,
@@ -109,10 +125,9 @@ class ChaosRunner:
 
     def _start_gateway(self, port: int = 0):
         from tpulsar.frontdoor.gateway import GatewayServer
-        from tpulsar.frontdoor.queue import FilesystemSpoolQueue
         from tpulsar.frontdoor.tenancy import TenantPolicy
         self.gateway = GatewayServer(
-            queue=FilesystemSpoolQueue(self.spool),
+            queue=self.q,
             policy=TenantPolicy(self.sc.tenants),
             port=port,
             outdir_base=os.path.join(
@@ -127,7 +142,7 @@ class ChaosRunner:
             else self.sc.workers
         deadline = time.time() + timeout_s
         while time.time() < deadline:
-            if len(protocol.fresh_workers(self.spool)) >= want:
+            if len(self.q.fresh_workers()) >= want:
                 return True
             self.sleeper(0.1)
         return False
@@ -140,13 +155,13 @@ class ChaosRunner:
                "worker": worker, **extra}
         self.actions.append(rec)
         telemetry.chaos_actions_total().inc(action=action)
-        journal.record(self.spool, "chaos_action", action=action,
+        journal.record(self.jroot, "chaos_action", action=action,
                        worker=worker, t_rel=round(t_rel, 3), **extra)
         self.log.info("chaos t+%.2f: %s %s %s", t_rel, action,
                       worker or "-", extra or "")
 
     def _worker_pid(self, worker_id: str) -> int | None:
-        hb = protocol.read_heartbeat(self.spool, worker_id)
+        hb = self.q.read_heartbeat(worker_id)
         pid = (hb or {}).get("pid")
         return int(pid) if pid else None
 
@@ -258,8 +273,10 @@ class ChaosRunner:
         if wl.priority not in (None, ""):
             extra["priority"] = wl.priority
         try:
-            protocol.write_ticket(self.spool, tid, datafiles, outdir,
-                                  job_id=i, **extra)
+            # QueueCorrupt deliberately NOT absorbed here: a corrupt
+            # database mid-storm must abort the run loudly, never
+            # read as one refused submission
+            self.q.submit(tid, datafiles, outdir, job_id=i, **extra)
             self.tickets.append(tid)
         except OSError as e:
             self._journal_action(t_rel, "submit_refused",
@@ -291,10 +308,11 @@ class ChaosRunner:
             # boot must not eat into window positions
             t0 = time.time()
             scenario_mod.write_schedule(self.spool, sc, t0)
-            journal.record(self.spool, "chaos_run_start",
+            journal.record(self.jroot, "chaos_run_start",
                            scenario=sc.name, seed=sc.seed,
                            workers=sc.workers,
-                           gateway=bool(sc.gateway))
+                           gateway=bool(sc.gateway),
+                           queue_url=self.queue_url)
             # one merged, seeded dispatch plan: submissions at their
             # (jittered) cadence, conductor actions at their t
             rng = random.Random(sc.seed)
@@ -324,8 +342,8 @@ class ChaosRunner:
             deadline = min(t0 + sc.duration_s,
                            time.time() + sc.quiesce_timeout_s)
             while time.time() < deadline:
-                if all(protocol.read_result(self.spool, tid)
-                       is not None for tid in self.tickets):
+                if all(self.q.read_result(tid) is not None
+                       for tid in self.tickets):
                     quiesced = True
                     break
                 self.sleeper(0.25)
@@ -341,7 +359,7 @@ class ChaosRunner:
                     os.kill(pid, signal.SIGCONT)
                 except OSError:
                     pass
-            journal.record(self.spool, "chaos_run_end",
+            journal.record(self.jroot, "chaos_run_end",
                            scenario=sc.name, status=status,
                            quiesced=quiesced)
             if self._ctrl is not None:
@@ -354,6 +372,7 @@ class ChaosRunner:
             "scenario": sc.name, "seed": sc.seed,
             "tenants": sc.tenants, "max_attempts": sc.max_attempts,
             "workers": sc.workers, "worker_kind": sc.worker_kind,
+            "queue_url": self.queue_url,
             "gateway": bool(sc.gateway),
             "gateway_port": self._gateway_port,
             "t0": t0, "wall_s": round(time.time() - t0, 3),

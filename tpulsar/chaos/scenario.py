@@ -134,6 +134,14 @@ class Scenario:
     #: and journals a batch_dispatch per coalesced group — the storm
     #: then audits exactly-once/attempts under batch claims too
     batch: int = 1
+    #: ticket-queue backend for the whole storm: "" = the spool
+    #: itself (the default, byte-identical to every pre-queue_url
+    #: scenario), the token "sqlite" = a queue.db INSIDE the run
+    #: spool (sqlite:<spool>/queue.db — journal and artifacts stay
+    #: where every consumer expects them), or a full backend URL.
+    #: The conductor, every worker, and the verifier all resolve the
+    #: same backend from this one field
+    queue_url: str = ""
     tenants: dict = dataclasses.field(default_factory=dict)
     #: non-empty = run the fleet ELASTIC: the dict is an
     #: autoscale.AutoscaleConfig (validated at load, same loud
@@ -145,6 +153,20 @@ class Scenario:
     timeline: list[Action] = dataclasses.field(default_factory=list)
     quiesce_timeout_s: float = 45.0
     poll_s: float = 0.3             # controller supervision cadence
+
+    def effective_queue_url(self, spool: str,
+                            override: str = "") -> str:
+        """The backend URL this run actually uses: '' stays the
+        spool, the 'sqlite' token expands to a queue.db inside it,
+        anything else is taken verbatim.  ``override`` (the CLI's
+        ``chaos run --queue``) wins over the scenario's own field —
+        same token rules."""
+        url = override or self.queue_url
+        if not url:
+            return f"spool:{spool}"
+        if url == "sqlite":
+            return f"sqlite:{os.path.join(spool, 'queue.db')}"
+        return url
 
     def fault_windows(self) -> list[Action]:
         return [a for a in self.timeline if a.action == "set_faults"]
@@ -229,6 +251,14 @@ def from_dict(doc: dict) -> Scenario:
         raise ValueError("workers must be >= 1")
     if sc.batch < 1:
         raise ValueError("batch must be >= 1")
+    if sc.queue_url and sc.queue_url != "sqlite" \
+            and ":" not in sc.queue_url:
+        raise ValueError(
+            f"queue_url {sc.queue_url!r} is neither the 'sqlite' "
+            f"token nor a backend URL (sqlite:<path>, spool:<dir>)")
+    if sc.queue_url == "memory" or sc.queue_url.startswith("memory:"):
+        raise ValueError("queue_url=memory cannot host a multi-"
+                         "process storm (no cross-process state)")
     if sc.gateway is False and wl.via == "gateway":
         raise ValueError("workload.via=gateway needs gateway: true")
     if sc.worker_kind == "serve" and wl.datafiles is None:

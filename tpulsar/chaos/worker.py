@@ -149,6 +149,12 @@ def _policy():
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--spool", required=True)
+    p.add_argument("--queue", default="",
+                   help="ticket-queue backend URL (sqlite:<path>, "
+                        "spool:<dir>); default = the spool itself. "
+                        "The spool stays the run root: journal, "
+                        "heartbeat files for spool runs, checkpoint "
+                        "outdirs")
     p.add_argument("--worker-id", required=True)
     p.add_argument("--worker-class", default="",
                    help="worker class stamped on heartbeats and "
@@ -205,6 +211,15 @@ def main(argv=None) -> int:
     # fleet-fresh gate already accounts for it)
     import tpulsar.checkpoint  # noqa: F401
     spool, wid = args.spool, args.worker_id
+    # all ticket traffic rides the backend interface; a corrupt
+    # sqlite queue raises QueueCorrupt here and the worker dies
+    # LOUDLY at boot (containment, not absorption)
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    q = get_ticket_queue(args.queue or f"spool:{spool}")
+    # direct journal appends (search_start, pass events, dispatch
+    # evidence) land at the backend's journal root — identical to the
+    # spool for every committed scenario layout
+    jroot = q.journal_root or spool
 
     draining = []
     signal.signal(signal.SIGTERM, lambda *a: draining.append(1))
@@ -217,9 +232,9 @@ def main(argv=None) -> int:
         if not force and now - last_beat[0] < args.heartbeat_s:
             return
         try:
-            protocol.write_heartbeat(
-                spool, worker_id=wid, status=status,
-                queue_depth=protocol.pending_count(spool),
+            q.heartbeat(
+                wid, status=status,
+                queue_depth=q.pending_count(),
                 max_queue_depth=args.depth,
                 **({"worker_class": args.worker_class}
                    if args.worker_class else {}))
@@ -230,7 +245,7 @@ def main(argv=None) -> int:
     # boot recovery, like the real server — guarded: a fault window
     # open at boot must not kill the worker before its first claim
     try:
-        protocol.requeue_stale_claims(spool, args.max_attempts)
+        q.requeue_stale_claims(args.max_attempts)
     except OSError:
         pass
     beat(force=True)
@@ -243,7 +258,7 @@ def main(argv=None) -> int:
             os._exit(70)
         tid = rec.get("ticket", "?")
         att = int(rec.get("attempts", 0))
-        journal.record(spool, "search_start", ticket=tid, worker=wid,
+        journal.record(jroot, "search_start", ticket=tid, worker=wid,
                        attempt=att, trace_id=rec.get("trace_id", ""))
         # worker-crash injection: hard exit mid-beam, claim in place,
         # no result, no drain — the footprint the janitor must heal
@@ -259,7 +274,7 @@ def main(argv=None) -> int:
         try:
             faults.fire("serve.beam", detail=f"ticket {tid}")
             if npasses > 0:
-                extras = _run_pass_beam(spool, wid, rec, args,
+                extras = _run_pass_beam(jroot, wid, rec, args,
                                         npasses)
             else:
                 time.sleep(float(rec.get("beam_s", args.beam_s)))
@@ -267,8 +282,8 @@ def main(argv=None) -> int:
             status, err = "failed", str(e)[:500]   # this ticket only
         for io_try in range(3):
             try:
-                protocol.write_result(
-                    spool, tid, status, rc=0 if status == "done"
+                q.write_result(
+                    tid, status, rc=0 if status == "done"
                     else 1, error=err,
                     beam_seconds=float(rec.get("beam_s",
                                                args.beam_s)),
@@ -292,12 +307,12 @@ def main(argv=None) -> int:
     while not draining:
         try:
             if args.batch > 1:
-                recs = protocol.claim_batch(
-                    spool, args.batch, wid, policy=policy,
+                recs = q.claim_batch(
+                    args.batch, wid, policy=policy,
                     worker_class=args.worker_class)
             else:
-                one = protocol.claim_next_ticket(
-                    spool, wid, policy=policy,
+                one = q.claim_next(
+                    wid, policy=policy,
                     worker_class=args.worker_class)
                 recs = [one] if one is not None else []
         except OSError:
@@ -305,8 +320,8 @@ def main(argv=None) -> int:
             time.sleep(args.poll_s)
             continue
         if not recs:
-            if args.once and protocol.pending_count(spool) == 0 \
-                    and protocol.claimed_count(spool) == 0:
+            if args.once and q.pending_count() == 0 \
+                    and q.claimed_count() == 0:
                 break
             beat()
             time.sleep(args.poll_s)
@@ -314,7 +329,7 @@ def main(argv=None) -> int:
         if args.batch > 1:
             # the batch-dispatch evidence (fleet-level, no ticket
             # key): the members' own chains carry claim/result
-            journal.record(spool, "batch_dispatch", worker=wid,
+            journal.record(jroot, "batch_dispatch", worker=wid,
                            beams=len(recs),
                            tickets=[r.get("ticket", "?")
                                     for r in recs])
@@ -328,7 +343,7 @@ def main(argv=None) -> int:
         beat()
     if draining:
         try:
-            protocol.requeue_own_claims(spool)
+            q.requeue_own_claims()
         except OSError:
             pass
     beat("stopped", force=True)

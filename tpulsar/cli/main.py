@@ -176,6 +176,13 @@ def _serve_spool(cfg) -> str:
     return cfg.jobpooler.serve_spool or protocol.default_spool_dir(cfg)
 
 
+def _default_queue_url() -> str:
+    """TPULSAR_QUEUE_URL: the deployment-wide default ticket-queue
+    backend (``sqlite:<path>`` / ``spool:<dir>``).  A --queue flag
+    always wins; empty means the serve spool."""
+    return os.environ.get("TPULSAR_QUEUE_URL", "")
+
+
 def _make_pool(args, cfg):
     from tpulsar.orchestrate.pool import JobPool
     from tpulsar.orchestrate.queue_managers import get_queue_manager
@@ -264,8 +271,10 @@ def cmd_serve(args):
     from tpulsar.serve.server import SearchServer
 
     cfg = settings()
+    queue_url = args.queue or _default_queue_url()
     server = SearchServer(
         spool=args.spool or _serve_spool(cfg), cfg=cfg,
+        queue_url=queue_url,
         worker_id=args.worker_id,
         worker_class=args.worker_class,
         max_queue_depth=cfg.jobpooler.serve_queue_depth,
@@ -279,6 +288,8 @@ def cmd_serve(args):
         batch_linger_s=args.batch_linger)
     server.install_signal_handlers()
     print(f"serve: spool {server.spool} "
+          + (f"queue {server.queue.url} "
+             if server.queue.backend != "spool" else "")
           + (f"worker {args.worker_id} " if args.worker_id else "")
           + (f"class {args.worker_class} " if args.worker_class
              else "")
@@ -305,8 +316,13 @@ def cmd_fleet(args):
 
     cfg = settings()
     spool = args.spool or _serve_spool(cfg)
+    queue_url = args.queue or _default_queue_url()
+    queue = None
+    if queue_url:
+        from tpulsar.frontdoor.queue import get_ticket_queue
+        queue = get_ticket_queue(queue_url)
     if args.status:
-        print(fleet_ctl.render_status(spool))
+        print(fleet_ctl.render_status(spool, queue=queue))
         # scriptable health: nonzero when a running controller's
         # fleet.json went stale past the heartbeat grace
         return fleet_ctl.status_rc(spool)
@@ -340,12 +356,15 @@ def cmd_fleet(args):
             return 2
     ctrl = fleet_ctl.FleetController(
         spool=spool, workers=nworkers, once=args.once,
+        queue=queue,
         max_worker_restarts=args.max_restarts,
         ticket_max_attempts=cfg.jobpooler.serve_max_attempts,
         autoscale=autoscale_cfg,
         worker_args=tuple(args.worker_arg))
     print(f"fleet: {len(ctrl.workers)} worker(s) on spool {spool} "
-          f"(restart budget {args.max_restarts}, ticket attempts cap "
+          + (f"queue {ctrl.q.url} " if ctrl.q.backend != "spool"
+             else "")
+          + f"(restart budget {args.max_restarts}, ticket attempts cap "
           f"{cfg.jobpooler.serve_max_attempts}"
           + (f", elastic [{autoscale_cfg.min_workers}, "
              f"{autoscale_cfg.max_workers}] class "
@@ -385,7 +404,8 @@ def cmd_gateway(args):
                            policy=policy, host=host, port=port)
         role = f"router over {federate}"
     else:
-        queue = get_ticket_queue(args.queue or _serve_spool(cfg))
+        queue = get_ticket_queue(args.queue or _default_queue_url()
+                                 or _serve_spool(cfg))
         gw = GatewayServer(
             queue=queue, policy=policy, host=host, port=port,
             outdir_base=args.outdir_base or os.path.join(
@@ -877,11 +897,15 @@ def cmd_chaos(args):
         spool = _serve_spool(settings())
     if args.chaos_cmd == "run":
         sc = scenario.load(args.scenario)
+        url = sc.effective_queue_url(spool, override=args.queue)
         print(f"chaos run: scenario {sc.name!r} (seed {sc.seed}, "
               f"{sc.workers} {sc.worker_kind} worker(s)"
               + (", gateway" if sc.gateway else "")
-              + f") on spool {spool}", flush=True)
-        manifest = runner.run_scenario(sc, spool)
+              + f") on spool {spool}"
+              + (f" queue {url}" if not url.startswith("spool:")
+                 else ""), flush=True)
+        manifest = runner.run_scenario(sc, spool,
+                                       queue_url=args.queue)
         print(_json.dumps({k: manifest[k] for k in
                            ("scenario", "status", "quiesced",
                             "wall_s", "tickets", "actions")},
@@ -899,17 +923,24 @@ def cmd_chaos(args):
     if args.scenario:
         sc = scenario.load(args.scenario)
         tenants, max_attempts = sc.tenants, sc.max_attempts
+    # the audit target: --queue override > the manifest's recorded
+    # queue_url > the bare spool (the 'sqlite' token expands to the
+    # run's queue.db, mirroring the scenario field)
+    target = args.queue or (manifest or {}).get("queue_url") or ""
+    if target == "sqlite":
+        target = f"sqlite:{os.path.join(spool, 'queue.db')}"
+    target = target or spool
     if args.chaos_cmd == "verify":
         if args.tail:
             report = invariants.tail_verify(
-                spool, tenants=tenants, max_attempts=max_attempts,
+                target, tenants=tenants, max_attempts=max_attempts,
                 timeout_s=args.timeout)
         else:
             quiesced = not args.live and (
                 manifest is None or bool(manifest.get("quiesced",
                                                       True)))
             report = invariants.verify(
-                spool, tenants=tenants, max_attempts=max_attempts,
+                target, tenants=tenants, max_attempts=max_attempts,
                 quiesced=quiesced)
         print(invariants.render_verify(report))
         for name, n in report["invariants"].items():
@@ -918,9 +949,45 @@ def cmd_chaos(args):
                     n, invariant=name)
         return 0 if report["ok"] else 1
     if args.chaos_cmd == "report":
-        print(invariants.render_report(spool))
+        print(invariants.render_report(target))
         return 0
     return 2
+
+
+def cmd_queue(args):
+    """Ticket-queue maintenance (tpulsar/frontdoor/).
+
+    fsck — offline health check of a queue backend: PRAGMA
+    integrity_check + a truncating WAL checkpoint for
+    ``sqlite:<path>``, an orphan side-file sweep for a spool, plus
+    per-state counts either way.  Exit 1 on ANY finding (or a
+    database so corrupt the backend refuses to open it)."""
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    from tpulsar.frontdoor.sqlite_queue import QueueCorrupt
+
+    if args.queue_cmd != "fsck":
+        return 2
+    try:
+        q = get_ticket_queue(args.url)
+        report = q.fsck()
+    except QueueCorrupt as e:
+        # the backend refused to even open it — that IS the finding
+        print(f"fsck: CORRUPT — {e}")
+        return 1
+    except (OSError, ValueError) as e:
+        print(f"fsck: {e}", file=sys.stderr)
+        return 2
+    print(f"fsck {report['backend']}: {report['target']}")
+    counts = report.get("counts") or {}
+    print("  counts: " + " ".join(
+        f"{k}={v}" for k, v in sorted(counts.items())))
+    findings = report.get("findings") or []
+    for f in findings:
+        print(f"  FINDING {f.get('what', '?')}: "
+              f"{f.get('detail', '')}")
+    print("fsck: clean" if not findings
+          else f"fsck: {len(findings)} finding(s)")
+    return 1 if findings else 0
 
 
 def cmd_checkpoint(args):
@@ -1258,6 +1325,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--spool", default=None,
                     help="spool dir (default: jobpooler.serve_spool "
                          "or <base_working_directory>/.serve_spool)")
+    sp.add_argument("--queue", default="",
+                    help="ticket-queue backend URL (sqlite:<path> / "
+                         "spool:<dir>); default: TPULSAR_QUEUE_URL "
+                         "or the spool itself.  The spool stays the "
+                         "worker's scratch/log root either way")
     sp.add_argument("--no-warmstart", action="store_true",
                     help="skip the boot-time AOT gate (cache "
                          "activation still applies)")
@@ -1320,6 +1392,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--spool", default=None,
                     help="spool dir (default: jobpooler.serve_spool "
                          "or <base_working_directory>/.serve_spool)")
+    sp.add_argument("--queue", default="",
+                    help="ticket-queue backend URL the whole fleet "
+                         "claims from (sqlite:<path> / spool:<dir>); "
+                         "default: TPULSAR_QUEUE_URL or the spool.  "
+                         "Workers inherit it on their command line")
     sp.add_argument("--once", action="store_true",
                     help="exit 0 once the spool's tickets are all "
                          "terminal (CI / cron mode; workers run "
@@ -1494,12 +1571,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "(e.g. ci_smoke)")
     cp.add_argument("--spool", default=None,
                     help="spool dir (default: the serve spool)")
+    cp.add_argument("--queue", default="",
+                    help="ticket-queue backend URL for the storm "
+                         "(overrides the scenario's queue_url); the "
+                         "bare token 'sqlite' expands to "
+                         "sqlite:<spool>/queue.db")
     cp.set_defaults(fn=cmd_chaos)
     cp = csub.add_parser(
         "verify", help="assert the system invariants over the "
                        "spool's journal + state; exit 1 on any "
                        "violation")
     cp.add_argument("--spool", default=None)
+    cp.add_argument("--queue", default="",
+                    help="audit this queue backend URL instead of "
+                         "the spool (default: the run manifest's "
+                         "recorded queue_url); 'sqlite' expands to "
+                         "sqlite:<spool>/queue.db")
     cp.add_argument("--scenario", default=None,
                     help="scenario providing the tenant table / "
                          "attempts cap (default: the spool's run "
@@ -1521,9 +1608,26 @@ def build_parser() -> argparse.ArgumentParser:
         "report", help="post-run digest: actions, statuses, MTTR "
                        "per kill, invariant verdict")
     cp.add_argument("--spool", default=None)
+    cp.add_argument("--queue", default="",
+                    help="report against this queue backend URL "
+                         "(default: the run manifest's queue_url)")
     cp.add_argument("--scenario", default=None)
     cp.add_argument("--max-attempts", type=int, default=3)
     cp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser(
+        "queue",
+        help="ticket-queue maintenance: fsck runs the backend's "
+             "integrity audit (sqlite PRAGMA integrity_check + WAL "
+             "checkpoint, spool orphan-sidefile sweep) and prints "
+             "per-state counts; exit 1 on findings")
+    qsub = sp.add_subparsers(dest="queue_cmd", required=True)
+    qp = qsub.add_parser(
+        "fsck", help="audit a queue backend's on-disk state")
+    qp.add_argument("url",
+                    help="queue URL: sqlite:<path>, spool:<dir>, or "
+                         "a bare spool directory path")
+    qp.set_defaults(fn=cmd_queue)
 
     sp = sub.add_parser(
         "checkpoint",

@@ -137,6 +137,16 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
        "control; it failed its on-chip smoke)"),
     _k("TPULSAR_PROFILE", "path", "unset",
        "directory for a JAX profiler trace of the search block"),
+    _k("TPULSAR_QUEUE_BUSY_TIMEOUT_S", "float", "5 (resilience "
+       "policy timeout_s when configured)",
+       "SQLite ticket-queue lock-wait budget: connect timeout and "
+       "PRAGMA busy_timeout of every queue.db connection (contended "
+       "multi-worker claims wait this long before SQLITE_BUSY)"),
+    _k("TPULSAR_QUEUE_URL", "str (URL)", "unset (the spool)",
+       "deployment-wide default ticket-queue backend for serve/"
+       "fleet/gateway: sqlite:<path> or spool:<dir>; a --queue flag "
+       "beats it, the spool remains the scratch/log root either "
+       "way"),
     _k("TPULSAR_SP_DETREND", "enum(median|clipped_mean)",
        "median (via params)",
        "single-pulse detrend estimator; the env beats SearchParams "

@@ -219,9 +219,14 @@ class Autoscaler:
     the cooldown)."""
 
     def __init__(self, cfg: AutoscaleConfig, spool: str, *,
-                 clock=time.time):
+                 queue=None, clock=time.time):
         self.cfg = cfg.validate()
         self.spool = spool
+        #: the ticket backend signals are read from (counts,
+        #: freshness, capacity, journal tail).  None keeps the
+        #: classic spool reads — existing callers and tests see
+        #: identical behaviour.
+        self.q = queue
         self.clock = clock
         self._last_action_at: float = float("-inf")
         self._low_since: float | None = None
@@ -234,9 +239,13 @@ class Autoscaler:
 
     def _tail_queue_waits(self, now: float) -> None:
         try:
-            new, self._journal_offset = journal.read_events(
-                self.spool, after_offset=self._journal_offset,
-                bad_lines=[])
+            if self.q is not None:
+                new, self._journal_offset = self.q.read_events_after(
+                    self._journal_offset)
+            else:
+                new, self._journal_offset = journal.read_events(
+                    self.spool, after_offset=self._journal_offset,
+                    bad_lines=[])
         except OSError:
             return            # a sick journal costs a signal, never
             #                   the controller loop
@@ -264,6 +273,20 @@ class Autoscaler:
     def read_signals(self, live_workers: int) -> Signals:
         now = self.clock()
         self._tail_queue_waits(now)
+        if self.q is not None:
+            pending = self.q.pending_count()
+            return Signals(
+                t=now,
+                pending=pending,
+                claimed=self.q.claimed_count(),
+                live_workers=live_workers,
+                fresh_workers=len(self.q.fresh_workers()),
+                capacity=self.q.capacity(),
+                oldest_wait_s=(self.q.oldest_pending_age_s(now)
+                               if pending else 0.0),
+                queue_wait_p95_s=self._recent_p95(),
+                tenant_backlog={},      # filled at decision time
+            )
         pending = protocol.pending_count(self.spool)
         return Signals(
             t=now,
@@ -370,14 +393,18 @@ class Autoscaler:
 def journal_scale_event(spool: str, decision: Decision,
                         cfg: AutoscaleConfig,
                         workers_before: int, workers_after: int,
-                        victims: list[dict] | None = None
-                        ) -> dict | None:
+                        victims: list[dict] | None = None,
+                        queue=None) -> dict | None:
     """One journaled scale event per executed decision, carrying the
     triggering signals AND the policy bounds — self-contained
     evidence the ``scaling_bounded`` invariant and the --status
-    decision trail replay with no side channel."""
+    decision trail replay with no side channel.  ``spool`` is the
+    journal root; ``queue`` (optional) supplies the tenant backlog
+    for non-spool backends."""
     sig = dict(decision.signals.as_event())
-    sig["tenant_backlog"] = pending_by_tenant(spool) or {}
+    sig["tenant_backlog"] = (queue.pending_by_tenant() if queue
+                             is not None
+                             else pending_by_tenant(spool)) or {}
     if not sig["tenant_backlog"]:
         sig.pop("tenant_backlog")
     extra: dict = {}

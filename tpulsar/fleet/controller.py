@@ -47,6 +47,7 @@ import threading
 import time
 
 from tpulsar.fleet import autoscale as autoscale_mod
+from tpulsar.frontdoor import queue as queue_mod
 from tpulsar.obs import fleetview, journal, metrics, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.resilience import policy
@@ -127,8 +128,20 @@ class FleetController:
                  | None = None,
                  poll_s: float = 1.0,
                  drain_timeout_s: float = 120.0,
+                 queue: queue_mod.TicketQueue | None = None,
                  logger=None, sleeper=time.sleep):
         self.spool = protocol.ensure_spool(spool)
+        #: the ticket backend every queue-facing operation routes
+        #: through (janitor requeues, counts, heartbeats, the
+        #: elective-kill ledger).  Fleet PROCESS state — fleet.json,
+        #: fleet.prom, fleet.ctl, worker logs — stays on the spool
+        #: directory whatever the backend, so ``tpulsar fleet``
+        #: tooling keeps one place to look.
+        self.q = queue if queue is not None \
+            else queue_mod.FilesystemSpoolQueue(self.spool)
+        #: journal root (== spool for the spool backend and for a
+        #: queue.db living inside the run directory)
+        self.jroot = self.q.journal_root or self.spool
         self.once = once
         #: callable(worker_id) -> argv; the default launches the real
         #: ``tpulsar serve`` worker (tests inject stubs)
@@ -165,7 +178,8 @@ class FleetController:
             autoscale.validate()
             workers = max(autoscale.min_workers,
                           min(workers, autoscale.max_workers))
-            self._as = autoscale_mod.Autoscaler(autoscale, self.spool)
+            self._as = autoscale_mod.Autoscaler(autoscale, self.spool,
+                                                queue=self.q)
         self.workers = [
             _Worker(f"w{i}",
                     worker_class=(autoscale.worker_class
@@ -221,6 +235,11 @@ class FleetController:
             argv += ["--config", cfgpath]
         argv += ["serve", "--spool", self.spool,
                  "--worker-id", worker_id]
+        if self.q.backend != "spool":
+            # a non-spool backend rides the command line so worker
+            # SUBPROCESSES claim from the same queue the controller
+            # janitors (the spool stays their scratch/log root)
+            argv += ["--queue", self.q.url]
         if self.once:
             argv.append("--once")
         argv += list(self.worker_args)
@@ -248,7 +267,7 @@ class FleetController:
         w.incarnation += 1
         w.next_restart_at = None
         w.spawned_at = time.time()
-        journal.record(self.spool, "worker_spawn",
+        journal.record(self.jroot, "worker_spawn",
                        worker=w.worker_id, kind=kind, pid=w.pid,
                        incarnation=w.incarnation,
                        **({"worker_class": w.worker_class}
@@ -263,14 +282,12 @@ class FleetController:
         backend's aggregate capacity stops counting it immediately
         (its file would otherwise read fresh for up to the heartbeat
         max age)."""
-        hb = protocol.read_heartbeat(self.spool, w.worker_id)
+        hb = self.q.read_heartbeat(w.worker_id)
         if hb is not None and hb.get("pid") == w.pid \
                 and hb.get("status") != "stopped":
             hb["status"] = "stopped"
             try:
-                protocol._atomic_write_json(
-                    protocol.heartbeat_path(self.spool, w.worker_id),
-                    hb)
+                self.q.write_heartbeat_record(w.worker_id, hb)
             except OSError:
                 pass     # the heartbeat ages out on its own
 
@@ -288,7 +305,7 @@ class FleetController:
             w.proc = None
             w.last_rc = rc
             self._mark_worker_down(w)
-            journal.record(self.spool, "worker_exit",
+            journal.record(self.jroot, "worker_exit",
                            worker=w.worker_id, rc=rc, pid=w.pid,
                            incarnation=w.incarnation)
             if self.draining:
@@ -353,8 +370,8 @@ class FleetController:
         if time.time() < self._janitor_paused_until:
             return
         try:
-            requeued = protocol.requeue_stale_claims(
-                self.spool, self.ticket_max_attempts)
+            requeued = self.q.requeue_stale_claims(
+                self.ticket_max_attempts)
         except OSError as e:
             # a failing spool (ENOSPC burst, injected spool.io) must
             # not take the CONTROLLER down mid-loop: skip this beat,
@@ -367,7 +384,7 @@ class FleetController:
             self.log.warning(
                 "janitor requeued %d orphaned ticket(s): %s",
                 len(requeued), ", ".join(requeued))
-        for tid in protocol.list_tickets(self.spool, "quarantine"):
+        for tid in self.q.list_tickets("quarantine"):
             if tid not in self._quarantined_seen:
                 self._quarantined_seen.add(tid)
                 telemetry.fleet_quarantined_total().inc()
@@ -411,7 +428,7 @@ class FleetController:
             w.proc = None
             w.last_rc = rc
             self._mark_worker_down(w)
-            journal.record(self.spool, "worker_exit",
+            journal.record(self.jroot, "worker_exit",
                            worker=w.worker_id, rc=rc, pid=w.pid,
                            incarnation=w.incarnation,
                            kind="scale_down")
@@ -423,18 +440,19 @@ class FleetController:
             except ValueError:
                 pass
             # elastic slot ids are never reused, so a retired slot's
-            # spool files are permanently dead — remove them, or a
-            # long-lived fleet leaks one heartbeat + one metrics
-            # snapshot per scale cycle, all stat+parsed by every
-            # freshness/capacity probe forever
-            for path in (protocol.heartbeat_path(self.spool,
-                                                 w.worker_id),
-                         fleetview.snapshot_path(self.spool,
-                                                 w.worker_id)):
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+            # liveness/metrics records are permanently dead — remove
+            # them, or a long-lived fleet leaks one heartbeat + one
+            # metrics snapshot per scale cycle, all stat+parsed by
+            # every freshness/capacity probe forever
+            try:
+                self.q.remove_heartbeat(w.worker_id)
+            except OSError:
+                pass
+            try:
+                os.unlink(fleetview.snapshot_path(self.spool,
+                                                  w.worker_id))
+            except OSError:
+                pass
 
     def _pick_victim(self) -> _Worker | None:
         """Scale-down victim choice: ELASTIC slots only (a base slot
@@ -498,7 +516,8 @@ class FleetController:
                 import dataclasses as _dc
                 decision = _dc.replace(decision, n=spawned)
             ev = autoscale_mod.journal_scale_event(
-                self.spool, decision, cfg, before, before + spawned)
+                self.jroot, decision, cfg, before, before + spawned,
+                queue=self.q)
             # cooldown armed from the JOURNAL timestamp, not the
             # signal-read instant: the auditor measures gaps between
             # journaled events, and spawns on a loaded host can take
@@ -518,8 +537,7 @@ class FleetController:
         # dead, every janitor already knows the death was elective —
         # the ordering no_elastic_strike rests on
         try:
-            protocol.record_elective_kill(self.spool, w.worker_id,
-                                          w.pid or 0)
+            self.q.record_elective_kill(w.worker_id, w.pid or 0)
         except OSError as e:
             # without the ledger a kill would charge the victim's
             # beams a crash strike — skip this scale-down entirely
@@ -527,10 +545,10 @@ class FleetController:
                            "keeping %s", e, w.worker_id)
             return
         ev = autoscale_mod.journal_scale_event(
-            self.spool, decision, cfg, before, before - 1,
+            self.jroot, decision, cfg, before, before - 1,
             victims=[{"worker": w.worker_id, "pid": w.pid,
                       "worker_class": w.worker_class,
-                      "mode": mode}])
+                      "mode": mode}], queue=self.q)
         try:
             if spot:
                 # spot semantics: SIGKILL is routine — no drain, the
@@ -555,23 +573,23 @@ class FleetController:
     def _worker_state(self, w: _Worker) -> str:
         if not w.alive:
             return "dead"
-        hb = protocol.read_heartbeat(self.spool, w.worker_id)
+        hb = self.q.read_heartbeat(w.worker_id)
         if hb is not None and hb.get("pid") == w.pid \
                 and protocol._hb_fresh(hb, self.heartbeat_max_age_s):
             return "fresh"
         return "stale"
 
     def _aggregate(self, status: str = "running") -> dict:
-        heartbeats = protocol.list_heartbeats(self.spool)
+        heartbeats = self.q.list_heartbeats()
         states = {w.worker_id: self._worker_state(w)
                   for w in self.workers}
         for st in ("fresh", "stale", "dead"):
             telemetry.fleet_workers().set(
                 sum(1 for s in states.values() if s == st), state=st)
-        # cached probe: _aggregate runs every poll second and the raw
-        # capacity read re-stats every heartbeat + the pending listing
-        cap = protocol.fleet_capacity_cached(self.spool,
-                                             self.heartbeat_max_age_s)
+        # the spool backend's capacity() is the short-TTL cached
+        # probe: _aggregate runs every poll second and the raw read
+        # re-stats every heartbeat + the pending listing
+        cap = self.q.capacity(self.heartbeat_max_age_s)
         # -1 = ZERO fresh workers (clients load-shed); 0 = fresh
         # workers but a full queue (backpressure) — a dashboard must
         # be able to tell a down fleet from a busy one
@@ -604,11 +622,11 @@ class FleetController:
             "external_workers": sorted(
                 wid for wid in heartbeats
                 if wid not in states and wid != ""),
-            "pending": protocol.pending_count(self.spool),
-            "claimed": protocol.claimed_count(self.spool),
-            "done": protocol.state_count(self.spool, "done"),
-            "quarantined": protocol.state_count(self.spool,
-                                                "quarantine"),
+            "queue": self.q.url,
+            "pending": self.q.pending_count(),
+            "claimed": self.q.claimed_count(),
+            "done": self.q.state_count("done"),
+            "quarantined": self.q.state_count("quarantine"),
             "capacity": cap,
         }
         try:
@@ -734,9 +752,8 @@ class FleetController:
                 if cmd == "rolling-restart":
                     self._rolling_restart()
                 self._aggregate()
-                outstanding = (
-                    protocol.pending_count(self.spool)
-                    or protocol.claimed_count(self.spool))
+                outstanding = (self.q.pending_count()
+                               or self.q.claimed_count())
                 if self.workers and all(
                         w.done or w.gave_up for w in self.workers):
                     if outstanding:
@@ -783,7 +800,7 @@ class FleetController:
             # incarnation end: worker-seconds accounting (the
             # autoscale bench's cost-per-beam) pairs every
             # worker_spawn with a worker_exit
-            journal.record(self.spool, "worker_exit",
+            journal.record(self.jroot, "worker_exit",
                            worker=w.worker_id, rc=w.last_rc,
                            pid=w.pid, incarnation=w.incarnation,
                            kind="drain")
@@ -797,10 +814,9 @@ class FleetController:
             "fleet stopped after %.0f s: pending=%d claimed=%d "
             "done=%d quarantined=%d",
             time.time() - self.started_at,
-            protocol.pending_count(self.spool),
-            protocol.state_count(self.spool, "claimed"),
-            protocol.state_count(self.spool, "done"),
-            protocol.state_count(self.spool, "quarantine"))
+            self.q.pending_count(), self.q.claimed_count(),
+            self.q.state_count("done"),
+            self.q.state_count("quarantine"))
         return rc
 
 
@@ -823,14 +839,22 @@ def status_rc(spool: str,
 
 
 def render_status(spool: str,
-                  max_age_s: float | None = None) -> str:
-    """Human-readable fleet status from the spool's shared state (no
+                  max_age_s: float | None = None,
+                  queue: queue_mod.TicketQueue | None = None) -> str:
+    """Human-readable fleet status from the fleet's shared state (no
     controller required: heartbeats + fleet.json are on disk) —
     including the autoscaler's decision trail, so the operator can
-    audit from the journal alone why the fleet is its current size."""
+    audit from the journal alone why the fleet is its current size.
+    ``queue`` routes ticket/liveness reads through a non-spool
+    backend (``--queue sqlite:...``); fleet.json stays on the
+    spool."""
     if max_age_s is None:
         max_age_s = protocol.heartbeat_max_age()
+    q = queue if queue is not None \
+        else queue_mod.FilesystemSpoolQueue(spool)
     lines = [f"fleet spool: {spool}"]
+    if q.backend != "spool":
+        lines.append(f"ticket queue: {q.url}")
     rec = protocol._read_json(os.path.join(spool, FLEET_JSON))
     if rec is not None:
         age = time.time() - rec.get("t", 0.0)
@@ -844,7 +868,7 @@ def render_status(spool: str,
     else:
         lines.append("controller: no fleet.json (not running, or "
                      "workers launched externally)")
-    heartbeats = protocol.list_heartbeats(spool)
+    heartbeats = q.list_heartbeats()
     if heartbeats:
         lines.append(f"{len(heartbeats)} worker heartbeat(s):")
         for wid, hb in heartbeats.items():
@@ -864,15 +888,15 @@ def render_status(spool: str,
                 f"skipped={beams.get('skipped', 0)}")
     else:
         lines.append("no worker heartbeats")
-    cap = protocol.fleet_capacity(spool, max_age_s)
+    cap = q.capacity(max_age_s)
     lines.append(
-        f"spool: pending={protocol.pending_count(spool)} "
-        f"claimed={protocol.state_count(spool, 'claimed')} "
-        f"done={protocol.state_count(spool, 'done')} "
-        f"quarantined={protocol.state_count(spool, 'quarantine')}"
+        f"queue: pending={q.pending_count()} "
+        f"claimed={q.claimed_count()} "
+        f"done={q.state_count('done')} "
+        f"quarantined={q.state_count('quarantine')}"
         f" capacity={'none (0 fresh workers)' if cap is None else cap}")
     asc = (rec or {}).get("autoscale")
-    trail = autoscale_mod.decision_trail(spool)
+    trail = autoscale_mod.decision_trail(q.journal_root or spool)
     if asc or trail:
         head = "autoscaler"
         if asc:

@@ -39,7 +39,10 @@ by the backend-parameterized tests in tests/test_frontdoor.py):
 
 ``get_ticket_queue`` resolves backend URLs: a bare path or
 ``spool:<dir>`` is the filesystem backend; ``memory:`` or
-``memory:<name>`` a (named, process-global) in-memory queue.
+``memory:<name>`` a (named, process-global) in-memory queue;
+``sqlite:<path>`` the durable WAL-mode SQLite backend
+(frontdoor/sqlite_queue.py) — same contract, no shared-filesystem
+assumption.
 """
 
 from __future__ import annotations
@@ -60,6 +63,13 @@ class TicketQueue:
 
     backend = "?"
 
+    @property
+    def url(self) -> str:
+        """The backend URL that resolves (via ``get_ticket_queue``)
+        back to this queue's state — what a supervisor hands its
+        worker subprocesses on the command line."""
+        raise NotImplementedError
+
     # ----------------------------------------------------- submission
     def submit(self, ticket_id: str, datafiles: list[str],
                outdir: str, job_id: int | None = None,
@@ -71,12 +81,13 @@ class TicketQueue:
         raise NotImplementedError
 
     # --------------------------------------------------------- claims
-    def claim_next(self, worker_id: str = "",
-                   policy=None) -> dict | None:
+    def claim_next(self, worker_id: str = "", policy=None,
+                   worker_class: str = "") -> dict | None:
         raise NotImplementedError
 
     def claim_batch(self, n: int, worker_id: str = "", policy=None,
-                    compat: str | None = None) -> list[dict]:
+                    compat: str | None = None,
+                    worker_class: str = "") -> list[dict]:
         """Claim up to ``n`` compatible tickets in ONE policy
         ordering pass (contract extension for batched admission):
         the first claim fixes the batch's declared ``compat`` key
@@ -147,6 +158,22 @@ class TicketQueue:
         (backpressure) — the PR-5 distinction federation rides on."""
         raise NotImplementedError
 
+    def oldest_pending_age_s(self, now: float | None = None) -> float:
+        """Age in seconds of the oldest pending ticket (0.0 when the
+        queue is empty) — the autoscaler's starvation signal.  The
+        generic walk reads every pending record; backends override
+        with something cheaper (mtime scan, SQL MIN)."""
+        now = time.time() if now is None else now
+        oldest = None
+        for tid in self.list_tickets("incoming"):
+            rec = self.read_ticket(tid)
+            if rec is None:
+                continue
+            t = rec.get("submitted_at")
+            if t is not None and (oldest is None or t < oldest):
+                oldest = float(t)
+        return max(0.0, now - oldest) if oldest is not None else 0.0
+
     # -------------------------------------------------------- journal
     def record_event(self, event: str, **fields) -> None:
         """Append a lifecycle event outside the built-in transitions
@@ -167,6 +194,71 @@ class TicketQueue:
         ``chaos verify --tail`` both ride this)."""
         raise NotImplementedError
 
+    # --------------------------------------- liveness detail / ledger
+    def read_heartbeat(self, worker_id: str = "") -> dict | None:
+        raise NotImplementedError
+
+    def list_heartbeats(self) -> dict[str, dict]:
+        """Every heartbeat the backend holds, fresh or not, keyed by
+        worker id (fleetview and the janitor read staleness, not just
+        freshness)."""
+        raise NotImplementedError
+
+    def write_heartbeat_record(self, worker_id: str,
+                               rec: dict) -> None:
+        """Overwrite a worker's heartbeat record VERBATIM — no pid or
+        timestamp restamp.  The controller's down-marking rides this:
+        a dead incarnation's heartbeat is re-written with
+        ``status="stopped"`` under the DEAD worker's pid, so capacity
+        stops counting it immediately."""
+        raise NotImplementedError
+
+    def remove_heartbeat(self, worker_id: str) -> None:
+        """Forget a retired worker's heartbeat entirely (elastic slot
+        ids are never reused — without this a long-lived fleet leaks
+        one liveness record per scale cycle)."""
+        raise NotImplementedError
+
+    def record_elective_kill(self, worker_id: str, pid: int,
+                             reason: str = "scale_down") -> None:
+        """The autoscaler's declaration of intent BEFORE a SIGKILL:
+        the janitor's next sweep finds this (worker, pid) pair in the
+        ledger and requeues its claims without a crash strike."""
+        raise NotImplementedError
+
+    def elective_kills(self) -> set[tuple[str, int]]:
+        raise NotImplementedError
+
+    # ------------------------------------------------ verifier surface
+    @property
+    def journal_root(self) -> str:
+        """The directory whose ``events/journal.jsonl`` this backend
+        appends to ('' for backends with no on-disk journal).  Run
+        artifacts (fleet.json, chaos manifests, worker logs) live
+        here too — the journal root IS the run root."""
+        return ""
+
+    def ticket_presence(self, ticket_id: str) -> dict[str, bool]:
+        """Raw per-state presence for the chaos verifier's
+        at-most-one-state invariant: which of the four states hold
+        this ticket RIGHT NOW, no precedence applied (``ticket_state``
+        resolves precedence; this deliberately does not)."""
+        raise NotImplementedError
+
+    def orphan_sweep(self) -> list[dict]:
+        """Transient artifacts that outlived their transaction —
+        ``{"ticket", "state", "name"}`` rows.  The spool backend
+        reports surviving ``*.tmp`` / claim / takeover side-files;
+        transactional backends have none by construction."""
+        raise NotImplementedError
+
+    def fsck(self) -> dict:
+        """Offline health check: ``{"backend", "target", "counts",
+        "findings"}`` where any finding means rc 1 for ``tpulsar
+        queue fsck`` — integrity check + WAL checkpoint for sqlite,
+        orphan side-file sweep for the spool."""
+        raise NotImplementedError
+
 
 # --------------------------------------------------------------------
 # filesystem backend (the reference implementation)
@@ -183,6 +275,10 @@ class FilesystemSpoolQueue(TicketQueue):
     def __init__(self, spool: str):
         self.spool = protocol.ensure_spool(spool)
 
+    @property
+    def url(self):
+        return f"spool:{self.spool}"
+
     def __repr__(self):
         return f"FilesystemSpoolQueue({self.spool!r})"
 
@@ -194,13 +290,16 @@ class FilesystemSpoolQueue(TicketQueue):
     def cancel(self, ticket_id):
         return protocol.cancel_ticket(self.spool, ticket_id)
 
-    def claim_next(self, worker_id="", policy=None):
+    def claim_next(self, worker_id="", policy=None, worker_class=""):
         return protocol.claim_next_ticket(self.spool, worker_id,
-                                          policy=policy)
+                                          policy=policy,
+                                          worker_class=worker_class)
 
-    def claim_batch(self, n, worker_id="", policy=None, compat=None):
+    def claim_batch(self, n, worker_id="", policy=None, compat=None,
+                    worker_class=""):
         return protocol.claim_batch(self.spool, n, worker_id,
-                                    policy=policy, compat=compat)
+                                    policy=policy, compat=compat,
+                                    worker_class=worker_class)
 
     def requeue_stale_claims(
             self, max_attempts=protocol.DEFAULT_MAX_ATTEMPTS):
@@ -260,6 +359,27 @@ class FilesystemSpoolQueue(TicketQueue):
         return protocol.fleet_capacity_cached(self.spool, max_age_s,
                                               default_depth)
 
+    def oldest_pending_age_s(self, now=None):
+        # mtime scan, not record parse: this runs inside the
+        # autoscaler's per-tick signal read
+        now = time.time() if now is None else now
+        oldest = now
+        try:
+            with os.scandir(os.path.join(self.spool,
+                                         "incoming")) as it:
+                for entry in it:
+                    if not entry.name.endswith(".json"):
+                        continue
+                    try:
+                        m = entry.stat().st_mtime
+                    except OSError:
+                        continue
+                    if m < oldest:
+                        oldest = m
+        except OSError:
+            return 0.0
+        return max(0.0, now - oldest)
+
     def record_event(self, event, **fields):
         journal.record(self.spool, event, **fields)
 
@@ -275,6 +395,67 @@ class FilesystemSpoolQueue(TicketQueue):
         return journal.read_events(self.spool, ticket=ticket,
                                    after_offset=after_offset,
                                    bad_lines=[])
+
+    # --------------------------------------- liveness detail / ledger
+
+    def read_heartbeat(self, worker_id=""):
+        return protocol.read_heartbeat(self.spool, worker_id)
+
+    def list_heartbeats(self):
+        return protocol.list_heartbeats(self.spool)
+
+    def write_heartbeat_record(self, worker_id, rec):
+        protocol._atomic_write_json(
+            protocol.heartbeat_path(self.spool, worker_id), rec)
+
+    def remove_heartbeat(self, worker_id):
+        try:
+            os.unlink(protocol.heartbeat_path(self.spool, worker_id))
+        except OSError:
+            pass
+
+    def record_elective_kill(self, worker_id, pid,
+                             reason="scale_down"):
+        protocol.record_elective_kill(self.spool, worker_id, pid,
+                                      reason=reason)
+
+    def elective_kills(self):
+        return protocol.elective_kills(self.spool)
+
+    # ------------------------------------------------ verifier surface
+
+    @property
+    def journal_root(self):
+        return self.spool
+
+    def ticket_presence(self, ticket_id):
+        return {state: os.path.exists(
+                    protocol.ticket_path(self.spool, ticket_id,
+                                         state))
+                for state in _STATES}
+
+    def orphan_sweep(self):
+        out: list[dict] = []
+        for state in _STATES:
+            try:
+                names = sorted(os.listdir(
+                    os.path.join(self.spool, state)))
+            except OSError:
+                continue
+            for name in names:
+                if (name.endswith(".tmp") or ".json.claiming." in name
+                        or ".json.takeover." in name):
+                    out.append({"ticket": name.split(".json")[0],
+                                "state": state, "name": name})
+        return out
+
+    def fsck(self):
+        findings = [{"what": "orphan_sidefile",
+                     "detail": f"{o['state']}/{o['name']}"}
+                    for o in self.orphan_sweep()]
+        counts = {s: self.state_count(s) for s in _STATES}
+        return {"backend": self.backend, "target": self.spool,
+                "counts": counts, "findings": findings}
 
 
 # --------------------------------------------------------------------
@@ -298,6 +479,11 @@ class MemoryTicketQueue(TicketQueue):
             s: {} for s in _STATES}
         self._heartbeats: dict[str, dict] = {}
         self._events: list[dict] = []
+        self._elective: set[tuple[str, int]] = set()
+
+    @property
+    def url(self):
+        return f"memory:{self.name}"
 
     def __repr__(self):
         return f"MemoryTicketQueue({self.name!r})"
@@ -332,7 +518,8 @@ class MemoryTicketQueue(TicketQueue):
                                         r["ticket"]))]
         return policy.claim_order(pending, self.inflight_by_tenant())
 
-    def _claim_locked(self, tid: str, worker_id: str) -> dict | None:
+    def _claim_locked(self, tid: str, worker_id: str,
+                      worker_class: str = "") -> dict | None:
         rec = self._states["incoming"].pop(tid, None)
         if rec is None:
             return None
@@ -346,6 +533,8 @@ class MemoryTicketQueue(TicketQueue):
         rec["claimed_by_thread"] = threading.get_ident()
         if worker_id:
             rec["claimed_by_worker"] = worker_id
+        if worker_class:
+            rec["claimed_by_class"] = worker_class
         self._states["claimed"][tid] = rec
         self.record_event(
             "claimed", ticket=tid, worker=worker_id,
@@ -356,18 +545,20 @@ class MemoryTicketQueue(TicketQueue):
                 rec["claimed_at"]
                 - rec.get("submitted_at", rec["claimed_at"]),
                 3),
-            tenant=rec.get("tenant", ""))
+            tenant=rec.get("tenant", ""),
+            worker_class=worker_class)
         return rec
 
-    def claim_next(self, worker_id="", policy=None):
+    def claim_next(self, worker_id="", policy=None, worker_class=""):
         with self._lock:
             for tid in self._order_locked(policy):
-                rec = self._claim_locked(tid, worker_id)
+                rec = self._claim_locked(tid, worker_id, worker_class)
                 if rec is not None:
                     return rec
             return None
 
-    def claim_batch(self, n, worker_id="", policy=None, compat=None):
+    def claim_batch(self, n, worker_id="", policy=None, compat=None,
+                    worker_class=""):
         # same contract as protocol.claim_batch: one ordering pass,
         # the first claim (or the pinned ``compat``) fixes the key,
         # mismatching tickets stay pending in place
@@ -387,7 +578,7 @@ class MemoryTicketQueue(TicketQueue):
                     if str(rec0.get("compat", "") or "") \
                             != str(want or ""):
                         continue
-                rec = self._claim_locked(tid, worker_id)
+                rec = self._claim_locked(tid, worker_id, worker_class)
                 if rec is not None:
                     claimed.append(rec)
         return claimed
@@ -404,6 +595,9 @@ class MemoryTicketQueue(TicketQueue):
                 verdict = verdict_fn(rec)
                 if verdict is None:
                     continue
+                reason = neutral_reason
+                if isinstance(verdict, tuple):
+                    verdict, reason = verdict
                 del self._states["claimed"][tid]
                 owner_pid = rec.get("claimed_by")
                 owner_worker = rec.get("claimed_by_worker", "")
@@ -428,7 +622,7 @@ class MemoryTicketQueue(TicketQueue):
                         worker=owner_worker,
                         attempt=int(rec.get("attempts", 0)),
                         trace_id=rec.get("trace_id", ""),
-                        reason=neutral_reason)
+                        reason=reason)
                 requeued.append(tid)
         return requeued
 
@@ -465,6 +659,14 @@ class MemoryTicketQueue(TicketQueue):
                     else "neutral"
             if owner is not None and protocol._pid_alive(owner):
                 return None
+            try:
+                if (str(rec.get("claimed_by_worker", "")),
+                        int(owner)) in self._elective:
+                    # an autoscaler-declared kill: requeue without a
+                    # crash strike, same ladder as the spool ledger
+                    return ("neutral", "scale_down")
+            except (TypeError, ValueError):
+                pass
             return "strike"
         return self._requeue(verdict, max_attempts,
                              neutral_reason="boot_recovery")
@@ -612,6 +814,51 @@ class MemoryTicketQueue(TicketQueue):
         evs.sort(key=lambda r: r.get("t", 0.0))
         return evs, next_offset
 
+    # --------------------------------------- liveness detail / ledger
+
+    def read_heartbeat(self, worker_id=""):
+        with self._lock:
+            rec = self._heartbeats.get(worker_id)
+            return dict(rec) if rec is not None else None
+
+    def list_heartbeats(self):
+        with self._lock:
+            return {wid: dict(rec)
+                    for wid, rec in self._heartbeats.items()}
+
+    def write_heartbeat_record(self, worker_id, rec):
+        with self._lock:
+            self._heartbeats[worker_id] = dict(rec)
+
+    def remove_heartbeat(self, worker_id):
+        with self._lock:
+            self._heartbeats.pop(worker_id, None)
+
+    def record_elective_kill(self, worker_id, pid,
+                             reason="scale_down"):
+        with self._lock:
+            self._elective.add((str(worker_id), int(pid)))
+
+    def elective_kills(self):
+        with self._lock:
+            return set(self._elective)
+
+    # ------------------------------------------------ verifier surface
+
+    def ticket_presence(self, ticket_id):
+        with self._lock:
+            return {state: ticket_id in self._states[state]
+                    for state in _STATES}
+
+    def orphan_sweep(self):
+        return []      # dict transitions leave no transient files
+
+    def fsck(self):
+        counts = {s: self.state_count(s) for s in _STATES}
+        return {"backend": self.backend,
+                "target": f"memory:{self.name}",
+                "counts": counts, "findings": []}
+
 
 # --------------------------------------------------------------------
 # resolution
@@ -633,12 +880,22 @@ def memory_queue(name: str = "") -> MemoryTicketQueue:
 
 def get_ticket_queue(url: str) -> TicketQueue:
     """Backend resolution: ``memory:`` / ``memory:<name>`` -> the
-    named in-memory queue; ``spool:<dir>`` or a bare directory path
-    -> the filesystem spool backend."""
+    named in-memory queue; ``sqlite:<path>`` -> the durable SQLite
+    backend; ``spool:<dir>`` or a bare directory path -> the
+    filesystem spool backend."""
     if url.startswith("memory:"):
         return memory_queue(url[len("memory:"):].lstrip("/"))
     if url == "memory":
         return memory_queue()
+    if url.startswith("sqlite:"):
+        # imported lazily: sqlite_queue imports this module for the
+        # TicketQueue base class
+        from tpulsar.frontdoor import sqlite_queue
+        path = url[len("sqlite:"):]
+        if not path:
+            raise ValueError("sqlite ticket-queue url needs a "
+                             "database path (sqlite:<path>)")
+        return sqlite_queue.SQLiteTicketQueue(path)
     if url.startswith("spool:"):
         url = url[len("spool:"):]
     if not url:

@@ -150,6 +150,10 @@ EVENTS = {
     "chaos_action": "chaos conductor executed a timeline action",
     "chaos_run_start": "chaos conductor opened a storm",
     "chaos_run_end": "chaos conductor quiesced the storm",
+    "queue_corrupt": "a durable queue backend refused to open: "
+                     "integrity check failed or the database is "
+                     "unreadable (path, error) — containment "
+                     "evidence, never silent data loss",
 }
 
 #: the one terminal event name: a ticket is finished exactly when its

@@ -105,6 +105,13 @@ FAULT_POINTS = (
     #                         entry is discarded + journaled
     #                         (checkpoint_invalid) and recomputed,
     #                         never resumed from garbage
+    "queue.db",             # frontdoor/sqlite_queue.py: fired before
+    #                         EVERY SQLite statement (BEGIN/claim CAS/
+    #                         result insert/requeue/heartbeat), shaped
+    #                         as sqlite3.OperationalError unless an
+    #                         errno= option makes it a disk-shaped
+    #                         OSError; delay mode models a congested
+    #                         database volume without failing anything
 )
 
 MODES = ("unimplemented", "hang", "delay", "poison")

@@ -45,6 +45,10 @@ import signal
 import threading
 import time
 
+# Module import (not name import): frontdoor.queue itself imports
+# serve.protocol, so pulling a name out of it here would trip the
+# circular-import guard when queue.py is the first module loaded.
+from tpulsar.frontdoor import queue as frontdoor_queue
 from tpulsar.obs import journal, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.resilience import faults, policy
@@ -55,6 +59,7 @@ from tpulsar.serve.stagein import (BatchStageInPipeline, PreparedBatch,
 
 class SearchServer:
     def __init__(self, spool: str | None = None, cfg=None, *,
+                 queue_url: str = "",
                  worker_id: str = "",
                  worker_class: str = "",
                  max_queue_depth: int = 8,
@@ -81,6 +86,17 @@ class SearchServer:
         self.claim_policy = claim_policy
         self.cfg = cfg
         self.spool = spool or protocol.default_spool_dir(cfg)
+        #: the ticket backend (``serve --queue sqlite:<path>``):
+        #: claims, results, heartbeats, and requeues all route
+        #: through it; the spool stays the worker's scratch/log/
+        #: metrics-snapshot root.  Constructing the sqlite backend
+        #: integrity-checks the database — a corrupt queue refuses
+        #: HERE, loudly, before any claim is taken.
+        self.queue = frontdoor_queue.get_ticket_queue(
+            queue_url or f"spool:{self.spool}")
+        #: journal root (== spool for the spool backend and a
+        #: queue.db inside the spool directory)
+        self.jroot = self.queue.journal_root or self.spool
         self.worker_id = worker_id
         #: "spot" workers advertise that an autoscaler SIGKILL is
         #: routine for them: the class rides the heartbeat, every
@@ -112,8 +128,8 @@ class SearchServer:
         self.batch_fn = batch_fn or self._search_batch
         if self.batch_size > 1:
             self.pipeline = BatchStageInPipeline(
-                claim_batch=lambda n, compat: protocol.claim_batch(
-                    self.spool, n, self.worker_id,
+                claim_batch=lambda n, compat: self.queue.claim_batch(
+                    n, self.worker_id,
                     policy=self.claim_policy,
                     worker_class=self.worker_class, compat=compat),
                 workdir_base=cfg.processing.base_working_directory,
@@ -123,8 +139,8 @@ class SearchServer:
                 journal=self._journal)
         else:
             self.pipeline = StageInPipeline(
-                claim=lambda: protocol.claim_next_ticket(
-                    self.spool, self.worker_id,
+                claim=lambda: self.queue.claim_next(
+                    self.worker_id,
                     policy=self.claim_policy,
                     worker_class=self.worker_class),
                 workdir_base=cfg.processing.base_working_directory,
@@ -161,7 +177,7 @@ class SearchServer:
         too): stamps worker id, attempt, and the ticket's trace id
         onto every event."""
         journal.record(
-            self.spool, event, ticket=ticket.get("ticket", "?"),
+            self.jroot, event, ticket=ticket.get("ticket", "?"),
             worker=self.worker_id,
             attempt=int(ticket.get("attempts", 0)),
             trace_id=ticket.get("trace_id", ""), **extra)
@@ -170,8 +186,8 @@ class SearchServer:
 
     def boot(self) -> None:
         protocol.ensure_spool(self.spool)
-        requeued = protocol.requeue_stale_claims(
-            self.spool, self.ticket_max_attempts)
+        requeued = self.queue.requeue_stale_claims(
+            self.ticket_max_attempts)
         if requeued:
             self.log.warning(
                 "requeued %d ticket(s) a dead worker left claimed: %s",
@@ -205,10 +221,10 @@ class SearchServer:
         now = time.time()
         if not force and now - self._hb_last < self.heartbeat_interval_s:
             return
-        depth = protocol.pending_count(self.spool)
+        depth = self.queue.pending_count()
         telemetry.serve_queue_depth().set(depth)
-        protocol.write_heartbeat(
-            self.spool, worker_id=self.worker_id, status=status,
+        self.queue.heartbeat(
+            worker_id=self.worker_id, status=status,
             queue_depth=depth, max_queue_depth=self.max_queue_depth,
             beams=dict(self.beams), started_at=self.started_at,
             **({"worker_class": self.worker_class}
@@ -268,8 +284,8 @@ class SearchServer:
                     else:
                         self._process(prepared)
                     continue
-                if once and protocol.pending_count(self.spool) == 0 \
-                        and protocol.claimed_count(self.spool) == 0:
+                if once and self.queue.pending_count() == 0 \
+                        and self.queue.claimed_count() == 0:
                     break
         finally:
             self._shutdown()
@@ -287,7 +303,7 @@ class SearchServer:
         # returned beams are not suspects)
         leftovers = self.pipeline.stop()
         try:
-            requeued = protocol.requeue_own_claims(self.spool)
+            requeued = self.queue.requeue_own_claims()
         except OSError as e:
             # a failing spool during drain: the claims stay put and
             # the janitor recovers them once this pid is gone — the
@@ -474,7 +490,7 @@ class SearchServer:
         # the batch-dispatch evidence: ONE fleet-level journal event
         # naming the members (their own chains carry claim/result),
         # plus per-beam search_start so every chain stays well-formed
-        journal.record(self.spool, "batch_dispatch",
+        journal.record(self.jroot, "batch_dispatch",
                        worker=self.worker_id, beams=len(ok),
                        tickets=[p.ticket_id for p in ok])
         telemetry.beam_batch_occupancy().set(len(ok))
@@ -563,8 +579,8 @@ class SearchServer:
         # but never lost, never double-recorded.
         for io_try in range(3):
             try:
-                protocol.write_result(
-                    self.spool, tid, status,
+                self.queue.write_result(
+                    tid, status,
                     rc=0 if status in ("done", "skipped") else 1,
                     error=error, beam_seconds=dt, warm=warm,
                     outdir=outdir, worker=self.worker_id,
