@@ -2249,6 +2249,87 @@ def run_queue() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_doctor() -> None:
+    """``bench.py --doctor``: the health doctor's cost and reflexes —
+    (a) steady-state tick overhead over a populated journal (the tax
+    every controller loop pays for free alerting; it must stay in
+    the low milliseconds so hosting the doctor is never a reason to
+    turn it off), and (b) detection latency: the wall time from the
+    second crash-flavoured ``worker_exit`` landing in the journal to
+    the first tick that reports ``worker_flap`` firing (incremental
+    journal read + the full rule pack, excluding the configurable
+    poll interval — the part the code owns, not the knob).  Emits
+    one bench/v2 record with an additive ``doctor`` key; headline
+    ``value`` is the steady-state tick overhead.  Knobs:
+    TPULSAR_DOCTORBENCH_EVENTS (default 2000) / TICKS (default 50) /
+    KEEP=1 keeps the scratch spool."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from tpulsar.obs import health, journal
+
+    nevents = int(os.environ.get("TPULSAR_DOCTORBENCH_EVENTS", "2000"))
+    nticks = int(os.environ.get("TPULSAR_DOCTORBENCH_TICKS", "50"))
+    base = tempfile.mkdtemp(prefix="tpulsar_doctorbench_")
+    spool = os.path.join(base, "spool")
+    os.makedirs(spool, exist_ok=True)
+    # a believable steady-state journal: full submit->claim->result
+    # cycles so the queue-wait SLO rule has real samples to digest
+    _log(f"doctor bench: journaling {nevents} events ...")
+    cycle = ("submitted", "claimed", "search_start", "result")
+    for i in range(nevents // len(cycle)):
+        tid = f"db-{i:05d}"
+        journal.record(spool, "submitted", ticket=tid)
+        journal.record(spool, "claimed", ticket=tid,
+                       worker=f"w{i % 4}", queue_wait_s=0.05)
+        journal.record(spool, "search_start", ticket=tid,
+                       worker=f"w{i % 4}")
+        journal.record(spool, "result", ticket=tid, status="done",
+                       rc=0)
+    det = health.HealthDetector(spool, persist=False,
+                                journal_events=False, notify=False)
+    det.tick()                      # absorb the cold full-journal read
+    ticks = []
+    for _ in range(nticks):
+        t0 = time.time()
+        det.tick()
+        ticks.append(time.time() - t0)
+    tick_overhead = statistics.mean(ticks)
+    # reflex: crash storm -> first firing tick (poll interval is a
+    # knob, so the measured latency is read+evaluate+transition only)
+    t_inject = time.time()
+    for _ in range(2):
+        journal.record(spool, "worker_exit", worker="w9", rc=70,
+                       kind="crash")
+    latency = -1.0
+    for _ in range(100):
+        active = det.tick()
+        if any(a["rule"] == "worker_flap" for a in active):
+            latency = time.time() - t_inject
+            break
+    _log(f"doctor bench: tick {tick_overhead * 1e3:.2f} ms over "
+         f"{nevents} events, detection latency "
+         f"{latency * 1e3:.2f} ms")
+    _emit({
+        "metric": "doctor_tick_overhead",
+        "value": round(tick_overhead, 6),
+        "unit": "s",
+        "doctor": {
+            "events": nevents,
+            "ticks": nticks,
+            "rules": len(det.rules),
+            "tick_overhead_s": round(tick_overhead, 6),
+            "tick_p95_s": round(
+                sorted(ticks)[int(0.95 * (len(ticks) - 1))], 6),
+            "detection_latency_s": round(latency, 6),
+            "fired": sorted(a["rule"] for a in active),
+        },
+    })
+    if os.environ.get("TPULSAR_DOCTORBENCH_KEEP", "") != "1":
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _usable_cpus() -> list:
     """The CPU ids this process may actually run on, for taskset
     pinning (a cgroup cpuset need not start at 0 or be contiguous)."""
@@ -2583,6 +2664,9 @@ def main() -> None:
         return
     if "--queue" in sys.argv:
         run_queue()
+        return
+    if "--doctor" in sys.argv:
+        run_doctor()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

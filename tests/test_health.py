@@ -1,0 +1,487 @@
+"""Fleet health doctor tests: the alert rule pack (every built-in
+rule: a synthetic stream firing at its exact threshold + a clean
+stream that must not fire), the detector loop's transitions, the
+flight recorder's dump/render round-trip (incl. torn dumps), the
+alert-fidelity invariants, and the obs-console/queue-op-histogram
+regression over BOTH queue backends."""
+
+import json
+import os
+import time
+
+import pytest
+
+from tpulsar.obs import alerts, health, journal, metrics, telemetry
+from tpulsar.resilience import faults
+
+
+def _frame(now, events=(), snapshot=None, samples=None,
+           queue_wait=None, fsck=None):
+    return {"now": now, "events": list(events),
+            "snapshot": snapshot or {}, "samples": samples or {},
+            "queue_wait": queue_wait or [], "fsck": fsck}
+
+
+def _rule(rid):
+    return next(r for r in alerts.builtin_rules() if r.id == rid)
+
+
+def _cap_snapshot(value):
+    name = telemetry.fleet_capacity().name
+    return {name: {"type": "gauge", "help": "", "labelnames": [],
+                   "series": {"": value}}}
+
+
+# --------------------------------------------------------------------
+# the mutation suite: each built-in rule at threshold and clean
+# --------------------------------------------------------------------
+
+NOW = 1_000_000.0
+
+
+def test_rule_queue_wait_slo_burn_threshold_and_clean():
+    rule = _rule("queue_wait_slo_burn")
+    # 1 bad of 5 => bad fraction 0.2, burn 0.2/0.1 = 2.0 == threshold
+    waits = [(NOW - 10 - i, 40.0 if i == 0 else 1.0)
+             for i in range(5)]
+    v = alerts.evaluate_rule(rule, _frame(NOW, queue_wait=waits))
+    assert v["breached"] and v["value"] == pytest.approx(2.0)
+    # clean: every wait inside the 30 s objective
+    clean = [(NOW - 10 - i, 1.0) for i in range(5)]
+    v = alerts.evaluate_rule(rule, _frame(NOW, queue_wait=clean))
+    assert v is not None and not v["breached"]
+    # 1 bad of 10 => burn 1.0 < 2.0: budget burning, but slowly
+    slow = [(NOW - 10 - i, 40.0 if i == 0 else 1.0)
+            for i in range(10)]
+    v = alerts.evaluate_rule(rule, _frame(NOW, queue_wait=slow))
+    assert not v["breached"]
+    # short window clean, long window burning => NOT breached (the
+    # multi-window rule: a recovered incident stops paging)
+    recovered = ([(NOW - 500 - i, 40.0) for i in range(5)]
+                 + [(NOW - 10 - i, 1.0) for i in range(5)])
+    v = alerts.evaluate_rule(rule, _frame(NOW, queue_wait=recovered))
+    assert not v["breached"]
+    # no samples at all: no verdict, not a clean bill
+    assert alerts.evaluate_rule(rule, _frame(NOW)) is None
+
+
+@pytest.mark.parametrize("rid,event,n_fire", [
+    ("takeover_rate", "takeover", 1),
+    ("quarantine", "quarantined", 1),
+    ("queue_corrupt", "queue_corrupt", 1),
+    ("checkpoint_sick", "checkpoint_invalid", 1),
+])
+def test_event_count_rules_threshold_and_clean(rid, event, n_fire):
+    rule = _rule(rid)
+    evs = [{"event": event, "t": NOW - 1.0}] * n_fire
+    v = alerts.evaluate_rule(rule, _frame(NOW, events=evs))
+    assert v["breached"] and v["value"] == float(n_fire)
+    # clean stream: other events, or the same event outside the window
+    clean = [{"event": event, "t": NOW - rule.window_s - 1.0},
+             {"event": "claimed", "t": NOW - 1.0}]
+    v = alerts.evaluate_rule(rule, _frame(NOW, events=clean))
+    assert not v["breached"]
+
+
+def test_rule_worker_flap_threshold_and_exclusions():
+    rule = _rule("worker_flap")
+    crash = {"event": "worker_exit", "t": NOW - 1.0, "rc": 70,
+             "kind": "crash"}
+    v = alerts.evaluate_rule(rule, _frame(NOW, events=[crash] * 2))
+    assert v["breached"] and v["value"] == 2.0
+    assert not alerts.evaluate_rule(
+        rule, _frame(NOW, events=[crash]))["breached"]
+    # drains, scale-downs, and clean rc-0 exits must NOT count
+    benign = [{"event": "worker_exit", "t": NOW - 1.0, "kind": "drain"},
+              {"event": "worker_exit", "t": NOW - 1.0,
+               "kind": "scale_down"},
+              {"event": "worker_exit", "t": NOW - 1.0, "rc": 0}]
+    v = alerts.evaluate_rule(rule, _frame(NOW, events=benign * 2))
+    assert not v["breached"]
+
+
+@pytest.mark.parametrize("rid", ["compile_miss_on_warm",
+                                 "accel_breaker_pinned"])
+def test_metric_delta_rules_threshold_and_clean(rid):
+    rule = _rule(rid)
+    fire = {rid: [(NOW - 100.0, 5.0), (NOW, 6.0)]}     # delta == 1
+    v = alerts.evaluate_rule(rule, _frame(NOW, samples=fire))
+    assert v["breached"] and v["value"] == 1.0
+    flat = {rid: [(NOW - 100.0, 5.0), (NOW, 5.0)]}
+    v = alerts.evaluate_rule(rule, _frame(NOW, samples=flat))
+    assert not v["breached"]
+    # no samples yet: the signal is absent, not zero
+    assert alerts.evaluate_rule(rule, _frame(NOW)) is None
+
+
+def test_rule_fsck_findings_threshold_and_clean():
+    rule = _rule("fsck_findings")
+    assert alerts.evaluate_rule(rule, _frame(NOW, fsck=1))["breached"]
+    assert not alerts.evaluate_rule(rule,
+                                    _frame(NOW, fsck=0))["breached"]
+    assert alerts.evaluate_rule(rule, _frame(NOW, fsck=None)) is None
+
+
+def test_rule_fleet_saturated_threshold_and_clean():
+    rule = _rule("fleet_saturated")
+    v = alerts.evaluate_rule(rule,
+                             _frame(NOW, snapshot=_cap_snapshot(0)))
+    assert v["breached"] and v["value"] == 0.0
+    v = alerts.evaluate_rule(rule,
+                             _frame(NOW, snapshot=_cap_snapshot(2)))
+    assert not v["breached"]
+    assert alerts.evaluate_rule(rule, _frame(NOW)) is None
+
+
+# --------------------------------------------------------------------
+# rule schema: loud validation, file loading
+# --------------------------------------------------------------------
+
+def test_rule_from_dict_rejects_unknown_and_bad_fields():
+    with pytest.raises(ValueError, match="unknown key"):
+        alerts.rule_from_dict({"id": "x", "severity": "warn",
+                               "kind": "event_count",
+                               "events": ["takeover"],
+                               "treshold": 2})
+    with pytest.raises(ValueError, match="unknown journal event"):
+        alerts.rule_from_dict({"id": "x", "severity": "warn",
+                               "kind": "event_count",
+                               "events": ["no_such_event"]})
+    with pytest.raises(ValueError, match="severity"):
+        alerts.rule_from_dict({"id": "x", "severity": "critical",
+                               "kind": "fsck"})
+    with pytest.raises(ValueError, match="short_window_s"):
+        alerts.rule_from_dict({"id": "x", "severity": "page",
+                               "kind": "burn_rate", "window_s": 60.0,
+                               "short_window_s": 60.0,
+                               "objective_s": 1.0})
+
+
+def test_load_rules_extends_and_replaces(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps([
+        {"id": "worker_flap", "severity": "warn",
+         "kind": "event_count", "events": ["worker_exit"],
+         "threshold": 5},
+        {"id": "my_rule", "severity": "warn", "kind": "fsck"}]))
+    rules = alerts.load_rules(str(p))
+    by_id = {r.id: r for r in rules}
+    assert by_id["worker_flap"].threshold == 5      # overridden
+    assert "my_rule" in by_id and "quarantine" in by_id  # extended
+    p.write_text(json.dumps({"replace": True, "rules": [
+        {"id": "only", "severity": "warn", "kind": "fsck"}]}))
+    assert [r.id for r in alerts.load_rules(str(p))] == ["only"]
+    p.write_text(json.dumps([{"id": "d", "severity": "warn",
+                              "kind": "fsck"}] * 2))
+    with pytest.raises(ValueError, match="duplicate"):
+        alerts.load_rules(str(p))
+
+
+# --------------------------------------------------------------------
+# detector loop: fire -> journal/persist/notify -> resolve
+# --------------------------------------------------------------------
+
+class _Recorder:
+    def __init__(self):
+        self.seen = []
+
+    def notify(self, alert):
+        self.seen.append(dict(alert))
+        return True
+
+
+def test_detector_fire_and_resolve_transitions(tmp_path):
+    spool = str(tmp_path)
+    journal.record(spool, "worker_exit", worker="w0", rc=70,
+                   kind="crash")
+    journal.record(spool, "worker_exit", worker="w0", rc=70,
+                   kind="crash")
+    rec = _Recorder()
+    det = health.HealthDetector(spool, notifier=rec)
+    active = det.tick()
+    assert [a["rule"] for a in active] == ["worker_flap"]
+    assert rec.seen[-1]["state"] == "firing"
+    persisted = health.read_active_alerts(spool)
+    assert persisted["alerts"][0]["rule"] == "worker_flap"
+    evs = journal.read_events(spool)
+    assert any(e["event"] == "alert_fired"
+               and e["rule"] == "worker_flap" for e in evs)
+    snap = det.metrics_snapshot()
+    name = telemetry.alerts_active(metrics.Registry()).name
+    assert sum(v for v in snap[name]["series"].values()) == 1
+    # the crash exits age out of the 300 s window => resolve
+    active = det.tick(now=time.time() + 400.0)
+    assert active == []
+    assert rec.seen[-1]["state"] == "resolved"
+    assert health.read_active_alerts(spool)["alerts"] == []
+    assert any(e["event"] == "alert_resolved"
+               for e in journal.read_events(spool))
+
+
+def test_detector_for_duration_debounce(tmp_path):
+    """fleet_saturated (for_s=60) must hold the breach for a minute
+    before firing — and evaluate_once waives the debounce."""
+    spool = str(tmp_path)
+    det = health.HealthDetector(
+        spool, notifier=_Recorder(),
+        extra_snapshots=lambda: (_cap_snapshot(0),))
+    t0 = time.time()
+    assert det.tick(now=t0) == []                  # breached, held
+    assert det.tick(now=t0 + 30.0) == []           # still held
+    active = det.tick(now=t0 + 61.0)               # for_s elapsed
+    assert [a["rule"] for a in active] == ["fleet_saturated"]
+    # one-shot verdict cannot wait a for_s out: debounce waived
+    once = health.evaluate_once(spool)
+    assert once == []     # no extra snapshots => capacity absent
+
+
+def test_detector_fsck_two_poll_intersection(tmp_path):
+    """fsck findings only count when they survive two consecutive
+    polls — a transient mid-rename side-file is not wreckage."""
+    spool = str(tmp_path)
+
+    class StubQueue:
+        def __init__(self):
+            self.findings = [{"what": "orphan", "detail": "a.tmp"}]
+            self.journal_root = spool
+
+        def read_events_after(self, off, ticket=None):
+            return [], off
+
+        def record_event(self, event, **fields):
+            journal.record(spool, event, **fields)
+
+        def fsck(self):
+            return {"findings": list(self.findings)}
+
+    q = StubQueue()
+    rules = tuple(r for r in alerts.builtin_rules()
+                  if r.id == "fsck_findings")
+    det = health.HealthDetector(spool, queue=q, rules=rules,
+                                notifier=_Recorder())
+    assert det.tick() == []                  # first poll: baseline
+    det._fsck_at = 0.0                       # force a re-poll
+    active = det.tick()                      # same finding survives
+    assert [a["rule"] for a in active] == ["fsck_findings"]
+    # a transient that changes identity every poll never fires
+    det2 = health.HealthDetector(spool, queue=q, rules=rules,
+                                 notifier=_Recorder(), persist=False)
+    det2.tick()
+    q.findings = [{"what": "orphan", "detail": "b.tmp"}]
+    det2._fsck_at = 0.0
+    assert det2.tick() == []
+
+
+# --------------------------------------------------------------------
+# flight recorder: round-trip, clean exit, torn dump
+# --------------------------------------------------------------------
+
+def test_blackbox_round_trip_and_render(tmp_path):
+    spool = str(tmp_path)
+    box = health.FlightRecorder("w7", spool=spool, ring=16)
+    for i in range(20):                      # overflow the ring
+        box.note("claim", ticket=f"t{i}")
+    path = box.dump(reason="unit test", rc=70)
+    assert os.path.exists(path)
+    assert box.dump() == ""                  # idempotent
+    rec = health.load_blackbox(spool, "w7")
+    assert not rec["torn"] and rec["bad_lines"] == 0
+    assert len(rec["entries"]) == 16         # ring bound held
+    assert rec["entries"][-1]["ticket"] == "t19"
+    assert rec["header"]["rc"] == 70
+    text = health.render_blackbox(spool, "w7")
+    assert "t19" in text and "rc=70" in text
+    assert "TORN" not in text
+
+
+def test_blackbox_disabled_and_clean_exit(tmp_path, monkeypatch):
+    spool = str(tmp_path)
+    monkeypatch.setenv("TPULSAR_BLACKBOX", "0")
+    box = health.FlightRecorder("w0", spool=spool)
+    box.note("claim", ticket="t")
+    assert box.dump(reason="x") == ""
+    monkeypatch.delenv("TPULSAR_BLACKBOX")
+    # spool-less recorder is inert too
+    assert not health.FlightRecorder("w0", spool="").enabled
+    # armed then disarmed: the atexit hook becomes a no-op
+    box = health.FlightRecorder("w1", spool=spool)
+    box.arm()
+    box.disarm()
+    box._atexit()
+    assert health.load_blackbox(spool, "w1") is None
+
+
+def test_blackbox_torn_dump_salvage(tmp_path):
+    spool = str(tmp_path)
+    box = health.FlightRecorder("w2", spool=spool, ring=32)
+    for i in range(10):
+        box.note("journal", event="claimed", ticket=f"t{i}")
+    faults.configure("blackbox.dump:unimplemented:errno=EIO")
+    try:
+        path = box.dump(reason="mid-dump death", rc=70)
+    finally:
+        faults.reset()
+    rec = health.load_blackbox(spool, "w2")
+    assert rec["path"] == path
+    assert rec["torn"]                       # no end marker landed
+    assert len(rec["entries"]) == 5          # first half salvaged
+    text = health.render_blackbox(spool, "w2")
+    assert "TORN DUMP" in text and "salvaged" in text
+    # a garbage line is counted, never fatal
+    with open(path, "a") as fh:
+        fh.write("{not json\n")
+    assert health.load_blackbox(spool, "w2")["bad_lines"] == 1
+
+
+# --------------------------------------------------------------------
+# alert-fidelity invariants (the chaos verifier sweeps)
+# --------------------------------------------------------------------
+
+def _fired(rule, t):
+    return {"event": "alert_fired", "rule": rule, "t": t,
+            "severity": "page"}
+
+
+def test_alert_sweep_false_alarm_detected(tmp_path):
+    from tpulsar.chaos import invariants
+    root = str(tmp_path)
+    out = invariants._alert_sweep([_fired("worker_flap", NOW)], root)
+    assert [v["invariant"] for v in out] == ["alert_no_false"]
+    # with a kill injected, worker_flap is explained
+    evs = [{"event": "chaos_action", "action": "kill_worker",
+            "t": NOW - 5.0}, _fired("worker_flap", NOW)]
+    assert invariants._alert_sweep(evs, root) == []
+    # ...but an unrelated alert is still a false alarm
+    evs.append(_fired("accel_breaker_pinned", NOW))
+    out = invariants._alert_sweep(evs, root)
+    assert [v["invariant"] for v in out] == ["alert_no_false"]
+
+
+def test_alert_sweep_missed_alarm_gated_on_doctor(tmp_path):
+    from tpulsar.chaos import invariants
+    root = str(tmp_path)
+    kills = [{"event": "chaos_action", "action": "kill_worker",
+              "t": NOW + i} for i in range(2)]
+    # no alerts.json: a doctor-less storm proves nothing => no verdict
+    assert invariants._alert_sweep(kills, root) == []
+    from tpulsar.serve import protocol
+    protocol._atomic_write_json(health.alerts_path(root),
+                                {"t": NOW, "alerts": []})
+    out = invariants._alert_sweep(kills, root)
+    assert [v["invariant"] for v in out] == ["alert_no_missed"]
+    assert "worker_flap" in out[0]["detail"]
+    # one kill is under the min_count=2 threshold: no judgment
+    assert invariants._alert_sweep(kills[:1], root) == []
+    # fired in time => clean
+    ok = kills + [_fired("worker_flap", NOW + 60.0)]
+    assert invariants._alert_sweep(ok, root) == []
+    # fired way past window_s + for_s + slack => missed
+    late = kills + [_fired("worker_flap", NOW + 1000.0)]
+    out = invariants._alert_sweep(late, root)
+    assert [v["invariant"] for v in out] == ["alert_no_missed"]
+
+
+def test_injected_classes_from_schedule_and_worker_args(tmp_path):
+    from tpulsar.chaos import invariants, scenario
+    from tpulsar.serve import protocol
+    root = str(tmp_path)
+    sched = scenario.schedule_path(root)
+    os.makedirs(os.path.dirname(sched), exist_ok=True)
+    protocol._atomic_write_json(
+        sched,
+        {"version": 1, "t0": 100.0, "seed": 1, "scenario": "x",
+         "entries": [
+             {"worker": "w1", "at": 5.0,
+              "faults": "fleet.worker:unimplemented:count=1"},
+             {"worker": "w1", "at": 7.0, "faults": "not a spec"}]})
+    evs = [{"event": "chaos_run_start", "t": 100.0,
+            "worker_args": ["--crash-after", "1"]},
+           {"event": "chaos_action", "action": "surge_submit",
+            "t": 103.0}]
+    classes = invariants._injected_classes(evs, root)
+    assert classes["fault:fleet.worker"] == [105.0]
+    assert classes["action:worker_crash_arg"] == [100.0]
+    assert classes["action:surge_submit"] == [103.0]
+    assert "fault:not a spec" not in str(classes)
+
+
+def test_alert_fidelity_invariants_registered():
+    from tpulsar.chaos import invariants
+    assert "alert_no_missed" in invariants.INVARIANTS
+    assert "alert_no_false" in invariants.INVARIANTS
+    # every EXPECTED rule must exist in the built-in pack, and every
+    # ALLOWED rule name must be a real rule — a typo here would
+    # silently weaken the fidelity contract
+    ids = {r.id for r in alerts.builtin_rules()}
+    for expect in alerts.EXPECTED_ALERTS.values():
+        assert set(expect["rules"]) <= ids
+    for rules in alerts.ALLOWED_ALERTS.values():
+        assert set(rules) <= ids
+
+
+# --------------------------------------------------------------------
+# both-backend regression: obs console + queue-op histogram
+# --------------------------------------------------------------------
+
+def _spool_url(tmp_path):
+    return str(tmp_path / "spool")
+
+
+def _sqlite_url(tmp_path):
+    return f"sqlite:{tmp_path / 'spool' / 'queue.db'}"
+
+
+@pytest.mark.parametrize("mk_url,backend", [
+    (_spool_url, "spool"), (_sqlite_url, "sqlite")])
+def test_obs_console_and_queue_ops_both_backends(tmp_path, mk_url,
+                                                 backend, capsys):
+    from tpulsar.cli.main import main as cli_main
+    from tpulsar.frontdoor.queue import get_ticket_queue
+
+    os.makedirs(tmp_path / "spool", exist_ok=True)
+    url = mk_url(tmp_path)
+    q = get_ticket_queue(url)
+    spool = q.journal_root
+    q.record_event("submitted", ticket="tk1")
+    q.submit("tk1", [str(tmp_path / "b.fits")],
+             str(tmp_path / "out"))
+    q.heartbeat(worker_id="w0", status="idle")
+    assert q.claim_next(worker_id="w0") is not None
+    q.record_event("claimed", ticket="tk1", worker="w0")
+    q.write_result("tk1", "done", rc=0)
+    q.record_event("result", ticket="tk1", status="done")
+
+    args = ["--queue", url] if backend == "sqlite" else []
+    assert cli_main(["obs", "timeline", "tk1", "--spool", spool]
+                    + args) == 0
+    assert "tk1" in capsys.readouterr().out
+    assert cli_main(["obs", "top", "--once", "--spool", spool]
+                    + args) == 0
+    assert "w0" in capsys.readouterr().out
+    assert cli_main(["obs", "tail", "--spool", spool] + args) == 0
+    assert "submitted" in capsys.readouterr().out
+
+    # the queue-op histogram observed the SAME op vocabulary on both
+    # backends (docs/operations.md metric table; read_result is
+    # deliberately untimed)
+    snap = metrics.REGISTRY.snapshot()
+    series = snap[telemetry.queue_op_seconds().name]["series"]
+    ops = {tuple(k.split("|")) for k in series}
+    for op in ("submit", "claim", "result", "heartbeat"):
+        assert (backend, op) in ops, (backend, op, sorted(ops))
+
+
+def test_trace_summarize_spool_mode_over_sqlite(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import trace_summarize as ts
+    from tpulsar.frontdoor.queue import get_ticket_queue
+
+    spool = tmp_path / "spool"
+    os.makedirs(spool)
+    url = f"sqlite:{spool / 'queue.db'}"
+    q = get_ticket_queue(url)
+    q.record_event("submitted", ticket="tk1")
+    assert ts.main([str(spool), "--queue", url]) == 0
+    assert "tk1" in capsys.readouterr().out

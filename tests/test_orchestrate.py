@@ -242,23 +242,36 @@ def test_config_validation(tmp_path):
     assert loaded.download.numdownloads == 7
 
 
-def test_mailer_sink():
+def test_daemon_notify_sink(monkeypatch, capsys, tmp_path):
+    """_notify routes daemon crash reports through the alert
+    notifier plane (obs/alerts.py) — the SMTP mailer is retired.  A
+    command: spec proves the alert JSON reaches the sink; a bad spec
+    falls back to log instead of killing the daemon."""
+    import json
+    import sys
+
+    from tpulsar.cli import main as cli
     from tpulsar.config import TpulsarConfig
-    from tpulsar.obs.mailer import ErrorMailer
+    from tpulsar.obs import alerts
 
-    cfg = TpulsarConfig()
-    cfg.email.enabled = True
-    cfg.email.recipient = "ops@example.org"
-    sent = []
-    m = ErrorMailer("it broke", subject="test failure", config=cfg.email,
-                    sink=lambda s, b: sent.append((s, b)))
-    assert m.send()
-    assert sent[0][0] == "[tpulsar] test failure"
-    assert "it broke" in sent[0][1]
+    out = tmp_path / "alert.json"
+    monkeypatch.setenv(
+        "TPULSAR_ALERT_NOTIFY",
+        f"command:{sys.executable} -c "
+        f"\"import sys, pathlib; pathlib.Path({str(out)!r})"
+        f".write_text(sys.stdin.read())\"")
+    send = cli._notify(TpulsarConfig())
+    send("test failure", "it broke")
+    rec = json.loads(out.read_text())
+    assert rec["subject"] == "test failure"
+    assert rec["body"] == "it broke"
+    assert rec["rule"] == "daemon_error"
 
-    cfg.email.enabled = False
-    assert not ErrorMailer("x", config=cfg.email,
-                           sink=lambda s, b: None).send()
+    monkeypatch.setenv("TPULSAR_ALERT_NOTIFY", "smtp:nope")
+    cli._notify(TpulsarConfig())("x", "y")   # falls back, no raise
+    assert "falling back to log" in capsys.readouterr().err
+    with pytest.raises(ValueError):
+        alerts.make_notifier("smtp:nope")
 
 
 def test_debugflags_cli():

@@ -85,6 +85,8 @@ DEFAULT_KEYS = (
     ("autoscale.cost_saving", "higher"),
     ("queue.spool.tickets_per_s", "higher"),
     ("queue.sqlite.tickets_per_s", "higher"),
+    ("doctor.tick_overhead_s", "lower"),
+    ("doctor.detection_latency_s", "lower"),
 )
 
 

@@ -142,15 +142,17 @@ def compile_rollup(trace: "str | list") -> dict[str, dict]:
     return roll
 
 
-def summarize_spool(spool: str, ticket: str | None = None) -> dict:
+def summarize_spool(spool: str, ticket: str | None = None,
+                    queue=None) -> dict:
     """Spool mode: the journal's per-ticket transition durations
     ALONGSIDE each beam's trace-span rollup (found via the outdir the
     ticket was submitted with) — one artifact answering both "what
     happened to this beam across the fleet" and "where did its
-    device time go"."""
+    device time go".  ``queue`` routes the journal read through a
+    TicketQueue backend (the ``sqlite:`` fleet path)."""
     from tpulsar.obs import journal as journal_lib
 
-    data = journal_lib.summarize(spool)
+    data = journal_lib.summarize(spool, queue=queue)
     if ticket is not None:
         data["tickets"] = {tid: rec
                            for tid, rec in data["tickets"].items()
@@ -225,10 +227,26 @@ def main(argv=None) -> int:
                          "on mismatch")
     ap.add_argument("--ticket", default=None,
                     help="spool mode: restrict to one ticket")
+    ap.add_argument("--queue", default="",
+                    help="spool mode: route the journal read through "
+                         "this ticket-queue backend URL "
+                         "(sqlite:<path> / spool:<dir>); the bare "
+                         "token 'sqlite' expands to "
+                         "sqlite:<path>/queue.db")
     args = ap.parse_args(argv)
-    if os.path.isdir(args.path) and \
-            os.path.isdir(os.path.join(args.path, "events")):
-        data = summarize_spool(args.path, ticket=args.ticket)
+    queue = None
+    if args.queue:
+        from tpulsar.frontdoor.queue import get_ticket_queue
+        url = args.queue
+        if url == "sqlite":
+            url = f"sqlite:{os.path.join(args.path, 'queue.db')}"
+        queue = get_ticket_queue(url)
+    if queue is not None or (
+            os.path.isdir(args.path) and
+            os.path.isdir(os.path.join(args.path, "events"))):
+        spool = (queue.journal_root or args.path) if queue is not None \
+            else args.path
+        data = summarize_spool(spool, ticket=args.ticket, queue=queue)
         if args.json:
             print(json.dumps(data, indent=1, sort_keys=True))
         else:

@@ -147,6 +147,13 @@ INVARIANTS = {
         "autoscaler-initiated preemptions never advance a ticket "
         "toward quarantine: no takeover (strike) ever names a "
         "journaled scale-down victim's pid as the dead owner",
+    "alert_no_missed":
+        "every injected fault class that crossed its mapped rule's "
+        "threshold raised that health alert within its detection "
+        "window (judged only when a health doctor ran)",
+    "alert_no_false":
+        "every fired health alert is explained by an injected fault "
+        "class; a clean run fires none",
 }
 
 #: events that RELEASE a claim (close an inflight interval) — drawn
@@ -520,6 +527,118 @@ def _elastic_sweep(events: list[dict]) -> list[dict]:
     return out
 
 
+#: detection-deadline slack for alert_no_missed: the detector ticks
+#: on its interval and the journal append races the storm's end; real
+#: misses are alerts that NEVER fire, not ones a few seconds past the
+#: arithmetic deadline
+_ALERT_SLACK_S = 30.0
+
+
+def _injected_classes(events: list[dict],
+                      root: str) -> dict[str, list[float]]:
+    """Every fault class this run injected, with the absolute
+    instants it struck: ``action:<name>`` per conductor-journaled
+    ``chaos_action``, ``fault:<point>`` per armed schedule-file
+    window (``set_faults`` never journals a chaos_action — the
+    windows open inside the workers), and
+    ``action:worker_crash_arg`` when the run's worker command line
+    carried a deterministic crash knob."""
+    from tpulsar.chaos import scenario as scenario_mod
+    from tpulsar.resilience import faults
+
+    classes: dict[str, list[float]] = {}
+
+    def note(cls: str, t) -> None:
+        classes.setdefault(cls, []).append(float(t or 0.0))
+
+    for ev in events:
+        name = ev.get("event")
+        if name == "chaos_action":
+            note(f"action:{ev.get('action', '')}", ev.get("t"))
+        elif name == "chaos_run_start":
+            if any("crash" in str(a)
+                   for a in ev.get("worker_args") or ()):
+                note("action:worker_crash_arg", ev.get("t"))
+    sched = protocol._read_json(scenario_mod.schedule_path(root))
+    t0 = float((sched or {}).get("t0", 0.0))
+    for entry in (sched or {}).get("entries") or ():
+        try:
+            specs = faults.parse_spec(str(entry.get("faults", "")))
+        except ValueError:
+            continue        # the workers refused it too — not armed
+        for point in specs:
+            note(f"fault:{point}",
+                 t0 + float(entry.get("at", 0.0)))
+    return {cls: sorted(ts) for cls, ts in classes.items()}
+
+
+def _alert_sweep(events: list[dict], root: str) -> list[dict]:
+    """The alert-fidelity contract of the health doctor, judged from
+    the same journal the alerts were appended to.
+
+    alert_no_false: every ``alert_fired`` rule must be in the union
+    of :func:`tpulsar.obs.alerts.allowed_rules` over the run's
+    injected fault classes — with NOTHING injected, any alert at all
+    is a false alarm.
+
+    alert_no_missed: for each injected class with an entry in
+    ``alerts.EXPECTED_ALERTS`` whose occurrence count reached that
+    entry's ``min_count``, at least one of its mapped rules must have
+    fired, no later than the threshold instant plus the widest mapped
+    rule's ``window_s + for_s`` plus slack.  Judged ONLY when a
+    health doctor actually ran (``alerts.json`` exists at the
+    journal root): a doctor-less storm has nobody to fire alerts and
+    proves nothing about detection."""
+    from tpulsar.obs import alerts as alerts_mod, health
+
+    out: list[dict] = []
+    fired = [e for e in events if e.get("event") == "alert_fired"]
+    classes = _injected_classes(events, root)
+
+    allowed: set[str] = set()
+    for cls in classes:
+        allowed.update(alerts_mod.allowed_rules(cls))
+    for ev in fired:
+        rule = str(ev.get("rule", ""))
+        if rule not in allowed:
+            out.append(_v(
+                "alert_no_false", "",
+                f"alert {rule!r} fired with no injected fault class "
+                f"allowing it (injected: "
+                f"{sorted(classes) or 'none'})"))
+
+    if not os.path.exists(health.alerts_path(root)):
+        return out
+    by_rule = {r.id: r for r in alerts_mod.builtin_rules()}
+    first_fired: dict[str, float] = {}
+    for ev in fired:
+        first_fired.setdefault(str(ev.get("rule", "")),
+                               float(ev.get("t", 0.0)))
+    for cls, expect in sorted(alerts_mod.EXPECTED_ALERTS.items()):
+        times = classes.get(cls) or []
+        need = int(expect.get("min_count", 1))
+        if len(times) < need:
+            continue
+        t_reached = times[need - 1]
+        rules = tuple(expect.get("rules", ()))
+        budget = max((by_rule[r].window_s + by_rule[r].for_s
+                      for r in rules if r in by_rule),
+                     default=0.0) + _ALERT_SLACK_S
+        hits = [first_fired[r] for r in rules if r in first_fired]
+        if not hits:
+            out.append(_v(
+                "alert_no_missed", "",
+                f"{cls} struck {len(times)}x (>= threshold {need}) "
+                f"but none of {list(rules)} ever fired"))
+        elif min(hits) > t_reached + budget:
+            out.append(_v(
+                "alert_no_missed", "",
+                f"{cls}: earliest mapped alert fired "
+                f"{min(hits) - t_reached:.1f} s after the threshold "
+                f"instant (detection budget {budget:.0f} s)"))
+    return out
+
+
 def _sidefile_sweep(q) -> list[dict]:
     # the backend's own accounting of transaction transients: the
     # spool reports surviving .tmp/.claiming/.takeover side-files,
@@ -583,7 +702,9 @@ def verify(spool: str, *, tenants: dict | None = None,
               "scale_ups": sum(1 for e in events
                                if e.get("event") == "scale_up"),
               "scale_downs": sum(1 for e in events
-                                 if e.get("event") == "scale_down")}
+                                 if e.get("event") == "scale_down"),
+              "alerts_fired": sum(1 for e in events
+                                  if e.get("event") == "alert_fired")}
     for tid, evs in sorted(per_ticket.items()):
         presence = q.ticket_presence(tid)
         violations.extend(_audit_chain(tid, evs, presence,
@@ -624,6 +745,7 @@ def verify(spool: str, *, tenants: dict | None = None,
 
     violations.extend(_quota_sweep(per_ticket, done_recs, tenants))
     violations.extend(_elastic_sweep(events))
+    violations.extend(_alert_sweep(events, root))
     if quiesced:
         violations.extend(_sidefile_sweep(q))
         violations.extend(_checkpoint_litter_sweep(per_ticket))
@@ -833,7 +955,8 @@ def render_verify(report: dict) -> str:
         f"quarantined, {c.get('resumes', 0)} checkpoint resume(s), "
         f"{c['journal_gaps']} journal gap(s), "
         f"{c.get('scale_ups', 0)} scale-up(s) / "
-        f"{c.get('scale_downs', 0)} scale-down(s)")
+        f"{c.get('scale_downs', 0)} scale-down(s), "
+        f"{c.get('alerts_fired', 0)} alert(s) fired")
     width = max(len(n) for n in INVARIANTS)
     for name in INVARIANTS:
         n = report["invariants"].get(name, 0)

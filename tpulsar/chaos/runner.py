@@ -312,7 +312,8 @@ class ChaosRunner:
                            scenario=sc.name, seed=sc.seed,
                            workers=sc.workers,
                            gateway=bool(sc.gateway),
-                           queue_url=self.queue_url)
+                           queue_url=self.queue_url,
+                           worker_args=list(self.worker_extra_args))
             # one merged, seeded dispatch plan: submissions at their
             # (jittered) cadence, conductor actions at their t
             rng = random.Random(sc.seed)

@@ -59,7 +59,7 @@ import signal
 import sys
 import time
 
-from tpulsar.obs import journal
+from tpulsar.obs import health, journal
 from tpulsar.resilience import faults
 from tpulsar.serve import protocol
 
@@ -82,7 +82,8 @@ def expected_digest(ticket: str, npasses: int) -> str:
 
 
 def _run_pass_beam(spool: str, wid: str, rec: dict, args,
-                   npasses: int) -> dict:
+                   npasses: int,
+                   box: health.FlightRecorder | None = None) -> dict:
     """One multi-pass beam through the checkpoint store.  Returns the
     result-record extras (passes, computed/resumed counts, digest)."""
     from tpulsar import checkpoint as ckpt   # hoisted via main()
@@ -93,6 +94,8 @@ def _run_pass_beam(spool: str, wid: str, rec: dict, args,
     outdir = rec.get("outdir") or ""
 
     def jr(event: str, **extra) -> None:
+        if box is not None:
+            box.note("journal", event=event, ticket=tid)
         journal.record(spool, event, ticket=tid, worker=wid,
                        attempt=att,
                        trace_id=rec.get("trace_id", ""), **extra)
@@ -127,6 +130,9 @@ def _run_pass_beam(spool: str, wid: str, rec: dict, args,
                 f"pass_{k:04d}", data, kind="pass", pass_idx=k):
             jr("pass_complete", pass_idx=k, npasses=npasses)
         if args.crash_after_pass and computed >= args.crash_after_pass:
+            if box is not None:
+                box.dump(reason=f"--crash-after-pass on {tid} "
+                                f"pass {k}", rc=70)
             os._exit(70)
     h = hashlib.sha256()
     for k in range(npasses):
@@ -220,6 +226,10 @@ def main(argv=None) -> int:
     # evidence) land at the backend's journal root — identical to the
     # spool for every committed scenario layout
     jroot = q.journal_root or spool
+    # flight recorder: bounded ring of recent claims/journal appends/
+    # heartbeats, dumped to <spool>/blackbox/ on any abnormal exit so
+    # a crashed worker's last seconds are reconstructable post-mortem
+    box = health.FlightRecorder(wid, spool=spool)
 
     draining = []
     signal.signal(signal.SIGTERM, lambda *a: draining.append(1))
@@ -239,6 +249,7 @@ def main(argv=None) -> int:
                 **({"worker_class": args.worker_class}
                    if args.worker_class else {}))
             last_beat[0] = now
+            box.note("heartbeat", status=status)
         except OSError:
             pass      # a spool.io window costs freshness, not the worker
 
@@ -249,15 +260,20 @@ def main(argv=None) -> int:
     except OSError:
         pass
     beat(force=True)
+    box.arm()
 
     claims = [0]
 
     def process_ticket(rec: dict) -> None:
         claims[0] += 1
-        if args.crash_after and claims[0] >= args.crash_after:
-            os._exit(70)
         tid = rec.get("ticket", "?")
+        box.note("claim", ticket=tid, n=claims[0])
+        if args.crash_after and claims[0] >= args.crash_after:
+            box.dump(reason=f"--crash-after on claim {claims[0]}",
+                     rc=70)
+            os._exit(70)
         att = int(rec.get("attempts", 0))
+        box.note("journal", event="search_start", ticket=tid)
         journal.record(jroot, "search_start", ticket=tid, worker=wid,
                        attempt=att, trace_id=rec.get("trace_id", ""))
         # worker-crash injection: hard exit mid-beam, claim in place,
@@ -267,6 +283,8 @@ def main(argv=None) -> int:
                 faults.fire("fleet.worker",
                             detail=f"ticket {tid} worker {wid}")
             except BaseException:
+                box.dump(reason=f"fleet.worker fault on {tid}",
+                         rc=70)
                 os._exit(70)
         status, err = "done", ""
         extras: dict = {}
@@ -275,7 +293,7 @@ def main(argv=None) -> int:
             faults.fire("serve.beam", detail=f"ticket {tid}")
             if npasses > 0:
                 extras = _run_pass_beam(jroot, wid, rec, args,
-                                        npasses)
+                                        npasses, box=box)
             else:
                 time.sleep(float(rec.get("beam_s", args.beam_s)))
         except Exception as e:   # noqa: BLE001 — crash isolation:
@@ -291,10 +309,12 @@ def main(argv=None) -> int:
                     outdir=rec.get("outdir", ""),
                     trace_id=rec.get("trace_id", ""), **extras)
                 break
-            except OSError:
+            except OSError as e:
                 if io_try == 2:
                     # persistent spool failure: die with the claim in
                     # place — the janitor reassigns, never loses it
+                    box.dump(reason=f"result write failed for {tid}:"
+                                    f" {e}", rc=74)
                     os._exit(74)
                 time.sleep(0.05 * (io_try + 1))
         if status == "done" and npasses > 0 and rec.get("outdir"):
@@ -339,6 +359,8 @@ def main(argv=None) -> int:
                 # mid-batch SIGKILL footprint: first beam's result is
                 # durable, every remaining batchmate's claim is held
                 # — the janitor must requeue each individually
+                box.dump(reason="--crash-mid-batch after first beam",
+                         rc=70)
                 os._exit(70)
         beat()
     if draining:
@@ -346,6 +368,7 @@ def main(argv=None) -> int:
             q.requeue_own_claims()
         except OSError:
             pass
+    box.disarm()        # clean exit: no dump, no atexit footprint
     beat("stopped", force=True)
     return 0
 

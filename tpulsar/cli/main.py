@@ -32,13 +32,27 @@ def _tracker(args):
 
 
 def _notify(cfg):
-    from tpulsar.obs.mailer import ErrorMailer
+    """Daemon crash fan-out through the alert notifier plane
+    (obs/alerts.py, spec from TPULSAR_ALERT_NOTIFY): the SMTP-era
+    ErrorMailer is retired — pager/webhook/log routing is one
+    pluggable spec shared with the fleet health doctor."""
+    from tpulsar.obs import alerts
+
+    try:
+        notifier = alerts.make_notifier(
+            os.environ.get("TPULSAR_ALERT_NOTIFY", "log"))
+    except ValueError as e:
+        print(f"bad TPULSAR_ALERT_NOTIFY ({e}); falling back to log",
+              file=sys.stderr)
+        notifier = alerts.LogNotifier()
 
     def send(subject, body):
         try:
-            ErrorMailer(body, subject=subject, config=cfg.email).send()
+            notifier.notify({"rule": "daemon_error",
+                             "severity": "page", "state": "firing",
+                             "subject": subject, "body": body})
         except Exception:
-            pass
+            pass          # notification must never take a daemon down
     return send
 
 
@@ -782,8 +796,26 @@ def cmd_trace(args):
     return 0
 
 
+def _obs_queue(args, spool):
+    """Resolve an obs/doctor ``--queue`` URL to (backend, journal
+    root): reads route through the TicketQueue so ``sqlite:`` fleets
+    are first-class, and the filesystem root (worker metric
+    snapshots, blackbox dumps, alerts.json) follows the backend's
+    journal_root.  The bare token 'sqlite' expands to
+    sqlite:<spool>/queue.db, mirroring the chaos commands."""
+    url = getattr(args, "queue", "") or ""
+    if not url:
+        return None, spool
+    if url == "sqlite":
+        url = f"sqlite:{os.path.join(spool, 'queue.db')}"
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    q = get_ticket_queue(url)
+    return q, q.journal_root or spool
+
+
 def cmd_obs(args):
-    """The fleet ops console (tpulsar/obs/journal.py + fleetview.py):
+    """The fleet ops console (tpulsar/obs/journal.py + fleetview.py
+    + health.py):
 
       timeline <ticket> — one beam's full lifecycle from the spool's
                           ticket journal, across every worker that
@@ -793,20 +825,25 @@ def cmd_obs(args):
                           journal-derived SLO quantiles (refresh
                           loop; --once for scripts/CI)
       tail              — follow the ticket journal as events land
+      blackbox <worker> — render a dead worker's flight-recorder
+                          dump (the last seconds before death)
 
-    All three read spool state only — no connection to any worker or
-    controller process is needed."""
+    All of them read spool/backend state only — no connection to any
+    worker or controller process is needed.  ``--queue`` routes the
+    reads through a ticket-queue backend (the ``sqlite:`` path)."""
     from tpulsar.config import settings
     from tpulsar.obs import fleetview, journal
 
     spool = args.spool or _serve_spool(settings())
+    queue, root = _obs_queue(args, spool)
     if args.obs_cmd == "timeline":
-        text = journal.render_timeline(spool, args.ticket)
+        text = journal.render_timeline(root, args.ticket,
+                                       queue=queue)
         print(text)
         if args.stitch:
             import json as _json
             try:
-                obj = fleetview.stitch(spool, args.ticket)
+                obj = fleetview.stitch(root, args.ticket)
             except FileNotFoundError as e:
                 print(str(e), file=sys.stderr)
                 return 1
@@ -818,7 +855,7 @@ def cmd_obs(args):
     if args.obs_cmd == "top":
         try:
             while True:
-                text = fleetview.render_top(spool)
+                text = fleetview.render_top(root, queue=queue)
                 if not args.once:
                     os.system("clear" if os.name != "nt" else "cls")
                 print(text, flush=True)
@@ -827,6 +864,11 @@ def cmd_obs(args):
                 time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
+    if args.obs_cmd == "blackbox":
+        from tpulsar.obs import health
+        text = health.render_blackbox(root, args.worker)
+        print(text)
+        return 0 if not text.startswith("no blackbox dump") else 1
     if args.obs_cmd == "tail":
         # ride the journal's offset-tailed reader: the attach read
         # replays history once, each poll then costs O(new bytes)
@@ -841,9 +883,12 @@ def cmd_obs(args):
             # here would stall the loop at the same offset forever
             bad: list = []
             try:
-                evs, off = journal.read_events(spool,
-                                               after_offset=off,
-                                               bad_lines=bad)
+                if queue is not None:
+                    evs, off = queue.read_events_after(off)
+                else:
+                    evs, off = journal.read_events(root,
+                                                   after_offset=off,
+                                                   bad_lines=bad)
             except OSError:
                 return [], off
             for b in bad:
@@ -1093,12 +1138,51 @@ def cmd_aot(args):
         nbeams=args.beams, verify=args.aot_cmd == "verify")
 
 
+def _doctor_alerts(args):
+    """Fleet health verdict from the declarative alert pack
+    (obs/health.py + obs/alerts.py): one-shot evaluates the rules
+    read-only against the journal/metrics/fsck surfaces and exits
+    0 healthy / 1 firing; ``--watch`` hosts a resident
+    HealthDetector instead (journaling alert transitions, persisting
+    alerts.json, fanning out through the notifier) — the standalone
+    spelling of the loop every FleetController already runs."""
+    from tpulsar.config import settings
+    from tpulsar.obs import alerts as alerts_lib, health
+
+    spool = args.spool or _serve_spool(settings())
+    queue, root = _obs_queue(args, spool)
+    rules = alerts_lib.load_rules(args.rules) if args.rules else None
+    title = f"fleet health: {root}"
+    if not args.watch:
+        active = health.evaluate_once(root, queue=queue, rules=rules)
+        print(health.render_alerts(active, title=title))
+        return 1 if active else 0
+    det = health.HealthDetector(root, queue=queue, rules=rules)
+    interval = (args.interval if args.interval > 0
+                else health.alert_interval_s())
+    try:
+        while True:
+            active = det.tick()
+            print(health.render_alerts(
+                active, title=f"{title} (watch, {interval:g}s)"),
+                flush=True)
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_doctor(args):
     """Environment probe: the reference's install_test.py dependency
     check and test_job.py worker-node probe (imports, directories
     writable, job tracker reachable, queue-manager contract, and an
     accelerator health probe in a subprocess under a timeout) rolled
-    into one operator command.  Exit 0 = healthy."""
+    into one operator command.  Exit 0 = healthy.
+
+    With --spool/--queue/--rules/--watch the doctor judges the FLEET
+    instead of the node: the declarative alert pack against the live
+    journal (see _doctor_alerts)."""
+    if args.watch or args.spool or args.queue or args.rules:
+        return _doctor_alerts(args)
     import importlib
     import json
     import subprocess
@@ -1529,14 +1613,24 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser(
         "obs",
         help="fleet observability console: per-ticket lifecycle "
-             "timeline from the spool journal, live fleet top, and "
-             "journal tail — all from spool state alone")
+             "timeline from the spool journal, live fleet top, "
+             "journal tail, and crashed-worker blackbox dumps — all "
+             "from spool/backend state alone")
     osub = sp.add_subparsers(dest="obs_cmd", required=True)
+
+    def _obs_queue_arg(op):
+        op.add_argument(
+            "--queue", default="",
+            help="route reads through this ticket-queue backend URL "
+                 "(sqlite:<path> / spool:<dir>); the bare token "
+                 "'sqlite' expands to sqlite:<spool>/queue.db")
+
     op = osub.add_parser(
         "timeline", help="one beam's lifecycle across the fleet "
                          "(journal events + durations)")
     op.add_argument("ticket")
     op.add_argument("--spool", default=None)
+    _obs_queue_arg(op)
     op.add_argument("--stitch", default=None, metavar="OUT.json",
                     help="also write the stitched Perfetto timeline "
                          "(journal events + this beam's trace spans "
@@ -1546,14 +1640,26 @@ def build_parser() -> argparse.ArgumentParser:
         "top", help="live per-worker state, queue depths, and "
                     "journal SLO quantiles")
     op.add_argument("--spool", default=None)
+    _obs_queue_arg(op)
     op.add_argument("--interval", type=float, default=2.0)
     op.add_argument("--once", action="store_true")
     op.set_defaults(fn=cmd_obs)
     op = osub.add_parser("tail", help="follow the ticket journal")
     op.add_argument("--spool", default=None)
+    _obs_queue_arg(op)
     op.add_argument("-n", "--lines", type=int, default=20)
     op.add_argument("-f", "--follow", action="store_true")
     op.add_argument("--interval", type=float, default=0.5)
+    op.set_defaults(fn=cmd_obs)
+    op = osub.add_parser(
+        "blackbox", help="render a crashed worker's flight-recorder "
+                         "dump: the bounded ring of its last "
+                         "claims/journal appends/heartbeats, written "
+                         "to <spool>/blackbox/ on abnormal exit")
+    op.add_argument("worker", nargs="?", default="",
+                    help="worker id (empty = the single-server dump)")
+    op.add_argument("--spool", default=None)
+    _obs_queue_arg(op)
     op.set_defaults(fn=cmd_obs)
 
     sp = sub.add_parser(
@@ -1650,10 +1756,32 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser(
         "doctor",
-        help="probe the environment: imports, config, directories, "
-             "job tracker, queue manager, accelerator")
+        help="health doctor: with no flags, probe the NODE (imports, "
+             "config, directories, job tracker, queue manager, "
+             "accelerator); with --spool/--queue/--watch, judge the "
+             "FLEET against the declarative alert pack (SLO burn "
+             "rate, worker flap, quarantine, fsck, ...) — rc 0 "
+             "healthy / 1 firing")
     sp.add_argument("--device-timeout", type=float, default=60.0,
                     help="accelerator probe timeout, seconds")
+    sp.add_argument("--spool", default="",
+                    help="fleet mode: evaluate the alert rules over "
+                         "this spool's journal + metric snapshots")
+    sp.add_argument("--queue", default="",
+                    help="fleet mode: route reads through this "
+                         "ticket-queue backend URL ('sqlite' expands "
+                         "to sqlite:<spool>/queue.db)")
+    sp.add_argument("--rules", default="",
+                    help="JSON alert-rules file extending/replacing "
+                         "the built-in pack (default: "
+                         "TPULSAR_ALERT_RULES)")
+    sp.add_argument("--watch", action="store_true",
+                    help="host a resident detector loop: journal "
+                         "alert transitions, persist alerts.json, "
+                         "notify via TPULSAR_ALERT_NOTIFY")
+    sp.add_argument("--interval", type=float, default=0.0,
+                    help="--watch tick period seconds (default: "
+                         "TPULSAR_ALERT_INTERVAL_S)")
     sp.set_defaults(fn=cmd_doctor)
 
     sp = sub.add_parser(

@@ -79,6 +79,17 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
     _k("TPULSAR_ACCEL_Z_CHUNK", "int [1,64]", "auto",
        "forced z-axis chunk height of the accel correlation "
        "programs (plane-memory / dispatch-count trade)"),
+    _k("TPULSAR_ALERT_INTERVAL_S", "float", "5",
+       "health-doctor detector tick period inside the fleet "
+       "controller and `tpulsar doctor --watch`; <= 0 disables the "
+       "hosted detector"),
+    _k("TPULSAR_ALERT_NOTIFY", "spec", "log",
+       "alert notifier fan-out: log | webhook:<url> | "
+       "command:<argv> (alert JSON POSTed / piped on stdin); "
+       "unknown schemes fail loudly at configure"),
+    _k("TPULSAR_ALERT_RULES", "path", "unset (built-in pack)",
+       "JSON alert-rules file extending (or with replace=true, "
+       "replacing) the built-in rule pack; load failures are loud"),
     _k("TPULSAR_BEAM_BATCH", "int", "0 (planner budget)",
        "pin the largest coalesced beam group of the batch-of-beams "
        "search (kernels/beam_batch.py): 1 = coalescing off (every "
@@ -94,6 +105,14 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
        "synthetic-beam sample dtype the AOT registry's program "
        "signatures assume (shared by bench.py so the gate compiles "
        "what the measured run executes)"),
+    _k("TPULSAR_BLACKBOX", "enum(0)", "on",
+       "0 disables the per-worker flight recorder (the in-memory "
+       "ring dumped to <spool>/blackbox/ on crash or abnormal "
+       "exit)"),
+    _k("TPULSAR_BLACKBOX_RING", "int", "256",
+       "flight-recorder ring size: how many recent journal appends/"
+       "heartbeats/claims a worker keeps in memory for its crash "
+       "dump"),
     _k("TPULSAR_CACHE_DIR", "path", ".jax_cache in a checkout",
        "persistent XLA compile-cache directory (one cache for the "
        "AOT gate, the measured child, and diagnostics)"),

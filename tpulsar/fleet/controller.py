@@ -48,7 +48,7 @@ import time
 
 from tpulsar.fleet import autoscale as autoscale_mod
 from tpulsar.frontdoor import queue as queue_mod
-from tpulsar.obs import fleetview, journal, metrics, telemetry
+from tpulsar.obs import fleetview, health, journal, metrics, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.resilience import policy
 from tpulsar.serve import protocol
@@ -206,6 +206,21 @@ class FleetController:
         #: lesson about per-poll-second O(spool) work, applied here)
         self.prom_interval_s = 10.0
         self._prom_last = 0.0
+        #: the hosted health doctor: every fleet gets the alert
+        #: detector for free (TPULSAR_ALERT_INTERVAL_S <= 0 opts
+        #: out); a doctor that cannot construct must not keep the
+        #: fleet from serving
+        self.alert_interval_s = health.alert_interval_s()
+        self._doctor: health.HealthDetector | None = None
+        self._doctor_last = 0.0
+        if self.alert_interval_s > 0:
+            try:
+                self._doctor = health.HealthDetector(
+                    self.jroot, queue=self.q, spool=self.spool,
+                    extra_snapshots=lambda:
+                        (metrics.REGISTRY.snapshot(),))
+            except (OSError, ValueError) as e:
+                self.log.error("health doctor disabled: %s", e)
         self.started_at = time.time()
 
     # ------------------------------------------------------------ control
@@ -568,6 +583,26 @@ class FleetController:
                          before, before - 1, mode, w.worker_id,
                          decision.reason)
 
+    # ------------------------------------------------------------- doctor
+
+    def _doctor_tick(self, force: bool = False) -> None:
+        """One hosted health-doctor evaluation (throttled to
+        alert_interval_s).  A detector tick failure costs that tick,
+        never the fleet — the doctor is observational, like the
+        journal it reads."""
+        if self._doctor is None:
+            return
+        now = time.time()
+        if not force and now - self._doctor_last \
+                < self.alert_interval_s:
+            return
+        self._doctor_last = now
+        try:
+            self._doctor.tick()
+        except Exception:
+            self.log.warning("health doctor tick failed",
+                             exc_info=True)
+
     # ---------------------------------------------------------- aggregate
 
     def _worker_state(self, w: _Worker) -> str:
@@ -642,9 +677,15 @@ class FleetController:
             if status == "stopped" or \
                     now - self._prom_last >= self.prom_interval_s:
                 self._prom_last = now
+                extras = [metrics.REGISTRY.snapshot()]
+                if self._doctor is not None:
+                    # the doctor's active-alert gauge rides the
+                    # merged export: tpulsar_alerts_active is
+                    # scrape-able wherever fleet.prom already is
+                    extras.append(self._doctor.metrics_snapshot())
                 fleetview.write_fleet_prom(
                     self.spool,
-                    extra_snapshots=(metrics.REGISTRY.snapshot(),),
+                    extra_snapshots=tuple(extras),
                     path=os.path.join(self.spool, FLEET_PROM))
         except OSError:
             pass         # a full disk must not take the fleet down
@@ -744,6 +785,7 @@ class FleetController:
                 self._respawn_due()
                 self._janitor()
                 self._autoscale_tick()
+                self._doctor_tick()
                 cmd = read_control(self.spool)
                 if cmd == "drain":
                     self.log.info("control file: drain")
@@ -809,6 +851,11 @@ class FleetController:
         # themselves are fine, but a worker that died ignoring the
         # drain leaves orphans this controller should not strand
         self._janitor()
+        # ...and one last doctor pass over the final journal state:
+        # a crash in the storm's last seconds must still make its
+        # alert deadline (the alert_no_missed audit), and the
+        # persisted alerts.json must reflect everything that happened
+        self._doctor_tick(force=True)
         self._aggregate(status="stopped")
         self.log.info(
             "fleet stopped after %.0f s: pending=%d claimed=%d "

@@ -396,6 +396,26 @@ class GatewayServer:
             "pending": self.queue.pending_count(),
             "backend": self.queue.backend, "role": "gateway"}
 
+    def handle_alerts(self) -> tuple[int, dict]:
+        """The health doctor's currently-firing alerts, read from the
+        ``alerts.json`` snapshot the detector persists at the journal
+        root every tick — the gateway never evaluates rules itself
+        (one detector, one verdict; the HTTP plane only serves it)."""
+        self._require_queue()
+        from tpulsar.obs import health
+        root = self.queue.journal_root
+        if not root:
+            return 200, {"alerts": [], "doctor": "unavailable",
+                         "detail": "queue backend has no journal "
+                                   "root to read alerts.json from"}
+        rec = health.read_active_alerts(root)
+        if rec is None:
+            return 200, {"alerts": [], "doctor": "absent",
+                         "detail": f"no {health.ALERTS_FILE} at "
+                                   f"{root} — no detector has run"}
+        return 200, {"alerts": rec.get("alerts", []),
+                     "doctor": "ok", "t": rec.get("t")}
+
     def _require_queue(self) -> None:
         if self.queue is None:
             raise GatewayError(
@@ -494,6 +514,8 @@ def _make_handler(gw: GatewayServer):
                 self._metrics()
             elif path == "/v1/capacity":
                 self._dispatch("capacity", gw.handle_capacity)
+            elif path == "/v1/alerts":
+                self._dispatch("alerts", gw.handle_alerts)
             elif path == "/v1/candidates":
                 self._dispatch("candidates",
                                lambda: gw.handle_candidates(params))

@@ -77,12 +77,19 @@ import time
 import uuid
 
 from tpulsar.frontdoor import queue as queue_mod
-from tpulsar.obs import journal
+from tpulsar.obs import journal, telemetry
 from tpulsar.resilience import faults
 from tpulsar.resilience import policy as respolicy
 from tpulsar.serve import protocol
 
 _STATES = ("incoming", "claimed", "done", "quarantine")
+
+#: the hot-path operations timed into tpulsar_queue_op_seconds —
+#: a deliberate whitelist, so introspection reads (ticket_state,
+#: list_heartbeats, ...) don't multiply the label cardinality
+_TIMED_OPS = frozenset(
+    ("submit", "claim", "claim_batch", "requeue", "result",
+     "heartbeat"))
 
 #: default SQLite busy timeout (seconds) — both the connection-level
 #: timeout and PRAGMA busy_timeout; TPULSAR_QUEUE_BUSY_TIMEOUT_S
@@ -253,10 +260,20 @@ class SQLiteTicketQueue(queue_mod.TicketQueue):
 
     def _guard(self, attempt, label: str):
         """Busy-retry + terminal-error classification around one
-        read or one whole transaction."""
+        read or one whole transaction.  Hot-path ops (the _TIMED_OPS
+        whitelist) land their wall time — busy retries included, the
+        latency a caller actually feels — in the
+        tpulsar_queue_op_seconds histogram."""
+        op = label.replace(" ", "_")
+        t0 = time.perf_counter() if op in _TIMED_OPS else None
         try:
-            return respolicy.call(attempt, self._retry,
-                                  label="queue.db")
+            out = respolicy.call(attempt, self._retry,
+                                 label="queue.db")
+            if t0 is not None:
+                telemetry.queue_op_seconds().observe(
+                    time.perf_counter() - t0,
+                    backend="sqlite", op=op)
+            return out
         except sqlite3.DatabaseError as e:
             if _is_corrupt(e):
                 self._refuse(str(e))

@@ -228,15 +228,21 @@ def write_fleet_prom(spool: str, extra_snapshots: tuple = (),
 # ------------------------------------------------------------- ops top
 
 def render_top(spool: str,
-               max_age_s: float | None = None) -> str:
+               max_age_s: float | None = None,
+               queue=None) -> str:
     """One refresh of ``tpulsar obs top``: live per-worker state,
-    queue depths, spool counts, and the journal SLO gauges."""
+    queue depths, spool counts, and the journal SLO gauges.  With a
+    TicketQueue in ``queue``, every queue-state read (heartbeats,
+    counts, capacity) goes through the backend — a sqlite fleet's
+    top looks identical to a spool fleet's; ``spool`` stays the
+    journal root the SLO series are derived from."""
     if max_age_s is None:
         max_age_s = protocol.heartbeat_max_age()
     now = time.time()
     lines = [f"fleet spool {spool}  "
              f"({time.strftime('%H:%M:%S', time.localtime(now))})"]
-    heartbeats = protocol.list_heartbeats(spool)
+    heartbeats = (queue.list_heartbeats() if queue is not None
+                  else protocol.list_heartbeats(spool))
     lines.append(
         f"{'worker':10s} {'state':6s} {'pid':>7s} {'hb age':>7s} "
         f"{'depth':>7s}  {'done':>5s} {'fail':>5s} {'skip':>5s}")
@@ -254,12 +260,21 @@ def render_top(spool: str,
             f"{beams.get('skipped', 0):>5}")
     if not heartbeats:
         lines.append("  (no worker heartbeats)")
-    cap = protocol.fleet_capacity(spool, max_age_s)
+    if queue is not None:
+        cap = queue.capacity(max_age_s)
+        pending, claimed = queue.pending_count(), \
+            queue.claimed_count()
+        done = queue.state_count("done")
+        quarantined = queue.state_count("quarantine")
+    else:
+        cap = protocol.fleet_capacity(spool, max_age_s)
+        pending = protocol.pending_count(spool)
+        claimed = protocol.claimed_count(spool)
+        done = protocol.state_count(spool, "done")
+        quarantined = protocol.state_count(spool, "quarantine")
     lines.append(
-        f"spool: pending={protocol.pending_count(spool)} "
-        f"claimed={protocol.claimed_count(spool)} "
-        f"done={protocol.state_count(spool, 'done')} "
-        f"quarantined={protocol.state_count(spool, 'quarantine')} "
+        f"spool: pending={pending} claimed={claimed} done={done} "
+        f"quarantined={quarantined} "
         f"capacity={'SHED (0 fresh)' if cap is None else cap}")
     summary = journal.summarize(spool)
     if summary["tickets"]:

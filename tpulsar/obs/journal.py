@@ -154,6 +154,11 @@ EVENTS = {
                      "integrity check failed or the database is "
                      "unreadable (path, error) — containment "
                      "evidence, never silent data loss",
+    "alert_fired": "the health doctor's detector breached an alert "
+                   "rule past its debounce (rule, severity, value, "
+                   "threshold, window_s) — self-contained evidence",
+    "alert_resolved": "a firing alert rule's signal dropped back "
+                      "under its threshold (rule, severity, value)",
 }
 
 #: the one terminal event name: a ticket is finished exactly when its
@@ -507,13 +512,18 @@ def chain_summary(events: list[dict]) -> dict:
     return out
 
 
-def summarize(spool: str) -> dict:
+def summarize(spool: str, queue=None) -> dict:
     """Spool-wide journal digest: per-ticket chains + fleet counts —
     the input both the fleet metrics aggregator (obs/fleetview.py)
-    and ``tools/trace_summarize.py --spool`` read."""
+    and ``tools/trace_summarize.py --spool`` read.  ``queue`` routes
+    the event read through a TicketQueue backend instead of the
+    spool's journal files (the ``sqlite:``/``memory:`` path)."""
     # tolerant read: the fleet aggregator and ops console must keep
     # rendering past a corrupt line (chaos verify reports it)
-    events = read_events(spool, bad_lines=[])
+    if queue is not None:
+        events, _ = queue.read_events_after(0)
+    else:
+        events = read_events(spool, bad_lines=[])
     per = iter_tickets(events)
     tickets = {tid: chain_summary(evs) for tid, evs in per.items()}
     statuses: dict[str, int] = {}
@@ -532,11 +542,15 @@ def summarize(spool: str) -> dict:
     }
 
 
-def render_timeline(spool: str, ticket: str) -> str:
+def render_timeline(spool: str, ticket: str, queue=None) -> str:
     """The ops-console timeline: one beam's full lifecycle across
     every worker that touched it, with the duration between
-    transitions — `tpulsar obs timeline <ticket>`."""
-    events = read_events(spool, ticket=ticket, bad_lines=[])
+    transitions — `tpulsar obs timeline <ticket>`.  ``queue`` routes
+    the event read through a TicketQueue backend."""
+    if queue is not None:
+        events, _ = queue.read_events_after(0, ticket=ticket)
+    else:
+        events = read_events(spool, ticket=ticket, bad_lines=[])
     if not events:
         return f"no journal events for ticket {ticket!r} in {spool}"
     digest = chain_summary(events)

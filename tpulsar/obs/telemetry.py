@@ -488,6 +488,34 @@ def fleet_event_rate(reg: metrics.Registry) -> metrics.Gauge:
         labelnames=("event",))
 
 
+def alerts_active(reg: metrics.Registry) -> metrics.Gauge:
+    return reg.gauge(
+        "tpulsar_alerts_active",
+        "health-doctor alert rules currently firing (value 1 per "
+        "active rule), by rule id and severity — each transition is "
+        "also journaled as an alert_fired/alert_resolved event "
+        "carrying the rule's signal values and window, so the gauge "
+        "is the live view and the journal the evidence",
+        labelnames=("rule", "severity"))
+
+
+#: histogram buckets for ticket-queue backend operations: healthy
+#: sub-millisecond spool renames / SQLite commits up to lock-contended
+#: multi-second waits (TPULSAR_QUEUE_BUSY_TIMEOUT_S territory)
+QUEUE_OP_BUCKETS = (0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0)
+
+
+def queue_op_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_queue_op_seconds",
+        "ticket-queue backend operation latency by backend (spool | "
+        "sqlite) and op (submit/claim/claim_batch/requeue_scan/"
+        "result/heartbeat/...) — both backends observe the same op "
+        "vocabulary so an A/B between the spool protocol and the "
+        "durable SQLite queue is one PromQL ratio",
+        labelnames=("backend", "op"), buckets=QUEUE_OP_BUCKETS)
+
+
 def chaos_actions_total() -> metrics.Counter:
     return metrics.counter(
         "tpulsar_chaos_actions_total",

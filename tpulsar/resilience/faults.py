@@ -112,6 +112,12 @@ FAULT_POINTS = (
     #                         errno= option makes it a disk-shaped
     #                         OSError; delay mode models a congested
     #                         database volume without failing anything
+    "blackbox.dump",        # obs/health.py flight-recorder crash dump:
+    #                         fired MID-WRITE (after the first half of
+    #                         the ring has landed) so an armed spec
+    #                         leaves a torn blackbox file — the render
+    #                         path must salvage the prefix, because a
+    #                         real crashing worker can die mid-dump too
 )
 
 MODES = ("unimplemented", "hang", "delay", "poison")

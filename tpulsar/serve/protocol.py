@@ -56,6 +56,7 @@ Result shape (written by the server):
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -63,6 +64,28 @@ import uuid
 
 from tpulsar.obs import journal
 from tpulsar.resilience import faults
+
+
+def _timed(op: str):
+    """Land a hot-path spool operation's wall time in the
+    ``tpulsar_queue_op_seconds`` histogram (``backend="spool"``) —
+    the same series the sqlite backend observes around its
+    transactions, so a queue-backend migration is an
+    apples-to-apples latency comparison, not two dashboards.
+    Failed operations are not observed: the histogram answers "how
+    long does a successful claim take", errors have their own
+    counters."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from tpulsar.obs import telemetry
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            telemetry.queue_op_seconds().observe(
+                time.perf_counter() - t0, backend="spool", op=op)
+            return out
+        return wrapper
+    return deco
 
 #: heartbeats older than this are stale: the worker is gone (crashed,
 #: drained, or never started); with zero fresh workers clients must
@@ -175,6 +198,7 @@ def ticket_path(spool: str, ticket_id: str, state: str) -> str:
 
 # ------------------------------------------------------------- tickets
 
+@_timed("submit")
 def write_ticket(spool: str, ticket_id: str, datafiles: list[str],
                  outdir: str, job_id: int | None = None,
                  **extra) -> str:
@@ -317,6 +341,7 @@ def inflight_by_tenant(spool: str) -> dict[str, int]:
     return counts
 
 
+@_timed("claim")
 def claim_next_ticket(spool: str, worker_id: str = "",
                       policy=None,
                       worker_class: str = "") -> dict | None:
@@ -366,6 +391,7 @@ def claim_next_ticket(spool: str, worker_id: str = "",
     return None
 
 
+@_timed("claim_batch")
 def claim_batch(spool: str, n: int, worker_id: str = "",
                 policy=None, worker_class: str = "",
                 compat: str | None = None) -> list[dict]:
@@ -891,6 +917,7 @@ def _quarantine(spool: str, rec: dict, max_attempts: int) -> None:
         trace_id=rec.get("trace_id", ""))
 
 
+@_timed("requeue")
 def _requeue_claims(spool: str, verdict_fn,
                     max_attempts: int = DEFAULT_MAX_ATTEMPTS,
                     neutral_reason: str = "drain") -> list[str]:
@@ -1059,6 +1086,7 @@ def requeue_own_claims(spool: str) -> list[str]:
 
 # ------------------------------------------------------------- results
 
+@_timed("result")
 def write_result(spool: str, ticket_id: str, status: str,
                  rc: int = 0, error: str = "", **extra) -> None:
     """Record a beam's outcome in done/ and release its claim.  The
@@ -1124,6 +1152,7 @@ def heartbeat_path(spool: str, worker_id: str = "") -> str:
     return os.path.join(spool, "server.json")
 
 
+@_timed("heartbeat")
 def write_heartbeat(spool: str, worker_id: str = "", **fields) -> None:
     ensure_spool(spool)
     rec = {"t": time.time(), "pid": os.getpid(),

@@ -49,7 +49,7 @@ import time
 # serve.protocol, so pulling a name out of it here would trip the
 # circular-import guard when queue.py is the first module loaded.
 from tpulsar.frontdoor import queue as frontdoor_queue
-from tpulsar.obs import journal, telemetry
+from tpulsar.obs import health, journal, telemetry
 from tpulsar.obs.log import get_logger
 from tpulsar.resilience import faults, policy
 from tpulsar.serve import protocol
@@ -151,6 +151,12 @@ class SearchServer:
         self._hb_thread: threading.Thread | None = None
         self._hb_last = 0.0
         self.beams = {"done": 0, "failed": 0, "skipped": 0}
+        #: the flight recorder (obs/health.py): a bounded ring of
+        #: this worker's recent moves, dumped to <spool>/blackbox/ on
+        #: crash or abnormal exit — armed once serving starts,
+        #: disarmed by a clean drain
+        self.blackbox = health.FlightRecorder(
+            worker_id, spool=self.spool)
         self.started_at = time.time()
 
     # ------------------------------------------------------------ control
@@ -176,6 +182,8 @@ class SearchServer:
         """This worker's journal hook (the stage-in pipeline calls it
         too): stamps worker id, attempt, and the ticket's trace id
         onto every event."""
+        self.blackbox.note("journal", event=event,
+                           ticket=ticket.get("ticket", "?"))
         journal.record(
             self.jroot, event, ticket=ticket.get("ticket", "?"),
             worker=self.worker_id,
@@ -222,6 +230,7 @@ class SearchServer:
         if not force and now - self._hb_last < self.heartbeat_interval_s:
             return
         depth = self.queue.pending_count()
+        self.blackbox.note("heartbeat", status=status, depth=depth)
         telemetry.serve_queue_depth().set(depth)
         self.queue.heartbeat(
             worker_id=self.worker_id, status=status,
@@ -267,6 +276,7 @@ class SearchServer:
             daemon=True)
         self._hb_thread.start()
         self.boot()
+        self.blackbox.arm()
         self.pipeline.start()
         try:
             while not self.draining:
@@ -293,6 +303,9 @@ class SearchServer:
 
     def _shutdown(self) -> None:
         t0 = time.time()
+        # a drain that reaches here is the clean exit path: the
+        # atexit dump must not leave wreckage for a healthy shutdown
+        self.blackbox.disarm()
         self._stopped.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)
@@ -375,6 +388,11 @@ class SearchServer:
                 # requeue_stale_claims / the fleet janitor to recover
                 self.log.error("fleet.worker fault: crashing on "
                                "ticket %s", tid)
+                # os._exit skips atexit: dump the black box NOW —
+                # this is the evidence trail the injected crash
+                # exists to exercise
+                self.blackbox.dump(
+                    reason=f"fleet.worker fault on {tid}", rc=70)
                 self._crash(70)
                 return          # unreachable with the real os._exit
         att = int(prepared.ticket.get("attempts", 0))
@@ -467,6 +485,9 @@ class SearchServer:
                 # mid-batch kill the janitor must requeue per ticket
                 self.log.error("fleet.worker fault: crashing on "
                                "batch %s", batch.ticket_ids)
+                self.blackbox.dump(
+                    reason=f"fleet.worker fault on batch "
+                           f"{batch.ticket_ids}", rc=70)
                 self._crash(70)
                 return          # unreachable with the real os._exit
         ok: list[PreparedBeam] = []
@@ -592,11 +613,18 @@ class SearchServer:
                     self.log.error(
                         "ticket %s: result write failed 3x (%s) — "
                         "leaving the claim for the janitor", tid, e)
+                    # abnormal exit path: the unwind reaches
+                    # _shutdown (which disarms), so the black box
+                    # must dump here or not at all
+                    self.blackbox.dump(
+                        reason=f"result write failed for {tid}: {e}")
                     raise
                 self.log.warning(
                     "ticket %s: result write failed (%s); retrying",
                     tid, e)
                 time.sleep(0.05 * (io_try + 1))
+        self.blackbox.note("result", ticket=tid, status=status,
+                           seconds=round(dt, 3))
         self.beams[status] = self.beams.get(status, 0) + 1
         telemetry.serve_beams_total().inc(outcome=status)
         if status != "skipped":
