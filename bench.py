@@ -2249,6 +2249,153 @@ def run_queue() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_dataplane() -> None:
+    """``bench.py --dataplane``: the data plane's two headline
+    numbers — (a) by-digest stage-in bandwidth through a live
+    gateway's blob routes (PUT then the stage-in GET, both
+    digest-verified end to end: the MB/s a spool-less worker
+    actually sees, hashing included), and (b) the candidate query
+    cost, indexed vs legacy outdir parse, over the same rows — the
+    read-path speedup that justifies the index's write-path tax.
+    Correctness rides along: every staged byte re-hashes to its
+    address and the indexed rows equal the parse exactly (asserted,
+    not toleranced).  Knobs: TPULSAR_DPBENCH_BLOB_MB (default 4) /
+    NBLOBS (default 8) / NTICKETS (default 40) / QUERY_ITERS
+    (default 50) / KEEP=1 keeps the scratch dir."""
+    import shutil
+    import tempfile
+
+    from tpulsar.dataplane import blobstore as dp_blobstore
+    from tpulsar.dataplane import index as dp_index
+    from tpulsar.dataplane import transfer
+    from tpulsar.frontdoor import results
+    from tpulsar.frontdoor.gateway import GatewayServer
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    from tpulsar.io import accelcands
+    from tpulsar.search.sifting import Candidate
+
+    blob_mb = float(os.environ.get("TPULSAR_DPBENCH_BLOB_MB", "4"))
+    nblobs = int(os.environ.get("TPULSAR_DPBENCH_NBLOBS", "8"))
+    ntickets = int(os.environ.get("TPULSAR_DPBENCH_NTICKETS", "40"))
+    iters = int(os.environ.get("TPULSAR_DPBENCH_QUERY_ITERS", "50"))
+    base = tempfile.mkdtemp(prefix="tpulsar_dpbench_")
+    spool = os.path.join(base, "spool")
+    os.makedirs(spool, exist_ok=True)
+    q = get_ticket_queue(spool)
+    # a handler-less logger keeps stdout pure bench/v2 (the default
+    # gateway logger echoes INFO to stdout, which would corrupt the
+    # committed baseline — bench_gate json.load()s the whole file)
+    quiet = __import__("logging").getLogger("tpulsar.bench.dpgw")
+    quiet.addHandler(__import__("logging").NullHandler())
+    quiet.propagate = False
+    gw = GatewayServer(queue=q, outdir_base=os.path.join(base, "res"),
+                       blob_root=os.path.join(base, "cas"),
+                       logger=quiet).start()
+    try:
+        # ---- (a) stage-in bandwidth over the wire, verified ------
+        payload = os.urandom(int(blob_mb * 1e6))
+        total_mb = nblobs * len(payload) / 1e6
+        _log(f"dataplane bench: staging {nblobs} x "
+             f"{len(payload) / 1e6:.0f} MB blobs through {gw.url}")
+        digests = []
+        t0 = time.time()
+        for i in range(nblobs):
+            # vary one leading byte so every blob is a distinct
+            # object (no dedup short-circuit flattering the rate)
+            digests.append(transfer.put_bytes(
+                gw.url, bytes([i % 256]) + payload[1:]))
+        put_s = time.time() - t0
+        stage_dir = os.path.join(base, "stagein")
+        os.makedirs(stage_dir, exist_ok=True)
+        t0 = time.time()
+        fetched = 0
+        for i, d in enumerate(digests):
+            fetched += transfer.get_to_file(
+                gw.url, d, os.path.join(stage_dir, f"b{i:03d}.dat"))
+        get_s = time.time() - t0
+        assert fetched == nblobs * len(payload), (fetched, nblobs)
+        stagein_mb_per_s = round(total_mb / get_s, 2) \
+            if get_s > 0 else -1.0
+        put_mb_per_s = round(total_mb / put_s, 2) if put_s > 0 \
+            else -1.0
+
+        # ---- (b) candidate query: index vs outdir parse ----------
+        rng = __import__("random").Random(18)
+        idx = dp_index.CandidateIndex(dp_index.index_path(spool))
+        rows = 0
+        for i in range(ntickets):
+            tid = f"dp-{i:04d}"
+            outdir = os.path.join(base, "out", tid)
+            os.makedirs(outdir, exist_ok=True)
+            cands = []
+            for k in range(10):
+                sig = round(4.0 + rng.random() * 12.0, 2)
+                freq = 1.0 + rng.random() * 50.0
+                cands.append(Candidate(
+                    r=round(100.0 + k, 2), z=round(rng.random(), 2),
+                    sigma=sig, power=round(20.0 + sig, 4),
+                    numharm=1 + k % 8, dm=round(10.0 * (k + 1), 2),
+                    period_s=1.0 / freq, freq_hz=freq,
+                    dm_hits=[(10.0 * (k + 1), sig)]))
+            accelcands.write_candlist(
+                cands, os.path.join(outdir, f"{tid}.accelcands"))
+            q.submit(tid, ["bench://synthetic"], outdir, job_id=i)
+            q.claim_next("dpbench")
+            q.write_result(tid, "done", rc=0, outdir=outdir,
+                           worker="dpbench")
+            rows += idx.index_outdir(tid, outdir)
+        for tid in (f"dp-{i:04d}" for i in range(ntickets)):
+            got = idx.candidate_rows(tid)
+            want = results._candidate_rows(
+                os.path.join(base, "out", tid))
+            assert got == want, f"index drift on {tid}"
+        t0 = time.time()
+        for _ in range(iters):
+            indexed = idx.query(min_sigma=8.0, limit=50)
+        query_ms = round((time.time() - t0) / iters * 1000.0, 3)
+        t0 = time.time()
+        for _ in range(iters):
+            parsed = results.query_candidates(q, min_sigma=8.0,
+                                              limit=50)
+        parse_ms = round((time.time() - t0) / iters * 1000.0, 3)
+        assert indexed["total"] == parsed["total"], \
+            (indexed["total"], parsed["total"])
+        idx.close()
+        speedup = round(parse_ms / query_ms, 2) if query_ms > 0 \
+            else -1.0
+        # a store-side sweep proves every staged byte is durable
+        store = dp_blobstore.BlobStore(os.path.join(base, "cas"))
+        verified = all(store.verify(d) for d in digests)
+        _log(f"dataplane: stage-in {stagein_mb_per_s} MB/s (put "
+             f"{put_mb_per_s} MB/s), candidates {query_ms} ms "
+             f"indexed vs {parse_ms} ms parse ({speedup}x), "
+             f"verify {'clean' if verified else 'FAILED'}")
+        _emit({
+            "metric": "dataplane_stagein_mb_per_s",
+            "value": stagein_mb_per_s,
+            "unit": "MB/s",
+            "dataplane": {
+                "blobs": nblobs,
+                "blob_mb": round(blob_mb, 2),
+                "stagein_mb_per_s": stagein_mb_per_s,
+                "put_mb_per_s": put_mb_per_s,
+                "candidates_query_ms": query_ms,
+                "candidates_parse_ms": parse_ms,
+                "index_speedup": speedup,
+                "tickets": ntickets,
+                "rows": rows,
+                "query_total": indexed["total"],
+                # correctness rows: CI asserts these un-toleranced
+                "all_blobs_verified": verified,
+                "index_matches_parse": True,
+            },
+        })
+    finally:
+        gw.stop()
+        if os.environ.get("TPULSAR_DPBENCH_KEEP", "") != "1":
+            shutil.rmtree(base, ignore_errors=True)
+
+
 def run_doctor() -> None:
     """``bench.py --doctor``: the health doctor's cost and reflexes —
     (a) steady-state tick overhead over a populated journal (the tax
@@ -2664,6 +2811,9 @@ def main() -> None:
         return
     if "--queue" in sys.argv:
         run_queue()
+        return
+    if "--dataplane" in sys.argv:
+        run_dataplane()
         return
     if "--doctor" in sys.argv:
         run_doctor()

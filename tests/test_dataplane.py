@@ -1,0 +1,562 @@
+"""Data-plane tests: the content-addressed blob store's write/verify
+contract (round trip, dedup, torn writes, corruption detection,
+refcounted GC), the persistent candidate index vs the legacy outdir
+parse, HTTP blob transfer + gateway bearer-token authn against live
+servers, cross-host fetch through the federation router, the
+stagein.fetch containment proof, and the spool-less end-to-end storm
+(real worker processes pulling their beams from the CAS by digest —
+no shared beam directory)."""
+
+import io
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpulsar.dataplane import blobstore
+from tpulsar.dataplane import index as dp_index
+from tpulsar.dataplane import transfer
+from tpulsar.frontdoor import client, federation
+from tpulsar.frontdoor import queue as fq
+from tpulsar.frontdoor import results
+from tpulsar.frontdoor.gateway import GatewayServer
+from tpulsar.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    faults.reset()
+    for var in ("TPULSAR_BLOB_ROOT", "TPULSAR_DATA_URL",
+                "TPULSAR_GATEWAY_TOKEN"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+    faults.reset()
+
+
+def _write_candlist(outdir, sigmas=(12.0, 6.5, 4.2),
+                    name="beam.accelcands"):
+    from tpulsar.io import accelcands
+    from tpulsar.search.sifting import Candidate
+    os.makedirs(outdir, exist_ok=True)
+    cands = [Candidate(r=100.0 + i, z=0.0, sigma=s, power=40.0,
+                       numharm=8, dm=20.0 + i, period_s=0.05,
+                       freq_hz=20.0, dm_hits=[(20.0 + i, s)])
+             for i, s in enumerate(sigmas)]
+    accelcands.write_candlist(cands, os.path.join(outdir, name))
+
+
+# --------------------------------------------------------------------
+# blob store: the CAS write/verify contract
+# --------------------------------------------------------------------
+
+def test_put_get_roundtrip_and_dedup(tmp_path):
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    data = b"pulsar beam payload " * 100
+    digest = store.put_bytes(data)
+    assert len(digest) == 64 and store.has(digest)
+    assert store.read_bytes(digest) == data
+    assert store.size(digest) == len(data)
+    # a re-put of identical bytes is a no-op at the same address
+    assert store.put_bytes(data) == digest
+    assert store.stats()["blobs"] == 1
+
+
+def test_put_file_and_fetch_to_are_verified(tmp_path):
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    src = tmp_path / "beam.dat"
+    src.write_bytes(b"\x00\x01" * 4096)
+    digest = store.put_file(str(src))
+    dest = tmp_path / "out" / "beam.dat"
+    os.makedirs(dest.parent)
+    n = store.fetch_to(digest, str(dest))
+    assert n == 8192 and dest.read_bytes() == src.read_bytes()
+
+
+def test_claimed_digest_mismatch_stores_nothing(tmp_path):
+    """A torn/lying transfer: the body hashes to something other
+    than its claimed address — nothing may land in the store."""
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    lie = "0" * 64
+    with pytest.raises(blobstore.BlobVerifyError):
+        store.put_stream(io.BytesIO(b"not those bytes"),
+                         expect_digest=lie)
+    assert not store.has(lie)
+    assert store.stats()["blobs"] == 0
+    # and no ingest temp survives the failed put
+    leftovers = [f for f in os.listdir(store.objects)
+                 if f.startswith(".")]
+    assert leftovers == []
+
+
+def test_verify_and_read_detect_corruption(tmp_path):
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    digest = store.put_bytes(b"good bytes")
+    assert store.verify(digest)
+    # bit-rot the stored object behind the store's back
+    path = store.object_path(digest)
+    with open(path, "r+b") as fh:
+        fh.write(b"BAD")
+    assert not store.verify(digest)
+    with pytest.raises(blobstore.BlobVerifyError):
+        store.read_bytes(digest)
+    dest = str(tmp_path / "fetched")
+    with pytest.raises(blobstore.BlobVerifyError):
+        store.fetch_to(digest, dest)
+    # the verified fetch must not leave a corrupt dest behind
+    assert not os.path.exists(dest)
+    assert not store.verify("f" * 64)      # absent = not durable
+
+
+def test_gc_respects_refs_and_ttl(tmp_path):
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    pinned = store.put_bytes(b"pinned artifact")
+    loose = store.put_bytes(b"loose artifact")
+    store.add_ref(pinned, "ticket-1")
+    assert store.refcount(pinned) == 1
+    rep = store.gc(ttl_s=0.0, now=time.time() + 10)
+    assert rep["collected"] == 1 and rep["kept"] == 1
+    assert store.has(pinned) and not store.has(loose)
+    # dropping the last ref makes it collectable
+    store.drop_ref(pinned, "ticket-1")
+    rep = store.gc(ttl_s=0.0, now=time.time() + 10)
+    assert rep["collected"] == 1 and not store.has(pinned)
+    # young unreferenced blobs survive a TTL'd sweep
+    store.put_bytes(b"fresh")
+    assert store.gc(ttl_s=3600.0)["collected"] == 0
+
+
+def test_gc_collects_orphaned_ingest_tmp(tmp_path):
+    """A crash mid-put leaves .ingest.* at the objects/ top level;
+    gc must age it out without tripping over the non-directory."""
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    store.put_bytes(b"a real blob")
+    orphan = os.path.join(store.objects, ".ingest.orphan")
+    with open(orphan, "wb") as fh:
+        fh.write(b"torn")
+    rep = store.gc(ttl_s=0.0, now=time.time() + 10)
+    assert not os.path.exists(orphan)
+    assert rep["kept"] == 0 and rep["collected"] == 1  # the blob
+
+
+def test_blobstore_io_fault_point_fires(tmp_path):
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    faults.configure("dataplane.io:unimplemented:count=1,errno=EIO")
+    with pytest.raises(OSError):
+        store.put_bytes(b"doomed")
+    # the window closed after one trigger: the retry lands
+    assert store.has(store.put_bytes(b"doomed"))
+
+
+# --------------------------------------------------------------------
+# candidate index: the parse is the source of truth
+# --------------------------------------------------------------------
+
+def test_index_rows_match_legacy_parse_exactly(tmp_path):
+    outdir = str(tmp_path / "out")
+    _write_candlist(outdir)
+    idx = dp_index.CandidateIndex(str(tmp_path / "candidates.db"))
+    try:
+        n = idx.index_outdir("t1", outdir, {"beam.accelcands": "a" * 64})
+        assert n == 3
+        assert idx.candidate_rows("t1") == \
+            results._candidate_rows(outdir)
+        row = idx.result_row("t1")
+        assert row["artifacts"] == {"beam.accelcands": "a" * 64}
+        assert row["outdir"] == outdir
+    finally:
+        idx.close()
+
+
+def test_index_reindex_is_idempotent(tmp_path):
+    outdir = str(tmp_path / "out")
+    _write_candlist(outdir)
+    idx = dp_index.CandidateIndex(str(tmp_path / "candidates.db"))
+    try:
+        idx.index_outdir("t1", outdir)
+        idx.index_outdir("t1", outdir)     # a chaos-retried beam
+        assert idx.tickets() == ["t1"]
+        assert len(idx.candidate_rows("t1")) == 3
+    finally:
+        idx.close()
+
+
+def test_index_query_shape_and_limit_refusal(tmp_path):
+    outdir = str(tmp_path / "out")
+    _write_candlist(outdir, sigmas=(12.0, 9.0, 4.0))
+    idx = dp_index.CandidateIndex(str(tmp_path / "candidates.db"))
+    try:
+        idx.index_outdir("t1", outdir)
+        rec = idx.query(min_sigma=5.0, limit=1)
+        assert rec["source"] == "index"
+        assert rec["total"] == 2 and rec["returned"] == 1
+        assert rec["truncated"] is True
+        assert rec["candidates"][0]["sigma"] == 12.0
+        full = idx.query()
+        assert full["truncated"] is False and full["total"] == 3
+        with pytest.raises(ValueError):
+            idx.query(limit=0)
+        with pytest.raises(ValueError):
+            idx.query(limit=-5)
+    finally:
+        idx.close()
+
+
+def test_index_rebuild_from_queue_outdirs(tmp_path):
+    q = fq.get_ticket_queue(str(tmp_path / "spool"))
+    for i in range(3):
+        tid = f"t{i}"
+        outdir = str(tmp_path / f"out{i}")
+        _write_candlist(outdir)
+        q.submit(tid, ["beam.dat"], outdir)
+        q.claim_next("w0")
+        q.write_result(tid, "done", rc=0, outdir=outdir, worker="w0")
+    idx = dp_index.CandidateIndex(str(tmp_path / "candidates.db"))
+    try:
+        rep = idx.rebuild(q)
+        assert rep == {"tickets": 3, "rows": 9}
+        for i in range(3):
+            assert idx.candidate_rows(f"t{i}") == \
+                results._candidate_rows(str(tmp_path / f"out{i}"))
+    finally:
+        idx.close()
+
+
+def test_index_fsck_reports_counts(tmp_path):
+    outdir = str(tmp_path / "out")
+    _write_candlist(outdir)
+    idx = dp_index.CandidateIndex(str(tmp_path / "candidates.db"))
+    try:
+        idx.index_outdir("t1", outdir)
+        rep = idx.fsck()
+        assert rep == {"ok": True, "results": 1, "candidates": 3}
+    finally:
+        idx.close()
+
+
+# --------------------------------------------------------------------
+# HTTP transfer + gateway blob routes + bearer-token authn
+# --------------------------------------------------------------------
+
+@pytest.fixture()
+def blob_gw(tmp_path):
+    q = fq.get_ticket_queue(str(tmp_path / "spool"))
+    server = GatewayServer(
+        queue=q, outdir_base=str(tmp_path / "results"),
+        blob_root=str(tmp_path / "cas")).start()
+    yield server
+    server.stop()
+
+
+def test_http_blob_roundtrip_digest_verified(blob_gw, tmp_path):
+    data = b"over-the-wire beam " * 64
+    digest = transfer.put_bytes(blob_gw.url, data)
+    assert transfer.get_bytes(blob_gw.url, digest) == data
+    dest = str(tmp_path / "fetched.dat")
+    assert transfer.get_to_file(blob_gw.url, digest, dest) == len(data)
+    with open(dest, "rb") as fh:
+        assert fh.read() == data
+
+
+def test_http_blob_put_rejects_lying_address(blob_gw, tmp_path):
+    src = tmp_path / "b.dat"
+    src.write_bytes(b"honest bytes")
+    with pytest.raises(transfer.TransferError) as ei:
+        transfer.put_file(blob_gw.url, str(src), digest="0" * 64)
+    assert ei.value.code == 409
+    # nothing was stored at the lying address
+    with pytest.raises(transfer.TransferError) as ei:
+        transfer.get_bytes(blob_gw.url, "0" * 64)
+    assert ei.value.code == 404
+
+
+def test_http_blob_bad_digest_is_400(blob_gw):
+    # the client refuses to even build the URL...
+    with pytest.raises(ValueError):
+        transfer.get_bytes(blob_gw.url, "not-a-digest")
+    # ...and a hand-built request gets the server's 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            blob_gw.url + "/v1/blobs/not-a-digest", timeout=10)
+    assert ei.value.code == 400
+
+
+def test_gateway_token_gates_mutating_routes(tmp_path, monkeypatch):
+    q = fq.get_ticket_queue(str(tmp_path / "spool"))
+    gw = GatewayServer(
+        queue=q, outdir_base=str(tmp_path / "results"),
+        blob_root=str(tmp_path / "cas"), token="s3cret").start()
+    try:
+        # blob PUT without the token: 401 before any store write
+        with pytest.raises(transfer.TransferError) as ei:
+            transfer.put_bytes(gw.url, b"payload", token="")
+        assert ei.value.code == 401
+        # submit without the token: 401 too (mutating route)
+        with pytest.raises(client.ClientError) as ci:
+            client.submit_beam(gw.url, ["/data/a.fits"])
+        assert ci.value.code == 401
+        # the 401 advertises the scheme
+        req = urllib.request.Request(
+            transfer.blob_url(gw.url, "a" * 64),
+            data=b"x", method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as hi:
+            urllib.request.urlopen(req, timeout=10)
+        assert hi.value.code == 401
+        assert hi.value.headers.get("WWW-Authenticate") == "Bearer"
+        # with the token, the same calls land
+        digest = transfer.put_bytes(gw.url, b"payload",
+                                    token="s3cret")
+        monkeypatch.setenv("TPULSAR_GATEWAY_TOKEN", "s3cret")
+        # a fresh worker heartbeat so admission has capacity
+        q.heartbeat("w0", status="running", max_queue_depth=8)
+        rec = client.submit_beam(gw.url, ["/data/a.fits"])
+        assert rec["ticket"]
+        # reads stay open: status and blob GET need no token
+        monkeypatch.delenv("TPULSAR_GATEWAY_TOKEN")
+        assert transfer.get_bytes(gw.url, digest) == b"payload"
+        with urllib.request.urlopen(
+                gw.url + f"/v1/tickets/{rec['ticket']}",
+                timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        gw.stop()
+
+
+def test_candidates_answered_from_index_with_parse_fallback(
+        blob_gw, tmp_path):
+    q = blob_gw.queue
+    outdir = str(tmp_path / "out")
+    _write_candlist(outdir, sigmas=(11.0, 7.0))
+    q.submit("t1", ["beam.dat"], outdir)
+    q.claim_next("w0")
+    q.write_result("t1", "done", rc=0, outdir=outdir, worker="w0")
+    # no candidates.db yet: the parse answers
+    with urllib.request.urlopen(blob_gw.url + "/v1/candidates",
+                                timeout=10) as resp:
+        rec = json.load(resp)
+    assert rec["source"] == "parse" and rec["total"] == 2
+    # a worker writes the index: the same route now answers from it
+    idx = dp_index.CandidateIndex(
+        dp_index.index_path(q.journal_root))
+    try:
+        idx.index_outdir("t1", outdir)
+    finally:
+        idx.close()
+    with urllib.request.urlopen(blob_gw.url + "/v1/candidates",
+                                timeout=10) as resp:
+        indexed = json.load(resp)
+    assert indexed["source"] == "index"
+    assert indexed["candidates"] == rec["candidates"]
+    # ?source=parse forces the legacy path
+    with urllib.request.urlopen(
+            blob_gw.url + "/v1/candidates?source=parse",
+            timeout=10) as resp:
+        assert json.load(resp)["source"] == "parse"
+    # a non-positive limit is a 400 refusal, never a silent clamp
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            blob_gw.url + "/v1/candidates?limit=0", timeout=10)
+    assert ei.value.code == 400
+
+
+def test_results_query_truncation_is_explicit(tmp_path):
+    q = fq.get_ticket_queue(str(tmp_path / "spool"))
+    outdir = str(tmp_path / "out")
+    _write_candlist(outdir, sigmas=(12.0, 9.0, 6.0))
+    q.submit("t1", ["beam.dat"], outdir)
+    q.claim_next("w0")
+    q.write_result("t1", "done", rc=0, outdir=outdir, worker="w0")
+    rec = results.query_candidates(q, limit=2)
+    assert rec["total"] == 3 and rec["returned"] == 2
+    assert rec["truncated"] is True
+    with pytest.raises(ValueError):
+        results.query_candidates(q, limit=0)
+
+
+# --------------------------------------------------------------------
+# cross-host fetch: the router finds the member holding the bytes
+# --------------------------------------------------------------------
+
+def _pin_capacities(router, *caps):
+    """Freeze the members' advertised capacities so open_blob never
+    polls a (nonexistent) /v1/capacity endpoint."""
+    for m, cap in zip(router.members, caps):
+        m.capacity = cap
+        m.polled_at = time.time() + 3600
+
+
+def _http_404(url):
+    return urllib.error.HTTPError(url, 404, "no such blob", {},
+                                  io.BytesIO(b""))
+
+
+def test_router_open_blob_falls_through_to_the_holder():
+    digest = "b" * 64
+    calls = []
+
+    def fetch_raw(url, timeout):
+        calls.append(url)
+        if "h1" in url:
+            raise _http_404(url)
+        return io.BytesIO(b"the actual bytes")
+
+    router = federation.FederationRouter(
+        "empty=http://h1:1,holder=http://h2:1", fetch_raw=fetch_raw)
+    # the empty member looks bigger, so it gets asked (and 404s) first
+    _pin_capacities(router, 8, 4)
+    name, resp = router.open_blob(digest)
+    assert name == "holder" and resp.read() == b"the actual bytes"
+    assert len(calls) == 2 and digest in calls[0]
+
+
+def test_router_open_blob_raises_when_nobody_has_it():
+    def fetch_raw(url, timeout):
+        raise _http_404(url)
+
+    router = federation.FederationRouter(
+        "a=http://h1:1,b=http://h2:1", fetch_raw=fetch_raw)
+    _pin_capacities(router, 1, 1)
+    with pytest.raises(federation.BlobNotFound):
+        router.open_blob("c" * 64)
+
+
+# --------------------------------------------------------------------
+# by-digest stage-in + the stagein.fetch containment proof
+# --------------------------------------------------------------------
+
+def test_stage_blobs_fetches_by_digest_from_local_cas(
+        tmp_path, monkeypatch):
+    from tpulsar.serve import stagein
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    d1 = store.put_bytes(b"beam one")
+    d2 = store.put_bytes(b"beam two")
+    monkeypatch.setenv("TPULSAR_BLOB_ROOT", str(tmp_path / "cas"))
+    workdir = str(tmp_path / "work")
+    os.makedirs(workdir)
+    staged = stagein._stage_blobs(
+        {"ticket": "t1", "blobs": {"b.dat": d2, "a.dat": d1}},
+        workdir)
+    assert [os.path.basename(p) for p in staged] == ["a.dat", "b.dat"]
+    with open(staged[0], "rb") as fh:
+        assert fh.read() == b"beam one"
+
+
+def test_stage_blobs_over_http(blob_gw, tmp_path, monkeypatch):
+    from tpulsar.serve import stagein
+    digest = transfer.put_bytes(blob_gw.url, b"remote beam")
+    workdir = str(tmp_path / "work")
+    os.makedirs(workdir)
+    staged = stagein._stage_blobs(
+        {"ticket": "t1", "data_url": blob_gw.url,
+         "blobs": {"beam.dat": digest}}, workdir)
+    with open(staged[0], "rb") as fh:
+        assert fh.read() == b"remote beam"
+
+
+def test_stagein_fetch_fault_is_contained_per_ticket(
+        tmp_path, monkeypatch):
+    """The containment proof: an injected stagein.fetch failure must
+    surface as THIS beam's PreparedBeam.error (the per-ticket failed
+    path), never escape the stage-in pipeline."""
+    from tpulsar.serve import stagein
+    store = blobstore.BlobStore(str(tmp_path / "cas"))
+    digest = store.put_bytes(b"beam")
+    monkeypatch.setenv("TPULSAR_BLOB_ROOT", str(tmp_path / "cas"))
+    faults.configure("stagein.fetch:unimplemented:count=1,errno=EIO")
+    ticket = {"ticket": "t1", "datafiles": ["beam.dat"],
+              "blobs": {"beam.dat": digest}}
+    prep = stagein.prepare_beam(ticket, str(tmp_path / "work"))
+    assert prep.error and "stagein.fetch" in prep.error
+    # the window closed: the staged fetch itself now succeeds
+    staged = stagein._stage_blobs(ticket, str(tmp_path / "work2"))
+    assert os.path.exists(staged[0])
+
+
+def test_ticket_with_no_blob_source_fails_contained(tmp_path):
+    from tpulsar.serve import stagein
+    prep = stagein.prepare_beam(
+        {"ticket": "t1", "datafiles": ["beam.dat"],
+         "blobs": {"beam.dat": "a" * 64}}, str(tmp_path / "work"))
+    assert prep.error
+
+
+# --------------------------------------------------------------------
+# spool-less end-to-end: real workers, beams that exist only as blobs
+# --------------------------------------------------------------------
+
+def test_spoolless_storm_stages_by_digest_and_indexes(tmp_path):
+    """The tentpole e2e: 2 real chaos-worker processes pull their
+    beams from the gateway CAS by digest (the payloads exist ONLY as
+    blobs — no shared beam directory), one worker is SIGKILLed
+    mid-storm, and afterwards every done beam's artifacts re-hash
+    clean in the CAS and its index rows equal a fresh outdir parse."""
+    from tpulsar.chaos import invariants, runner, scenario
+    spool = str(tmp_path / "spool")
+    sc = scenario.from_dict({
+        "name": "dp-mini", "seed": 7, "duration_s": 60.0,
+        "workers": 2, "worker_kind": "stub", "beam_s": 0.15,
+        "poll_s": 0.2, "gateway": True, "dataplane": True,
+        "queue_url": "sqlite",
+        "workload": {"beams": 5, "interval_s": 0.05,
+                     "via": "gateway"},
+        "timeline": [
+            {"t": 0.6, "action": "kill_worker", "worker": "w0",
+             "signal": "KILL"},
+        ],
+        "quiesce_timeout_s": 40.0})
+    manifest = runner.run_scenario(sc, spool)
+    assert manifest["quiesced"], manifest
+    assert manifest["dataplane"] is True
+    assert len(manifest["tickets"]) == 5
+
+    q = fq.get_ticket_queue(f"sqlite:{os.path.join(spool, 'queue.db')}")
+    store = blobstore.BlobStore(blobstore.default_blob_root(spool))
+    idx = dp_index.CandidateIndex(dp_index.index_path(spool))
+    try:
+        done = 0
+        for tid in manifest["tickets"]:
+            rec = q.read_result(tid)
+            assert rec is not None and rec["status"] == "done", \
+                (tid, rec)
+            done += 1
+            artifacts = rec.get("artifacts") or {}
+            assert artifacts, rec
+            for digest in artifacts.values():
+                assert store.verify(digest)
+            # the index rows equal a fresh parse of the outdir
+            assert idx.candidate_rows(tid) == \
+                results._candidate_rows(rec["outdir"])
+        assert done == 5
+    finally:
+        idx.close()
+    report = invariants.verify(
+        f"sqlite:{os.path.join(spool, 'queue.db')}",
+        max_attempts=sc.max_attempts)
+    assert report["ok"], report["violations"]
+
+
+def test_packaged_dataplane_scenario_loads():
+    from tpulsar.chaos import scenario
+    sc = scenario.load("dataplane_smoke")
+    assert sc.dataplane and sc.gateway
+    assert sc.worker_kind == "stub"
+    assert any("stagein.fetch" in (a.faults or "")
+               for a in sc.timeline)
+
+
+def test_scenario_dataplane_validation():
+    from tpulsar.chaos import scenario
+    with pytest.raises(ValueError, match="gateway"):
+        scenario.from_dict({
+            "name": "t", "workers": 1, "dataplane": True,
+            "worker_kind": "stub",
+            "workload": {"beams": 1, "interval_s": 0.01},
+            "timeline": []})
+    with pytest.raises(ValueError, match="stub"):
+        scenario.from_dict({
+            "name": "t", "workers": 1, "dataplane": True,
+            "gateway": True, "worker_kind": "serve",
+            "workload": {"beams": 1, "interval_s": 0.01},
+            "timeline": []})

@@ -393,12 +393,20 @@ def test_router_mirrors_member_refusal_class(tmp_path):
         member.stop()
 
 
-def test_candidate_query_clamps_negative_limit(gw, q, worker):
+def test_candidate_query_refuses_nonpositive_limit(gw, q, worker):
+    # a non-positive limit is a caller bug, not a request for an
+    # empty page — refused loudly (400) instead of clamped to zero,
+    # which silently read as "no candidates"
     rec = client.submit_beam(gw.url, ["/data/a.fits"])
     client.wait_for_result(gw.url, rec["ticket"], timeout_s=30)
-    out = client.query_candidates(gw.url, limit=-5)
-    assert out["returned"] == 0 and out["candidates"] == []
-    assert out["total"] == 3
+    for bad in (-5, 0):
+        with pytest.raises(client.ClientError) as ei:
+            client.query_candidates(gw.url, limit=bad)
+        assert ei.value.code == 400
+        assert "limit" in ei.value.payload["error"]
+    out = client.query_candidates(gw.url, limit=1)
+    assert out["returned"] == 1 and out["total"] == 3
+    assert out["truncated"] is True
 
 
 def test_router_mode_all_members_shedding_is_503(tmp_path):

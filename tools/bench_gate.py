@@ -87,6 +87,8 @@ DEFAULT_KEYS = (
     ("queue.sqlite.tickets_per_s", "higher"),
     ("doctor.tick_overhead_s", "lower"),
     ("doctor.detection_latency_s", "lower"),
+    ("dataplane.stagein_mb_per_s", "higher"),
+    ("dataplane.candidates_query_ms", "lower"),
 )
 
 

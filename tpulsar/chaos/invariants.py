@@ -154,6 +154,13 @@ INVARIANTS = {
     "alert_no_false":
         "every fired health alert is explained by an injected fault "
         "class; a clean run fires none",
+    "blob_durable":
+        "every artifact digest a done result names re-hashes clean "
+        "in the CAS (verify-after-write held end to end)",
+    "index_consistent":
+        "every indexed ticket's candidate rows are byte-identical "
+        "to a fresh parse of its outdir, and every done beam with "
+        "candidates is indexed",
 }
 
 #: events that RELEASE a claim (close an inflight interval) — drawn
@@ -639,6 +646,82 @@ def _alert_sweep(events: list[dict], root: str) -> list[dict]:
     return out
 
 
+def _dataplane_sweep(root: str,
+                     done_recs: dict[str, dict]) -> list[dict]:
+    """The data plane's two contracts, judged from disk.
+
+    blob_durable: every artifact digest a done result record names
+    must exist in the CAS at the journal root and RE-HASH to its
+    address (``BlobStore.verify`` — the verify-after-write promise,
+    audited after the storm instead of trusted).
+
+    index_consistent: the candidate index is a cache of the sifted
+    truth — each indexed ticket's rows must equal a fresh legacy
+    parse of its outdir, and every done ticket that produced
+    .accelcands must be present in the index (a worker that wrote a
+    result without its index rows broke the same-durable-step
+    contract).  Both judgments arm themselves only when the run left
+    a CAS / index behind — a plain storm proves nothing here."""
+    import glob as globmod
+
+    out: list[dict] = []
+    from tpulsar.dataplane import blobstore
+    blob_root = blobstore.default_blob_root(root)
+    if blob_root and os.path.isdir(blob_root):
+        store = blobstore.BlobStore(blob_root)
+        for tid, rec in sorted(done_recs.items()):
+            for name, digest in sorted(
+                    (rec.get("artifacts") or {}).items()):
+                try:
+                    ok = store.verify(str(digest))
+                except (ValueError, OSError) as e:
+                    ok = False
+                    name = f"{name} ({e})"
+                if not ok:
+                    out.append(_v(
+                        "blob_durable", tid,
+                        f"artifact {name} {str(digest)[:12]}.. "
+                        f"absent or corrupt in {blob_root}"))
+
+    from tpulsar.dataplane import index as dp_index
+    ipath = dp_index.index_path(root)
+    if not os.path.exists(ipath):
+        return out
+    from tpulsar.frontdoor import results
+    idx = dp_index.CandidateIndex(ipath)
+    try:
+        indexed = set(idx.tickets())
+        for tid in sorted(indexed):
+            row = idx.result_row(tid) or {}
+            outdir = row.get("outdir", "")
+            if not outdir or not os.path.isdir(outdir):
+                continue        # results moved/cleaned: nothing to
+            want = results._candidate_rows(outdir)   # compare against
+            got = idx.candidate_rows(tid)
+            if got != want:
+                out.append(_v(
+                    "index_consistent", tid,
+                    f"index rows ({len(got)}) differ from the "
+                    f"outdir parse ({len(want)})"))
+        for tid, rec in sorted(done_recs.items()):
+            if rec.get("status") != "done" or tid in indexed:
+                continue
+            outdir = rec.get("outdir", "")
+            if outdir and globmod.glob(
+                    os.path.join(outdir, "*.accelcands")):
+                out.append(_v(
+                    "index_consistent", tid,
+                    "done ticket with .accelcands artifacts has no "
+                    "index entry (result written without its index "
+                    "rows)"))
+    except (OSError, dp_index.IndexCorrupt) as e:
+        out.append(_v("index_consistent", "",
+                      f"index unreadable: {e}"))
+    finally:
+        idx.close()
+    return out
+
+
 def _sidefile_sweep(q) -> list[dict]:
     # the backend's own accounting of transaction transients: the
     # spool reports surviving .tmp/.claiming/.takeover side-files,
@@ -750,6 +833,7 @@ def verify(spool: str, *, tenants: dict | None = None,
         violations.extend(_sidefile_sweep(q))
         violations.extend(_checkpoint_litter_sweep(per_ticket))
     violations.extend(_capacity_check(root))
+    violations.extend(_dataplane_sweep(root, done_recs))
 
     by_inv = {name: 0 for name in INVARIANTS}
     for v in violations:

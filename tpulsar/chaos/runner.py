@@ -38,6 +38,18 @@ _SIGNALS = {"KILL": signal.SIGKILL, "TERM": signal.SIGTERM,
             "STOP": signal.SIGSTOP, "CONT": signal.SIGCONT}
 
 
+def beam_payload(name: str, seed: int, i: int,
+                 size: int = 16384) -> bytes:
+    """One dataplane beam's synthetic input bytes — a pure function
+    of (scenario, seed, beam index), so a requeued/retried beam
+    fetches byte-identical inputs and the run is reproducible from
+    the scenario file alone."""
+    import hashlib
+    block = hashlib.sha256(f"{name}:{seed}:beam{i}".encode()).digest()
+    reps = size // len(block) + 1
+    return (block * reps)[:size]
+
+
 class ChaosRunner:
     def __init__(self, sc: scenario_mod.Scenario, spool: str, *,
                  queue_url: str = "",
@@ -102,6 +114,13 @@ class ChaosRunner:
         if self.sc.tenants:
             env["TPULSAR_CHAOS_TENANTS"] = _json.dumps(
                 self.sc.tenants)
+        if self.sc.dataplane and self.gateway is not None:
+            # spool-less stage-in: workers fetch blobs: refs and push
+            # artifacts over HTTP — the gateway was started BEFORE
+            # the fleet precisely so its URL exists to hand out here
+            # (restart_gateway rebinds the same port, so the URL
+            # survives the storm's gateway kills)
+            env["TPULSAR_DATA_URL"] = self.gateway.url
         return env
 
     def _start_fleet(self):
@@ -237,6 +256,24 @@ class ChaosRunner:
         datafiles = list(wl.datafiles or ["chaos://synthetic"])
         outdir = os.path.join(scenario_mod.chaos_dir(self.spool),
                               "out", f"beam{i:03d}")
+        blobs: dict[str, str] = {}
+        if self.sc.dataplane:
+            # by-digest inputs: the beam's synthetic bytes go into
+            # the gateway CAS FIRST (through the real PUT route), and
+            # the ticket carries only {filename: sha256} refs — no
+            # shared path ever reaches the worker
+            from tpulsar.dataplane import transfer
+            payload = beam_payload(self.sc.name, self.sc.seed, i)
+            try:
+                digest = transfer.put_bytes(self.gateway.url, payload)
+            except Exception as e:      # noqa: BLE001 — a refused
+                # upload refuses the SUBMISSION (the ticket would be
+                # unservable), journaled like any refused submit
+                self._journal_action(t_rel, "submit_refused",
+                                     detail=f"blob put: "
+                                            f"{str(e)[:100]}", beam=i)
+                return
+            blobs = {f"beam{i:03d}.dat": digest}
         if wl.via == "gateway":
             from tpulsar.frontdoor import client
             # the gateway may be mid-restart at this instant — that
@@ -248,7 +285,8 @@ class ChaosRunner:
                     rec = client.submit_beam(
                         self.gateway.url, datafiles, outdir=outdir,
                         tenant=wl.tenant, priority=wl.priority,
-                        job_id=i, retries=2)
+                        job_id=i, retries=2,
+                        blobs=blobs or None)
                     self.tickets.append(rec["ticket"])
                     return
                 except client.ClientError as e:
@@ -265,6 +303,8 @@ class ChaosRunner:
             return
         tid = f"{self.sc.name}-{i:03d}"
         extra = {"beam_s": self.sc.beam_s}
+        if blobs:
+            extra["blobs"] = blobs
         if wl.passes:
             extra["passes"] = wl.passes
             extra["pass_s"] = wl.pass_s
@@ -292,6 +332,11 @@ class ChaosRunner:
         # placeholder (no entries): workers must FIND the schedule at
         # boot, but no window may open until the workload anchor
         scenario_mod.write_schedule(self.spool, sc, t0, arm=False)
+        if sc.gateway and sc.dataplane:
+            # dataplane runs start the gateway BEFORE the fleet: the
+            # workers' TPULSAR_DATA_URL is baked into their spawn env,
+            # so the CAS endpoint must exist first
+            self._start_gateway()
         self._start_fleet()
         status = "aborted"
         quiesced = False
@@ -301,7 +346,7 @@ class ChaosRunner:
                     f"fleet never became fresh ({sc.workers} "
                     f"worker(s)) — check "
                     f"{self.spool}/workers/*.log")
-            if sc.gateway:
+            if sc.gateway and self.gateway is None:
                 self._start_gateway()
             # the schedule's t0 is re-anchored to the WORKLOAD start:
             # scenario times mean "seconds into the storm", and fleet
@@ -376,6 +421,7 @@ class ChaosRunner:
             "queue_url": self.queue_url,
             "gateway": bool(sc.gateway),
             "gateway_port": self._gateway_port,
+            "dataplane": bool(sc.dataplane),
             "t0": t0, "wall_s": round(time.time() - t0, 3),
             "status": status, "quiesced": quiesced,
             "actions": self.actions, "tickets": self.tickets,

@@ -142,6 +142,15 @@ class Scenario:
     #: The conductor, every worker, and the verifier all resolve the
     #: same backend from this one field
     queue_url: str = ""
+    #: true = SPOOL-LESS data plane: the conductor uploads each beam's
+    #: synthetic input bytes into the gateway CAS and submits tickets
+    #: carrying ``blobs:`` {filename: sha256} refs instead of shared
+    #: paths; workers stage in BY DIGEST over HTTP (TPULSAR_DATA_URL),
+    #: write real .accelcands artifacts, push them back into the CAS,
+    #: and index candidates — arming the blob_durable and
+    #: index_consistent invariants.  Requires gateway: true (the CAS
+    #: is mounted on the gateway's blob routes)
+    dataplane: bool = False
     tenants: dict = dataclasses.field(default_factory=dict)
     #: non-empty = run the fleet ELASTIC: the dict is an
     #: autoscale.AutoscaleConfig (validated at load, same loud
@@ -261,6 +270,13 @@ def from_dict(doc: dict) -> Scenario:
                          "process storm (no cross-process state)")
     if sc.gateway is False and wl.via == "gateway":
         raise ValueError("workload.via=gateway needs gateway: true")
+    if sc.dataplane and not sc.gateway:
+        raise ValueError("dataplane: true needs gateway: true (the "
+                         "CAS rides the gateway's blob routes)")
+    if sc.dataplane and sc.worker_kind != "stub":
+        raise ValueError("dataplane: true needs worker_kind=stub "
+                         "(the stub worker implements the synthetic "
+                         "by-digest beam)")
     if sc.worker_kind == "serve" and wl.datafiles is None:
         raise ValueError("worker_kind=serve needs workload.datafiles "
                          "(real beams for real workers)")

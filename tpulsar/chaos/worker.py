@@ -143,6 +143,98 @@ def _run_pass_beam(spool: str, wid: str, rec: dict, args,
             "candidates_digest": h.hexdigest()}
 
 
+def synth_candidates(ticket: str, n: int = 3):
+    """Deterministic sifted candidates for a dataplane beam — a pure
+    function of the ticket id, so a retried beam writes a byte-
+    identical .accelcands and the index delete+reinsert is a no-op."""
+    from tpulsar.search.sifting import Candidate
+    h = hashlib.sha256(ticket.encode()).digest()
+    out = []
+    for k in range(n):
+        b = h[4 * k:4 * k + 4]
+        freq = 1.0 + b[0] / 8.0
+        out.append(Candidate(
+            r=round(100.0 + b[1], 2), z=round(b[2] / 16.0, 2),
+            sigma=round(6.0 + b[3] / 32.0, 2),
+            power=round(20.0 + b[0] / 4.0, 4),
+            numharm=1 + k, dm=round(10.0 * (k + 1), 2),
+            period_s=1.0 / freq, freq_hz=freq,
+            dm_hits=[(round(10.0 * (k + 1), 2),
+                      round(6.0 + b[3] / 32.0, 2))]))
+    return out
+
+
+def _run_dataplane_beam(jroot: str, wid: str, rec: dict, args,
+                        box: health.FlightRecorder | None = None
+                        ) -> dict:
+    """One SPOOL-LESS beam: stage in the ticket's ``blobs:`` refs by
+    digest (HTTP when TPULSAR_DATA_URL is set, else a local
+    TPULSAR_BLOB_ROOT store), 'search' (sleep beam_s), write a real
+    .accelcands artifact into the outdir, push it back into the CAS,
+    and index the candidates — the same publish discipline as
+    serve/server.py, at stub-worker speed.  A stage-in failure is
+    journaled ``stagein_failed`` and re-raised so the caller's
+    containment marks THIS ticket failed and keeps serving."""
+    from tpulsar.dataplane import blobstore, index as dp_index, \
+        transfer
+
+    tid = rec.get("ticket", "?")
+    att = int(rec.get("attempts", 0))
+    outdir = rec.get("outdir") or ""
+
+    def jr(event: str, **extra) -> None:
+        if box is not None:
+            box.note("journal", event=event, ticket=tid)
+        journal.record(jroot, event, ticket=tid, worker=wid,
+                       attempt=att,
+                       trace_id=rec.get("trace_id", ""), **extra)
+
+    url = os.environ.get("TPULSAR_DATA_URL", "")
+    root = "" if url else blobstore.default_blob_root("")
+    staging = os.path.join(outdir or jroot, "stagein")
+    t0 = time.time()
+    fetched = 0
+    try:
+        for fname, digest in sorted(
+                (rec.get("blobs") or {}).items()):
+            faults.fire("stagein.fetch", make_exc=faults.io_error,
+                        detail=f"{fname} {str(digest)[:12]}")
+            dest = os.path.join(staging,
+                                os.path.basename(str(fname)))
+            if url:
+                fetched += transfer.get_to_file(url, str(digest),
+                                                dest)
+            elif root:
+                blobstore.BlobStore(root).fetch_to(str(digest), dest)
+                fetched += os.path.getsize(dest)
+            else:
+                raise RuntimeError(
+                    "blobs: ticket with no data plane configured")
+    except Exception as e:          # noqa: BLE001 — contained
+        jr("stagein_failed", error=str(e)[:200])
+        raise
+    jr("stagein_done", seconds=round(time.time() - t0, 3))
+    time.sleep(float(rec.get("beam_s", args.beam_s)))
+    # lazy import: accelcands needs numpy, which only dataplane
+    # storms require of the stub worker
+    from tpulsar.io import accelcands
+    os.makedirs(outdir, exist_ok=True)
+    apath = os.path.join(outdir, f"{tid}.accelcands")
+    accelcands.write_candlist(synth_candidates(tid), apath)
+    if url:
+        digest = transfer.put_file(url, apath)
+    else:
+        store = blobstore.BlobStore(root)
+        digest = store.put_file(apath)
+        store.add_ref(digest, tid)
+    artifacts = {os.path.basename(apath): digest}
+    dp_index.CandidateIndex(
+        dp_index.index_path(jroot)).index_outdir(tid, outdir,
+                                                 artifacts)
+    jr("artifact_push", blobs=len(artifacts))
+    return {"artifacts": artifacts, "blob_bytes": fetched}
+
+
 def _policy():
     import json as _json
     raw = os.environ.get("TPULSAR_CHAOS_TENANTS", "")
@@ -291,7 +383,10 @@ def main(argv=None) -> int:
         npasses = int(rec.get("passes", 0) or 0)
         try:
             faults.fire("serve.beam", detail=f"ticket {tid}")
-            if npasses > 0:
+            if rec.get("blobs"):
+                extras = _run_dataplane_beam(jroot, wid, rec, args,
+                                             box=box)
+            elif npasses > 0:
                 extras = _run_pass_beam(jroot, wid, rec, args,
                                         npasses, box=box)
             else:

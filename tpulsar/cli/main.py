@@ -415,7 +415,8 @@ def cmd_gateway(args):
     federate = args.federate or fd.federate
     if federate:
         gw = GatewayServer(router=FederationRouter(federate),
-                           policy=policy, host=host, port=port)
+                           policy=policy, host=host, port=port,
+                           token=args.token)
         role = f"router over {federate}"
     else:
         queue = get_ticket_queue(args.queue or _default_queue_url()
@@ -425,7 +426,8 @@ def cmd_gateway(args):
             outdir_base=args.outdir_base or os.path.join(
                 cfg.processing.base_results_directory, "gateway"),
             default_depth=cfg.jobpooler.serve_queue_depth,
-            query_limit=fd.results_query_limit)
+            query_limit=fd.results_query_limit,
+            blob_root=args.blob_root, token=args.token)
         role = f"front of {queue!r}"
     gw.start()
     print(f"gateway: {gw.url} ({role})", flush=True)
@@ -1035,6 +1037,131 @@ def cmd_queue(args):
     return 1 if findings else 0
 
 
+def _blob_target(args):
+    """Resolve a blob command's target: ``--url`` (a gateway's blob
+    routes, digest-verified both ends) beats ``--root`` beats the
+    TPULSAR_BLOB_ROOT / <serve spool>/blobs convention."""
+    from tpulsar.config import settings
+    from tpulsar.dataplane import blobstore
+
+    url = getattr(args, "url", "") or os.environ.get(
+        "TPULSAR_DATA_URL", "")
+    if url:
+        return url, None
+    root = getattr(args, "root", "") or \
+        blobstore.default_blob_root(_serve_spool(settings()))
+    return "", blobstore.BlobStore(root)
+
+
+def cmd_blob(args):
+    """Content-addressed artifact store (tpulsar/dataplane/):
+
+      put FILE...  — ingest files, print ``<sha256>  <path>`` per
+                     file (dedup is free: a re-put of identical
+                     bytes is a no-op that returns the same digest)
+      get DIGEST   — fetch one blob, verified against its digest
+      gc           — drop unreferenced objects older than --ttl and
+                     orphaned ingest temps
+      stats        — object/byte counts for the store
+
+    ``--url`` talks to a gateway's ``/v1/blobs/<digest>`` routes
+    (token from --token / TPULSAR_GATEWAY_TOKEN); ``--root`` (or
+    TPULSAR_BLOB_ROOT) addresses a local store directly."""
+    import json
+
+    from tpulsar.dataplane import transfer
+
+    if getattr(args, "token", ""):
+        os.environ["TPULSAR_GATEWAY_TOKEN"] = args.token
+    try:
+        url, store = _blob_target(args)
+        if args.blob_cmd == "put":
+            for path in args.files:
+                if url:
+                    digest = transfer.put_file(url, path)
+                else:
+                    digest = store.put_file(path)
+                    if getattr(args, "ref", ""):
+                        store.add_ref(digest, args.ref)
+                print(f"{digest}  {path}")
+            return 0
+        if args.blob_cmd == "get":
+            dest = args.out or args.digest[:12]
+            if url:
+                n = transfer.get_to_file(url, args.digest, dest)
+            else:
+                n = store.fetch_to(args.digest, dest)
+            print(f"{dest}  {n} B")
+            return 0
+        if args.blob_cmd == "gc":
+            if url:
+                print("blob gc is local-only: pass --root (the "
+                      "store owner collects; a client must not)",
+                      file=sys.stderr)
+                return 2
+            print(json.dumps(store.gc(ttl_s=args.ttl)))
+            return 0
+        if args.blob_cmd == "stats":
+            if url:
+                print("blob stats is local-only: pass --root",
+                      file=sys.stderr)
+                return 2
+            print(json.dumps(store.stats()))
+            return 0
+    except FileNotFoundError as e:
+        print(f"blob: {e}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError, transfer.TransferError) as e:
+        print(f"blob: {e}", file=sys.stderr)
+        return 1
+    return 2
+
+
+def cmd_index(args):
+    """Persistent candidate index (tpulsar/dataplane/index.py):
+
+      rebuild — re-derive every row from the done outdirs' parse
+                (the outdirs are the source of truth; the index is
+                a cache a crash can never make authoritative)
+      fsck    — PRAGMA integrity_check + truncating WAL checkpoint
+      query   — the indexed /v1/candidates answer from the CLI
+
+    Reads resolve like obs: ``--queue`` routes through a ticket
+    backend ('sqlite' expands to sqlite:<spool>/queue.db)."""
+    import json
+
+    from tpulsar.config import settings
+    from tpulsar.dataplane import index as dp_index
+
+    spool = args.spool or _serve_spool(settings())
+    queue, root = _obs_queue(args, spool)
+    idx = dp_index.CandidateIndex(dp_index.index_path(root))
+    try:
+        if args.index_cmd == "rebuild":
+            if queue is None:
+                from tpulsar.frontdoor.queue import get_ticket_queue
+                queue = get_ticket_queue(spool)
+            print(json.dumps(idx.rebuild(queue)))
+            return 0
+        if args.index_cmd == "fsck":
+            print(json.dumps(idx.fsck()))
+            return 0
+        if args.index_cmd == "query":
+            print(json.dumps(idx.query(
+                ticket=args.ticket or None,
+                min_sigma=args.min_sigma, limit=args.limit)))
+            return 0
+    except ValueError as e:
+        print(f"index: {e}", file=sys.stderr)
+        return 1
+    except (OSError, dp_index.IndexCorrupt) as e:
+        print(f"index: {e}", file=sys.stderr)
+        return 1
+    finally:
+        idx.close()
+    return 2
+
+
 def cmd_checkpoint(args):
     """Inspect/audit a beam's crash-resume checkpoints
     (tpulsar/checkpoint/): render the manifest — fingerprint, one row
@@ -1528,6 +1655,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="results dir root for submissions that "
                          "name no outdir (default: "
                          "<base_results_directory>/gateway)")
+    sp.add_argument("--blob-root", default=None,
+                    help="mount the content-addressed blob store at "
+                         "this directory (default: TPULSAR_BLOB_ROOT "
+                         "or <spool>/blobs; router mode proxies and "
+                         "never stores)")
+    sp.add_argument("--token", default=None,
+                    help="shared-secret bearer token required on "
+                         "mutating routes (default: "
+                         "TPULSAR_GATEWAY_TOKEN; empty = open)")
     sp.set_defaults(fn=cmd_gateway)
 
     sp = sub.add_parser(
@@ -1734,6 +1870,92 @@ def build_parser() -> argparse.ArgumentParser:
                     help="queue URL: sqlite:<path>, spool:<dir>, or "
                          "a bare spool directory path")
     qp.set_defaults(fn=cmd_queue)
+
+    sp = sub.add_parser(
+        "blob",
+        help="content-addressed artifact store: put/get blobs by "
+             "sha256 (local --root or a gateway --url, verified "
+             "both ends), gc unreferenced objects, print stats")
+    bsub = sp.add_subparsers(dest="blob_cmd", required=True)
+
+    def _blob_common(bp):
+        bp.add_argument("--root", default="",
+                        help="local store dir (default: "
+                             "TPULSAR_BLOB_ROOT or <spool>/blobs)")
+        bp.add_argument("--url", default="",
+                        help="gateway base URL — route through its "
+                             "/v1/blobs/<digest> API instead of a "
+                             "local store (default: "
+                             "TPULSAR_DATA_URL)")
+        bp.add_argument("--token", default="",
+                        help="bearer token for --url puts (default: "
+                             "TPULSAR_GATEWAY_TOKEN)")
+
+    bp = bsub.add_parser("put", help="ingest files; print "
+                                     "'<sha256>  <path>' per file")
+    bp.add_argument("files", nargs="+")
+    bp.add_argument("--ref", default="",
+                    help="also pin a named reference on each blob "
+                         "(local store only; gc keeps referenced "
+                         "objects)")
+    _blob_common(bp)
+    bp.set_defaults(fn=cmd_blob)
+    bp = bsub.add_parser("get", help="fetch one blob, verified "
+                                     "against its digest")
+    bp.add_argument("digest")
+    bp.add_argument("--out", default="",
+                    help="destination path (default: the digest's "
+                         "first 12 hex chars in the cwd)")
+    _blob_common(bp)
+    bp.set_defaults(fn=cmd_blob)
+    bp = bsub.add_parser(
+        "gc", help="drop unreferenced objects older than --ttl and "
+                   "orphaned ingest temps (local store only)")
+    bp.add_argument("--ttl", type=float, default=7 * 86400.0,
+                    help="age floor in seconds before an "
+                         "unreferenced object is collected")
+    _blob_common(bp)
+    bp.set_defaults(fn=cmd_blob)
+    bp = bsub.add_parser("stats", help="object/byte counts")
+    _blob_common(bp)
+    bp.set_defaults(fn=cmd_blob)
+
+    sp = sub.add_parser(
+        "index",
+        help="persistent candidate index: rebuild from the done "
+             "outdirs' parse, fsck the sqlite file, or query "
+             "candidates without touching any outdir")
+    isub = sp.add_subparsers(dest="index_cmd", required=True)
+
+    def _index_common(ip):
+        ip.add_argument("--spool", default=None,
+                        help="spool dir (default: the serve spool); "
+                             "the index lives at "
+                             "<spool>/candidates.db")
+        ip.add_argument("--queue", default="",
+                        help="route reads through this ticket-queue "
+                             "backend URL ('sqlite' expands to "
+                             "sqlite:<spool>/queue.db)")
+
+    ip = isub.add_parser(
+        "rebuild", help="re-derive every row from the done outdirs "
+                        "(outdirs are the source of truth; the "
+                        "index is only their cache)")
+    _index_common(ip)
+    ip.set_defaults(fn=cmd_index)
+    ip = isub.add_parser("fsck", help="integrity-check + WAL "
+                                      "checkpoint; exit 1 on damage")
+    _index_common(ip)
+    ip.set_defaults(fn=cmd_index)
+    ip = isub.add_parser(
+        "query", help="the indexed /v1/candidates answer, from the "
+                      "CLI")
+    ip.add_argument("--ticket", default="",
+                    help="restrict to one ticket id")
+    ip.add_argument("--min-sigma", type=float, default=0.0)
+    ip.add_argument("--limit", type=int, default=200)
+    _index_common(ip)
+    ip.set_defaults(fn=cmd_index)
 
     sp = sub.add_parser(
         "checkpoint",

@@ -113,6 +113,11 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
        "flight-recorder ring size: how many recent journal appends/"
        "heartbeats/claims a worker keeps in memory for its crash "
        "dump"),
+    _k("TPULSAR_BLOB_ROOT", "path", "unset (<spool>/blobs when "
+       "serving)",
+       "content-addressed blob-store root the gateway mounts at "
+       "/v1/blobs and workers push result artifacts into; a "
+       "--blob-root flag beats it"),
     _k("TPULSAR_CACHE_DIR", "path", ".jax_cache in a checkout",
        "persistent XLA compile-cache directory (one cache for the "
        "AOT gate, the measured child, and diagnostics)"),
@@ -128,6 +133,11 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
     _k("TPULSAR_CONFIG", "path", "unset (built-in defaults)",
        "config file path; the CLI exports it so queue-launched "
        "workers inherit the operator's settings"),
+    _k("TPULSAR_DATA_URL", "str (URL)", "unset (shared-disk paths)",
+       "gateway base URL workers fetch by-digest `blobs:` ticket "
+       "refs from at stage-in and push result artifacts to — the "
+       "spool-less data plane; unset keeps the shared-filesystem "
+       "path contract"),
     _k("TPULSAR_DD_FAMILY", "enum(auto|direct|tree)", "auto",
        "stage-2 dedispersion kernel family; auto = the per-pass "
        "cost-model dispatch"),
@@ -137,6 +147,11 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
     _k("TPULSAR_FAULTS", "spec", "unset",
        "deterministic fault-injection spec: point:mode[:k=v,..] "
        "(';'-separated); unknown points/modes fail loudly at parse"),
+    _k("TPULSAR_GATEWAY_TOKEN", "str", "unset (open gateway)",
+       "shared-secret bearer token: when set, every mutating "
+       "gateway route (beam POST, blob PUT) answers 401 without "
+       "`Authorization: Bearer <token>`; clients and the CLI read "
+       "the same knob to send it"),
     _k("TPULSAR_HEARTBEAT_MAX_AGE_S", "float", "120",
        "heartbeat staleness window for every serve/fleet freshness "
        "judgment (config jobpooler.heartbeat_max_age_s wins over "

@@ -1,0 +1,27 @@
+"""The data plane: content-addressed artifacts + the result index.
+
+PR 16 made the *control* plane spool-less (the sqlite TicketQueue);
+this package closes the *data* half of multi-host. Three modules:
+
+  * ``blobstore`` — a sha256-addressed content store (CAS) with the
+    paper's uploader discipline: tmp+fsync+rename writes, a
+    verify-after-write re-hash of what actually landed on disk, and
+    GC by refcount/TTL.  Beams stage in from it by digest; result
+    artifacts land in it on finish.
+  * ``transfer`` — the HTTP wire: client helpers for the gateway's
+    ``PUT/GET /v1/blobs/<sha256>`` routes (streamed, digest-verified
+    on BOTH ends) and the federation fetch that proxies a read to
+    whichever member holds the bytes.
+  * ``index`` — a persistent sqlite candidate index written in the
+    same durable step as the result, so ``/v1/candidates`` is an
+    indexed query instead of an outdir re-parse (the legacy parse
+    survives only as the ``--rebuild`` path).
+
+stdlib only — imported by the chaos stub worker and the gateway,
+which never import jax.
+"""
+
+from tpulsar.dataplane.blobstore import (  # noqa: F401
+    BlobStore, BlobVerifyError, default_blob_root)
+from tpulsar.dataplane.index import (  # noqa: F401
+    CandidateIndex, index_path)

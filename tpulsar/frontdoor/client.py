@@ -12,6 +12,7 @@ go elsewhere.
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.error
 import urllib.parse
@@ -37,9 +38,15 @@ def _request(method: str, url: str, payload: dict | None = None,
              timeout: float = DEFAULT_TIMEOUT_S) -> dict:
     data = json.dumps(payload).encode() if payload is not None \
         else None
+    headers = {"Content-Type": "application/json"} if data else {}
+    # authenticated deployments set TPULSAR_GATEWAY_TOKEN on both
+    # ends; sending it on reads too is harmless (the gateway only
+    # checks mutating routes)
+    token = os.environ.get("TPULSAR_GATEWAY_TOKEN", "")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
     req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"} if data else {})
+        url, data=data, method=method, headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.loads(resp.read().decode() or "{}")
@@ -54,6 +61,7 @@ def _request(method: str, url: str, payload: dict | None = None,
 def submit_beam(base_url: str, datafiles: list[str],
                 outdir: str | None = None, tenant: str = "",
                 priority=None, job_id: int | None = None,
+                blobs: dict | None = None,
                 timeout: float = DEFAULT_TIMEOUT_S,
                 retries: int = 0, sleep=time.sleep) -> dict:
     """Submit a beam.  ``retries`` > 0 makes a 429 refusal
@@ -72,6 +80,10 @@ def submit_beam(base_url: str, datafiles: list[str],
         payload["priority"] = priority
     if job_id is not None:
         payload["job_id"] = job_id
+    if blobs:
+        # spool-less stage-in: {filename: sha256} refs resolved
+        # against the gateway CAS by the worker
+        payload["blobs"] = dict(blobs)
     attempt = 0
     while True:
         try:

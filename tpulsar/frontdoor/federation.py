@@ -51,6 +51,11 @@ class AllSaturated(Exception):
     client should retry (HTTP 429)."""
 
 
+class BlobNotFound(Exception):
+    """No federation member holds the requested blob (HTTP 404 at
+    the router)."""
+
+
 @dataclasses.dataclass
 class MemberState:
     name: str
@@ -91,11 +96,17 @@ def _default_post(url: str, payload: dict, timeout: float) -> dict:
         return json.loads(resp.read().decode())
 
 
+def _default_fetch_raw(url: str, timeout: float):
+    """Open a streaming GET (returns the response object — the
+    caller reads and closes it).  Injectable for socket-free tests."""
+    return urllib.request.urlopen(url, timeout=timeout)
+
+
 class FederationRouter:
     def __init__(self, members: list[tuple[str, str]] | str, *,
                  ttl_s: float = CAPACITY_TTL_S,
                  poll_timeout_s: float = POLL_TIMEOUT_S,
-                 fetch=None, post=None, logger=None):
+                 fetch=None, post=None, fetch_raw=None, logger=None):
         if isinstance(members, str):
             members = parse_members(members)
         if not members:
@@ -106,6 +117,7 @@ class FederationRouter:
         self.poll_timeout_s = poll_timeout_s
         self._fetch = fetch or _default_fetch
         self._post = post or _default_post
+        self._fetch_raw = fetch_raw or _default_fetch_raw
         if logger is None:
             from tpulsar.obs.log import get_logger
             logger = get_logger("frontdoor.router")
@@ -216,3 +228,41 @@ class FederationRouter:
             return m.name, resp
         assert last_err is not None
         raise last_err
+
+    # --------------------------------------------------------- data plane
+
+    def open_blob(self, digest: str) -> tuple[str, object]:
+        """Find the member that HAS the bytes and return its open
+        streaming response: (member name, response).  Content
+        addressing makes this trivially safe — any member's copy of
+        a sha256 is THE copy, so the first 200 wins.  Members are
+        tried most-capacity-first (a member accepting work is alive
+        and worth asking first); a 404 moves on, transport failures
+        mark the member shed.  BlobNotFound when nobody has it."""
+        last_err: Exception | None = None
+        states = sorted(self.capacities(),
+                        key=lambda m: -m.capacity)
+        for m in states:
+            url = f"{m.url}/v1/blobs/{digest}"
+            try:
+                resp = self._fetch_raw(url, self.poll_timeout_s)
+            except urllib.error.HTTPError as e:
+                e.close()
+                if e.code != 404:
+                    last_err = e
+                continue
+            except Exception as e:        # noqa: BLE001 — transport
+                m.capacity = -1
+                m.error = str(e)[:200]
+                telemetry.frontdoor_host_capacity().set(
+                    -1, host=m.name)
+                last_err = e
+                continue
+            telemetry.frontdoor_routed_total().inc(host=m.name,
+                                                   outcome="ok")
+            return m.name, resp
+        if last_err is not None and not isinstance(
+                last_err, urllib.error.HTTPError):
+            raise last_err
+        raise BlobNotFound(
+            f"no federation member holds blob {digest[:12]}..")

@@ -23,12 +23,28 @@ API (all JSON):
     GET /v1/results/<id>          terminal record + parsed candidates
     GET /v1/candidates            result-store query
         ?ticket=&min_sigma=&limit=
+        (indexed via <journal root>/candidates.db when the data
+        plane has written one; the outdir parse is the fallback.
+        limit <= 0 is a 400, and a clipped answer carries
+        ``truncated: true`` + the uncut total)
+    PUT /v1/blobs/<sha256>        ingest bytes into the gateway CAS
+                                  at their address (streamed; the
+                                  server re-hashes and refuses a
+                                  mismatched body with 409)
+    GET /v1/blobs/<sha256>        stream the bytes back (router mode
+                                  proxies to the member that has
+                                  them); clients re-hash their side
     GET /v1/capacity              admission headroom: >0 accepting,
                                   0 backpressure, -1 load-shed (the
                                   federation router's poll target)
     GET /healthz                  liveness
     GET /metrics                  this gateway's registry (Prometheus
                                   text)
+
+Authn: when a shared secret is configured (``TPULSAR_GATEWAY_TOKEN``
+or ``token=``), every MUTATING route (beam POST, blob PUT) requires
+``Authorization: Bearer <token>`` and answers 401 without it; reads
+stay open (the journal/results are already the operator's to serve).
 
 Admission at the edge mirrors the warm backend's semantics: capacity
 None (zero fresh workers) is a 503 load-shed — nothing will drain the
@@ -91,13 +107,29 @@ class GatewayServer:
                  max_age_s: float | None = None,
                  default_depth: int = 8,
                  query_limit: int = 200,
-                 retry_jitter_seed: int = 0, logger=None):
+                 retry_jitter_seed: int = 0, logger=None,
+                 blob_root: str | None = None,
+                 token: str | None = None):
         if (queue is None) == (router is None):
             raise ValueError(
                 "exactly one of queue= (gateway mode) or router= "
                 "(router mode) is required")
         self.queue = queue
         self.router = router
+        #: the shared-secret bearer token; '' = open gateway
+        self.token = token if token is not None \
+            else os.environ.get("TPULSAR_GATEWAY_TOKEN", "")
+        #: the mounted CAS: an explicit blob_root beats the
+        #: TPULSAR_BLOB_ROOT/<spool>/blobs convention; None in
+        #: router mode (the router proxies, it never stores)
+        self.blob_store = None
+        if router is None:
+            from tpulsar.dataplane import blobstore as blobstore_mod
+            root = blob_root if blob_root is not None else \
+                blobstore_mod.default_blob_root(
+                    getattr(queue, "journal_root", "") or "")
+            if root:
+                self.blob_store = blobstore_mod.BlobStore(root)
         self.policy = policy or tenancy.TenantPolicy()
         self.outdir_base = outdir_base
         self.max_age_s = max_age_s
@@ -176,6 +208,20 @@ class GatewayServer:
             u = self._retry_rng.random()
         return round(base * (1.0 + (u - 0.5) * 0.5), 2)
 
+    def check_auth(self, auth_header: str) -> None:
+        """The mutating-route gate: no configured token = open
+        gateway (the pre-authn contract); a configured token makes
+        a missing/wrong ``Authorization: Bearer`` a 401 before any
+        handler state is touched."""
+        if not self.token:
+            return
+        if auth_header.strip() == f"Bearer {self.token}":
+            return
+        raise GatewayError(
+            401, "missing or invalid bearer token (the deployment "
+                 "sets TPULSAR_GATEWAY_TOKEN; send Authorization: "
+                 "Bearer <token>)")
+
     # -------------------------------------------------------------- routes
 
     def handle_submit(self, payload: dict) -> tuple[int, dict]:
@@ -188,6 +234,15 @@ class GatewayServer:
             self._count_submission(payload, "invalid")
             raise GatewayError(
                 400, "datafiles must be a non-empty list of paths")
+        blobs = payload.get("blobs")
+        if blobs is not None and not (
+                isinstance(blobs, dict) and blobs
+                and all(isinstance(k, str) and isinstance(v, str)
+                        for k, v in blobs.items())):
+            self._count_submission(payload, "invalid")
+            raise GatewayError(
+                400, "blobs must be a non-empty {filename: sha256} "
+                     "object when present")
         tenant = str(payload.get("tenant", "")
                      or tenancy.DEFAULT_TENANT)
         ticket_id = self._next_ticket_id()
@@ -243,7 +298,10 @@ class GatewayServer:
                 ticket_id, datafiles, outdir,
                 job_id=payload.get("job_id"), trace_id=trace_id,
                 tenant=tenant, priority=priority,
-                submitted_via="gateway")
+                submitted_via="gateway",
+                # by-digest stage-in refs ride the ticket record so
+                # a spool-less worker pulls its beam from the CAS
+                **({"blobs": blobs} if blobs else {}))
         self._count_submission({"tenant": tenant}, "accepted")
         return 201, {"ticket": ticket_id, "trace_id": trace_id,
                      "tenant": tenant, "priority": priority,
@@ -369,10 +427,100 @@ class GatewayServer:
                 "limit", [str(self.query_limit)])[0])
         except ValueError:
             raise GatewayError(400, "min_sigma/limit must be numeric")
+        if limit <= 0:
+            # explicit refusal, never a silent clamp: a client that
+            # asked for 0 (or -5) rows has a bug, and an empty 200
+            # would hide it
+            raise GatewayError(
+                400, f"limit must be a positive integer (got {limit})")
+        limit = min(limit, self.query_limit)
         ticket = params.get("ticket", [None])[0]
+        source = params.get("source", ["auto"])[0]
+        idx = self._candidate_index() if source != "parse" else None
+        if idx is not None:
+            try:
+                return 200, idx.query(ticket=ticket,
+                                      min_sigma=min_sigma, limit=limit)
+            except OSError as e:
+                # a sick index must degrade to the parse, not 500 a
+                # read-only query the outdirs can still answer
+                self.log.warning("candidate index failed (%s); "
+                                 "falling back to outdir parse", e)
         return 200, results.query_candidates(
             self.queue, ticket=ticket, min_sigma=min_sigma,
-            limit=min(max(0, limit), self.query_limit))
+            limit=limit)
+
+    def _candidate_index(self):
+        """The data plane's candidates.db next to the journal root,
+        when a worker has written one (None = legacy parse)."""
+        root = getattr(self.queue, "journal_root", "") or ""
+        if not root:
+            return None
+        from tpulsar.dataplane import index as index_mod
+        path = index_mod.index_path(root)
+        if not os.path.exists(path):
+            return None
+        return index_mod.CandidateIndex(path)
+
+    # ---------------------------------------------------------- blob routes
+
+    def handle_blob_put(self, digest: str, body,
+                        length: int) -> tuple[int, dict]:
+        """Ingest one streamed blob at its claimed address."""
+        from tpulsar.dataplane import blobstore as blobstore_mod
+        if self.router is not None:
+            raise GatewayError(
+                404, "this is a federation router: it stores no "
+                     "blobs — PUT to a member gateway")
+        if self.blob_store is None:
+            raise GatewayError(
+                404, "no blob store mounted (set TPULSAR_BLOB_ROOT "
+                     "or start the gateway with --blob-root)")
+        try:
+            d = blobstore_mod.check_digest(digest)
+        except ValueError as e:
+            raise GatewayError(400, str(e))
+        try:
+            stored = self.blob_store.put_stream(
+                body, expect_digest=d, length=length)
+        except blobstore_mod.BlobVerifyError as e:
+            # the body hashed to something other than its URL: the
+            # transfer is corrupt (or lying); nothing was stored
+            raise GatewayError(409, str(e))
+        except OSError as e:
+            raise GatewayError(500, f"blob store write failed: {e}")
+        return 201, {"digest": stored,
+                     "bytes": self.blob_store.size(stored)}
+
+    def open_blob(self, digest: str):
+        """(readable fh, size or None) for a blob GET — the local
+        store in gateway mode, a proxied member stream in router
+        mode.  GatewayError 400/404/500/502 otherwise."""
+        from tpulsar.dataplane import blobstore as blobstore_mod
+        try:
+            d = blobstore_mod.check_digest(digest)
+        except ValueError as e:
+            raise GatewayError(400, str(e))
+        if self.router is not None:
+            try:
+                _name, resp = self.router.open_blob(d)
+            except federation.BlobNotFound as e:
+                raise GatewayError(404, str(e))
+            except Exception as e:        # noqa: BLE001 — transport
+                raise GatewayError(
+                    502, f"every member failed the blob fetch: {e}")
+            size = resp.headers.get("Content-Length")
+            return resp, (int(size) if size else None)
+        if self.blob_store is None:
+            raise GatewayError(404, "no blob store mounted")
+        try:
+            fh, size = self.blob_store.open_blob(d)
+        except FileNotFoundError:
+            raise GatewayError(
+                404, f"no blob {d[:12]}.. in the store")
+        except OSError as e:
+            raise GatewayError(500, f"blob store read failed: {e}")
+        return fh, size
 
     def handle_capacity(self) -> tuple[int, dict]:
         if self.router is not None:
@@ -466,6 +614,8 @@ def _make_handler(gw: GatewayServer):
                     # client library sleeps on) and round here
                     headers["Retry-After"] = str(max(1, round(
                         float(e.payload["retry_after_s"]))))
+                if code == 401:
+                    headers["WWW-Authenticate"] = "Bearer"
             except Exception as e:        # noqa: BLE001 — one bad
                 # request must never take the gateway down
                 gw.log.exception("gateway %s failed", route)
@@ -498,8 +648,35 @@ def _make_handler(gw: GatewayServer):
                 self._dispatch("submit", lambda: (_ for _ in ()).throw(
                     GatewayError(400, f"bad JSON body: {e}")))
                 return
-            self._dispatch("submit",
-                           lambda: gw.handle_submit(payload))
+
+            def run():
+                gw.check_auth(self.headers.get("Authorization", ""))
+                return gw.handle_submit(payload)
+
+            self._dispatch("submit", run)
+
+        def do_PUT(self):
+            path = urllib.parse.urlparse(self.path).path
+            parts = [p for p in path.split("/") if p]
+            if len(parts) != 3 or parts[:2] != ["v1", "blobs"]:
+                self._dispatch("other", lambda: (_ for _ in ()).throw(
+                    GatewayError(404, f"no PUT route {path!r}")))
+                return
+            try:
+                length = int(self.headers.get("Content-Length", ""))
+            except ValueError:
+                self._dispatch(
+                    "blob_put", lambda: (_ for _ in ()).throw(
+                        GatewayError(411, "Content-Length required "
+                                          "for blob PUT")))
+                return
+
+            def run():
+                gw.check_auth(self.headers.get("Authorization", ""))
+                return gw.handle_blob_put(parts[2], self.rfile,
+                                          length)
+
+            self._dispatch("blob_put", run)
 
         def do_GET(self):
             parsed = urllib.parse.urlparse(self.path)
@@ -534,9 +711,56 @@ def _make_handler(gw: GatewayServer):
             elif len(parts) == 3 and parts[:2] == ["v1", "results"]:
                 self._dispatch("result",
                                lambda: gw.handle_result(parts[2]))
+            elif len(parts) == 3 and parts[:2] == ["v1", "blobs"]:
+                self._blob_get(parts[2])
             else:
                 self._dispatch("other", lambda: (_ for _ in ()).throw(
                     GatewayError(404, f"no route {path!r}")))
+
+        def _blob_get(self, digest: str) -> None:
+            """Streamed (non-JSON) blob read: bytes straight from
+            the store — or a proxied member stream in router mode —
+            with the address echoed in X-Tpulsar-Sha256 so the
+            client verifies its side of the wire."""
+            t0 = time.time()
+            try:
+                fh, size = gw.open_blob(digest)
+            except GatewayError as e:
+                try:
+                    self._send_json(e.code, e.payload)
+                except OSError:
+                    pass
+                self._observe("blob_get", e.code, t0)
+                return
+            code = 200
+            n = 0
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                if size is not None:
+                    self.send_header("Content-Length", str(size))
+                self.send_header("X-Tpulsar-Sha256",
+                                 digest.strip().lower())
+                self.end_headers()
+                while True:
+                    block = fh.read(1 << 20)
+                    if not block:
+                        break
+                    self.wfile.write(block)
+                    n += len(block)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                code = 499
+            finally:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+            if n:
+                telemetry.dataplane_bytes_total().inc(n, op="get")
+            telemetry.dataplane_transfer_seconds().observe(
+                time.time() - t0, op="get")
+            self._observe("blob_get", code, t0)
 
         def _metrics(self) -> None:
             t0 = time.time()

@@ -61,10 +61,13 @@ def query_candidates(queue, ticket: str | None = None,
                      limit: int = 200) -> dict:
     """The candidate query API: rows across one ticket (or every done
     ticket), filtered by sigma, sorted strongest first, truncated to
-    ``limit`` with the truncation made explicit (``total`` counts the
-    matching rows BEFORE the cut — a capped result must never read as
-    a complete one)."""
-    limit = max(0, limit)
+    ``limit`` with the truncation made explicit (``truncated: true``
+    plus ``total`` counting the matching rows BEFORE the cut — a
+    capped result must never read as a complete one).  A
+    non-positive ``limit`` is a caller bug and raises ValueError
+    (the gateway answers 400), never a silent clamp."""
+    if limit <= 0:
+        raise ValueError(f"limit must be positive (got {limit})")
     tickets = ([ticket] if ticket is not None
                else queue.list_tickets("done"))
     rows: list[dict] = []
@@ -84,6 +87,7 @@ def query_candidates(queue, ticket: str | None = None,
             rows.append(row)
     rows.sort(key=lambda r: -r.get("sigma", 0.0))
     return {"total": len(rows), "returned": min(len(rows), limit),
+            "truncated": len(rows) > limit,
             "tickets_searched": searched,
-            "min_sigma": min_sigma,
-            "candidates": rows[:max(0, limit)]}
+            "min_sigma": min_sigma, "source": "parse",
+            "candidates": rows[:limit]}

@@ -132,6 +132,9 @@ EVENTS = {
                       "batched dispatch (worker, beams, tickets "
                       "list; no ticket key — each member's own chain "
                       "carries its claim/result)",
+    "artifact_push": "a finished beam's sifted artifacts entered the "
+                     "CAS by digest (blobs count) — written just "
+                     "before the terminal result that names them",
     "result": "TERMINAL: the durable done/ record landed (status, "
               "rc, worker, attempt)",
     "takeover": "a janitor stole the claim from a DEAD owner "

@@ -516,6 +516,52 @@ def queue_op_seconds() -> metrics.Histogram:
         labelnames=("backend", "op"), buckets=QUEUE_OP_BUCKETS)
 
 
+#: histogram buckets for data-plane blob transfers: millisecond-scale
+#: candidate artifacts up to multi-minute beam stage-ins over a
+#: congested link
+DATAPLANE_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0,
+                     300.0)
+
+
+def dataplane_bytes_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_dataplane_bytes_total",
+        "bytes moved through the content-addressed blob store, by op "
+        "(put = ingested writes incl. dedup hits, get = reads served "
+        "to stage-in/fetch callers)",
+        labelnames=("op",))
+
+
+def dataplane_blobs_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_dataplane_blobs_total",
+        "blob-store operations by op and outcome: put "
+        "(stored | dedup | error), get (hit | miss | error), gc "
+        "(collected | kept) — verify failures count as error here "
+        "AND in tpulsar_dataplane_verify_failures_total",
+        labelnames=("op", "outcome"))
+
+
+def dataplane_verify_failures_total() -> metrics.Counter:
+    return metrics.counter(
+        "tpulsar_dataplane_verify_failures_total",
+        "content-integrity failures in the data plane: bytes whose "
+        "re-hash disagreed with their address (torn/corrupt object, "
+        "tampered transfer) — the paper's verify-after-write "
+        "discipline; alert at ANY sustained rate",
+        labelnames=("where",))       # store | transfer | stagein
+
+
+def dataplane_transfer_seconds() -> metrics.Histogram:
+    return metrics.histogram(
+        "tpulsar_dataplane_transfer_seconds",
+        "wall seconds per blob transfer, by op (put | get) — local "
+        "CAS I/O and HTTP blob-route streams observe the same "
+        "series, so a congested data plane shows as the histogram "
+        "tail walking right",
+        labelnames=("op",), buckets=DATAPLANE_BUCKETS)
+
+
 def chaos_actions_total() -> metrics.Counter:
     return metrics.counter(
         "tpulsar_chaos_actions_total",

@@ -118,6 +118,17 @@ FAULT_POINTS = (
     #                         leaves a torn blackbox file — the render
     #                         path must salvage the prefix, because a
     #                         real crashing worker can die mid-dump too
+    "dataplane.io",         # dataplane/blobstore.py + index.py CAS and
+    #                         index I/O: fired before blob writes/reads
+    #                         and before every index SQL statement —
+    #                         EIO/ENOSPC on the tmp+fsync+rename path
+    #                         must never leave a torn object under
+    #                         objects/, and an index failure must never
+    #                         cost the result transition it rides on
+    "stagein.fetch",        # serve/stagein.py by-digest blob fetch:
+    #                         errno mode fails the transfer (contained
+    #                         as a per-ticket stagein_failed result),
+    #                         delay mode models a congested data plane
 )
 
 MODES = ("unimplemented", "hang", "delay", "poison")

@@ -584,9 +584,70 @@ class SearchServer:
         rec = snap.get("tpulsar_compile_cache_misses_total") or {}
         return int(sum(rec.get("series", {}).values()))
 
+    def _publish_result(self, tid: str, outdir: str) -> dict:
+        """Data-plane publication for a finished beam: push the sifted
+        ``*.accelcands`` artifacts into the CAS (HTTP to
+        TPULSAR_DATA_URL, or a local TPULSAR_BLOB_ROOT store, pinned
+        under the ticket id) and write the candidate index rows — so
+        by the time the result record is observable, ``/v1/candidates``
+        answers from the index and the bytes are fetchable by digest
+        from any host.  Returns extras for the result record
+        ({"artifacts": {name: sha256}} when anything was pushed).
+
+        Publication failures degrade, never fail the beam: the search
+        succeeded and the outdir holds the truth — the gateway falls
+        back to the legacy parse, and the warning names what to
+        re-push/reindex."""
+        import glob as globmod
+
+        extras: dict = {}
+        paths = (sorted(globmod.glob(
+            os.path.join(outdir, "*.accelcands")))
+            if outdir and os.path.isdir(outdir) else [])
+        url = os.environ.get("TPULSAR_DATA_URL", "")
+        root = "" if url else os.environ.get("TPULSAR_BLOB_ROOT", "")
+        artifacts: dict[str, str] = {}
+        if paths and (url or root):
+            from tpulsar.dataplane import blobstore, transfer
+            try:
+                for path in paths:
+                    if url:
+                        digest = transfer.put_file(url, path)
+                    else:
+                        store = blobstore.BlobStore(root)
+                        digest = store.put_file(path)
+                        store.add_ref(digest, tid)
+                    artifacts[os.path.basename(path)] = digest
+            except Exception as e:      # noqa: BLE001 — degrade loud
+                self.log.warning(
+                    "ticket %s: artifact push failed (%s) — results "
+                    "stay on disk, re-push with `tpulsar blob put`",
+                    tid, e)
+                artifacts = {}
+        if artifacts:
+            extras["artifacts"] = artifacts
+            self._journal("artifact_push", {"ticket": tid},
+                          blobs=len(artifacts))
+        try:
+            from tpulsar.dataplane import index as dp_index
+            dp_index.CandidateIndex(
+                dp_index.index_path(self.jroot)).index_outdir(
+                    tid, outdir, artifacts)
+        except Exception as e:          # noqa: BLE001 — degrade loud
+            self.log.warning(
+                "ticket %s: candidate index write failed (%s) — the "
+                "gateway will parse the outdir; `tpulsar index "
+                "rebuild` recovers", tid, e)
+        return extras
+
     def _finish(self, tid: str, status: str, t0: float, outdir: str,
                 error: str = "", **extra) -> None:
         dt = time.time() - t0
+        if status == "done":
+            # the data plane rides the SAME durable step as the
+            # result: artifacts pushed + index rows written before the
+            # record that makes them observable
+            extra.update(self._publish_result(tid, outdir))
         # a beam is warm when it compiled nothing: the steady state
         # this subsystem exists to reach (failed beams are labelled
         # by their measured compile traffic too — a deadline kill
