@@ -2396,6 +2396,129 @@ def run_dataplane() -> None:
             shutil.rmtree(base, ignore_errors=True)
 
 
+def run_stream() -> None:
+    """``bench.py --stream``: the streaming plane's headline numbers
+    over the AOT-registered STREAM_PROFILE geometry — per-chunk
+    ingest-to-searched latency p95 (dedisperse the chunk, search
+    every span it completes) and sustained chunk throughput.
+    Parity rides along un-toleranced: the streamed dedispersed
+    series must be BIT-identical to the batch program over the same
+    samples, the streamed trigger set must equal the batch
+    span-partitioned search, and the injected dispersed pulse must
+    be recovered.  Knobs: TPULSAR_STBENCH_CHUNKS (default 24) /
+    TPULSAR_STBENCH_BACKEND (numpy|jax|auto, default numpy)."""
+    import numpy as np
+
+    from tpulsar.constants import dispersion_delay_s
+    from tpulsar.stream import STREAM_PROFILE
+    from tpulsar.stream import dedisp_state as dds
+    from tpulsar.stream.dedisp_state import StreamDedisp
+    from tpulsar.stream.trigger import SpanTrigger, trigger_digest
+
+    n_chunks = int(os.environ.get("TPULSAR_STBENCH_CHUNKS", "24"))
+    backend = dds.resolve_backend(
+        os.environ.get("TPULSAR_STBENCH_BACKEND", "numpy"))
+    geom = dict(STREAM_PROFILE)
+    nchan, cl = int(geom["nchan"]), int(geom["chunk_len"])
+    T = n_chunks * cl
+    rng = np.random.default_rng(19)
+    data = rng.normal(0, 1, (nchan, T)).astype(np.float32)
+    freqs, _ = dds.geometry_freqs_dms(geom)
+    pulse_dm, pulse_t = 12.0, 2 * cl + 17
+    sh = np.round(
+        dispersion_delay_s(pulse_dm, freqs, float(freqs[-1]))
+        / geom["dt"]).astype(int)
+    for c in range(nchan):
+        s = pulse_t + sh[c]
+        if s + 3 <= T:
+            data[c, s:s + 3] += 8.0
+    _log(f"stream bench: {n_chunks} x {nchan}x{cl} chunks, "
+         f"backend {backend}")
+
+    # one untimed warm lap: a jax backend's compile cost (absent on
+    # a warm AOT worker) must never pollute the latency distribution
+    for _ in StreamDedisp(geom, backend=backend).append(data[:, :cl]):
+        pass
+    sd = StreamDedisp(geom, backend=backend)
+    trig = SpanTrigger(geom, session="bench", backend=backend)
+    blocks, recs, lat = [], [], []
+    t_start = time.time()
+    for k in range(n_chunks):
+        t0 = time.time()
+        for blk in sd.append(data[:, k * cl:(k + 1) * cl]):
+            blocks.append(blk)
+            for _, r in trig.feed(blk):
+                recs.extend(r)
+        lat.append(time.time() - t0)
+    t0 = time.time()
+    for blk in sd.flush():
+        blocks.append(blk)
+        for _, r in trig.feed(blk):
+            recs.extend(r)
+    for _, r in trig.flush():
+        recs.extend(r)
+    drain_s = time.time() - t0
+    total_s = time.time() - t_start
+    stream_series = np.concatenate(blocks, axis=1)
+
+    # ---- parity, asserted (bitwise, not toleranced) --------------
+    if backend == "jax":
+        from tpulsar.kernels import dedisperse as dd_k
+        batch = np.asarray(
+            dd_k.dedisperse_stream_batch(data, sd.shifts))
+    else:
+        pad = dds.pad_bucket(sd.maxshift)
+        ext = np.concatenate(
+            [data, np.broadcast_to(data[:, -1:], (nchan, pad))],
+            axis=1)
+        batch = dds._window_scan_numpy(ext, sd.shifts, T)
+    series_ok = stream_series.shape == batch.shape \
+        and np.array_equal(stream_series, batch)
+    ctl = SpanTrigger(geom, session="bench", backend=backend)
+    ctl_recs = []
+    for _, r in ctl.feed(batch):
+        ctl_recs.extend(r)
+    for _, r in ctl.flush():
+        ctl_recs.extend(r)
+    trig_ok = trigger_digest(recs) == trigger_digest(ctl_recs)
+    found = any(abs(r["dm"] - pulse_dm) < 2.0
+                and abs(r["sample"] - pulse_t) < 8 for r in recs)
+    parity_ok = series_ok and trig_ok and found
+    assert series_ok, "streamed series differs from batch (bitwise)"
+    assert trig_ok, "streamed trigger set differs from batch spans"
+    assert found, "injected pulse not recovered by the trigger plane"
+
+    p95 = round(float(np.percentile(lat, 95)), 6)
+    mean = round(float(np.mean(lat)), 6)
+    cps = round(n_chunks / total_s, 2) if total_s > 0 else -1.0
+    _log(f"stream: chunk latency p95 {p95 * 1000:.2f} ms (mean "
+         f"{mean * 1000:.2f} ms), {cps} chunks/s, {len(recs)} "
+         f"trigger(s), parity {'ok' if parity_ok else 'FAILED'}")
+    _emit({
+        "metric": "stream_chunk_latency_p95_s",
+        "value": p95,
+        "unit": "s",
+        "stream": {
+            "chunks": n_chunks,
+            "chunk_len": cl,
+            "nchan": nchan,
+            "ndms": int(geom["ndms"]),
+            "span_chunks": int(geom["span_chunks"]),
+            "backend": backend,
+            "chunk_latency_p95_s": p95,
+            "chunk_latency_mean_s": mean,
+            "chunks_per_sec": cps,
+            "drain_s": round(drain_s, 4),
+            "triggers": len(recs),
+            # correctness rows: CI asserts these un-toleranced
+            "parity_ok": parity_ok,
+            "series_bit_identical": series_ok,
+            "trigger_parity": trig_ok,
+            "pulse_found": found,
+        },
+    })
+
+
 def run_doctor() -> None:
     """``bench.py --doctor``: the health doctor's cost and reflexes —
     (a) steady-state tick overhead over a populated journal (the tax
@@ -2817,6 +2940,9 @@ def main() -> None:
         return
     if "--doctor" in sys.argv:
         run_doctor()
+        return
+    if "--stream" in sys.argv:
+        run_stream()
         return
     if "--probe" in sys.argv:
         rec = probe_device(

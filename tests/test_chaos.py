@@ -563,3 +563,138 @@ def test_batch_admission_storm_passes_invariants(tmp_path):
     report = invariants.verify(spool, max_attempts=sc.max_attempts)
     assert report["ok"], report["violations"]
     assert report["checked"]["terminal"] == 8
+
+
+# --------------------------------------------------------------------
+# the streaming storm (worker_kind=stream)
+# --------------------------------------------------------------------
+
+def _stream_doc(**over):
+    doc = {"name": "st", "workers": 1, "worker_kind": "stream",
+           "workload": {"beams": 1, "stream_chunks": 4,
+                        "stream_chunk_len": 64, "stream_nchan": 8,
+                        "stream_ndms": 4, "stream_interval_s": 0.01},
+           "timeline": []}
+    doc.update(over)
+    return doc
+
+
+def test_stream_scenario_validates_loudly(tmp_path):
+    sc = scenario.from_dict(_stream_doc())
+    assert sc.worker_kind == "stream"
+    assert sc.workload.stream_chunks == 4
+    # the stream fields and the worker kind come together
+    with pytest.raises(ValueError, match="come together"):
+        scenario.from_dict(_stream_doc(
+            workload={"beams": 1, "stream_chunks": 0}))
+    with pytest.raises(ValueError, match="come together"):
+        scenario.from_dict({"workload": {"beams": 1},
+                            "worker_kind": "stream"})
+    with pytest.raises(ValueError, match="via=spool"):
+        doc = _stream_doc(gateway=True)
+        doc["workload"]["via"] = "gateway"
+        scenario.from_dict(doc)
+    with pytest.raises(ValueError, match="batch=1"):
+        scenario.from_dict(_stream_doc(batch=2))
+    with pytest.raises(ValueError, match="stream_drop_seqs"):
+        doc = _stream_doc()
+        doc["workload"]["stream_drop_seqs"] = [9]
+        scenario.from_dict(doc)
+    # the stream worker module is the spawned command
+    cmd = runner.ChaosRunner(
+        sc, str(tmp_path / "s"))._worker_cmd("w0")
+    assert "tpulsar.stream.worker" in cmd
+    assert "--worker-id" in cmd
+
+
+def test_packaged_stream_scenario_loads():
+    sc = scenario.load("stream_smoke")
+    assert sc.worker_kind == "stream" and sc.workers == 2
+    assert sc.workload.stream_drop_seqs == [5]
+    kinds = {a.action for a in sc.timeline}
+    assert {"kill_worker", "set_faults"} <= kinds
+
+
+def test_stream_chunk_payload_is_pure_function():
+    import numpy as np
+    a = runner.stream_chunk_payload("st", 7, 0, 3, 8, 64)
+    b = runner.stream_chunk_payload("st", 7, 0, 3, 8, 64)
+    assert a.dtype == np.float32 and a.shape == (8, 64)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(
+        a, runner.stream_chunk_payload("st", 7, 0, 4, 8, 64))
+    assert not np.array_equal(
+        a, runner.stream_chunk_payload("st", 8, 0, 3, 8, 64))
+
+
+def _stream_chain(spool, tid, acks, gaps=(), n_chunks=None,
+                  latency=0.5, slo=30.0):
+    trace = f"tr-{tid}"
+    journal.record(spool, "submitted", ticket=tid, attempt=0,
+                   trace_id=trace)
+    journal.record(spool, "claimed", ticket=tid, worker="w0",
+                   attempt=0, trace_id=trace)
+    for seq in acks:
+        journal.record(spool, "chunk_received", ticket=tid,
+                       worker="w0", attempt=0, trace_id=trace,
+                       seq=seq, latency_s=latency, slo_s=slo,
+                       proc_s=0.01)
+    for seq in gaps:
+        journal.record(spool, "chunk_gap", ticket=tid, worker="w0",
+                       attempt=0, trace_id=trace, seq=seq,
+                       waited_s=2.0)
+    if n_chunks is not None:
+        journal.record(spool, "stream_closed", ticket=tid,
+                       worker="w0", attempt=0, trace_id=trace,
+                       n_chunks=n_chunks, chunks=len(acks),
+                       gaps=len(gaps), triggers=0, digest="d")
+    journal.record(spool, "result", ticket=tid, worker="w0",
+                   attempt=0, trace_id=trace, status="done", rc=0)
+    protocol.ensure_spool(spool)
+    protocol._atomic_write_json(
+        protocol.ticket_path(spool, tid, "done"),
+        {"ticket": tid, "status": "done", "rc": 0})
+
+
+def test_verifier_passes_clean_stream_chain(tmp_path):
+    spool = str(tmp_path / "spool")
+    _stream_chain(spool, "s0", acks=[0, 1, 3], gaps=[2], n_chunks=4)
+    report = invariants.verify(spool)
+    assert report["ok"], report["violations"]
+
+
+def test_verifier_names_lost_chunk(tmp_path):
+    spool = str(tmp_path / "spool")
+    # seq 3 neither acknowledged nor gapped in a closed 4-chunk run
+    _stream_chain(spool, "s0", acks=[0, 1], gaps=[2], n_chunks=4)
+    assert "no_lost_chunk" in _named(spool)
+
+
+def test_verifier_names_doubled_and_conflicting_chunks(tmp_path):
+    spool = str(tmp_path / "spool")
+    _stream_chain(spool, "s0", acks=[0, 1, 1, 2, 3], n_chunks=4)
+    _stream_chain(spool, "s1", acks=[0, 1, 2, 3], gaps=[3],
+                  n_chunks=4)
+    report = invariants.verify(spool)
+    details = " | ".join(
+        v["detail"] for v in report["violations"]
+        if v["invariant"] == "no_lost_chunk")
+    assert "acknowledged 2x" in details
+    assert "both received and declared a gap" in details
+
+
+def test_verifier_names_out_of_window_chunk(tmp_path):
+    spool = str(tmp_path / "spool")
+    _stream_chain(spool, "s0", acks=[0, 1, 2, 3, 7], n_chunks=4)
+    assert "no_lost_chunk" in _named(spool)
+
+
+def test_verifier_names_latency_breach(tmp_path):
+    spool = str(tmp_path / "spool")
+    # an OPEN (never closed) session is still judged for latency
+    _stream_chain(spool, "s0", acks=[0, 1], latency=45.0, slo=30.0)
+    assert "trigger_latency_bounded" in _named(spool)
+    # within budget: quiet
+    spool2 = str(tmp_path / "spool2")
+    _stream_chain(spool2, "s1", acks=[0, 1], latency=29.0, slo=30.0)
+    assert "trigger_latency_bounded" not in _named(spool2)

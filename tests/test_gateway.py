@@ -453,3 +453,130 @@ def test_cli_submit_load_shed_rc3(tmp_path, capsys):
         assert err["code"] == 503
     finally:
         gw.stop()
+
+
+# --------------------------------------------------------------------
+# streaming-ingest routes
+# --------------------------------------------------------------------
+
+def _http(url, data=None, method=None, token=None, raw=False):
+    headers = {}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    if data is not None and not raw:
+        data = json.dumps(data).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+@pytest.fixture()
+def stream_gw(tmp_path):
+    q = fq.get_ticket_queue(f"spool:{tmp_path / 'spool'}")
+    server = GatewayServer(
+        queue=q, outdir_base=str(tmp_path / "results"),
+        stream_root=str(tmp_path / "stream")).start()
+    yield server, q
+    server.stop()
+
+
+def _stream_geom():
+    from tpulsar.stream import STREAM_PROFILE
+    g = dict(STREAM_PROFILE)
+    g.update(nchan=8, chunk_len=64, ndms=4)
+    return g
+
+
+def test_stream_session_over_http(stream_gw):
+    import numpy as np
+    from tpulsar.stream import ingest
+    gw, q = stream_gw
+    geom = _stream_geom()
+    code, rec = _http(gw.url + "/v1/stream/sA/open",
+                      {"geometry": geom})
+    assert code == 201 and rec["ticket"] == "stream-sA"
+    # idempotent re-open: 200, same fingerprint, NO second ticket
+    code2, rec2 = _http(gw.url + "/v1/stream/sA/open",
+                        {"geometry": geom})
+    assert code2 == 200
+    assert rec2["fingerprint"] == rec["fingerprint"]
+    assert q.pending_count() == 1
+    # frames land verified; a corrupt body is refused whole
+    chunk = np.ones((8, 64), np.float32)
+    blob = ingest.encode_frame(0, chunk, t_ingest=1.0)
+    code, got = _http(gw.url + "/v1/stream/sA/chunks", blob,
+                      method="POST", raw=True)
+    assert code == 201 and got["seq"] == 0
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _http(gw.url + "/v1/stream/sA/chunks", blob[:-3] + b"xyz",
+              method="POST", raw=True)
+    assert ei.value.code == 400
+    assert ingest.landed_seqs(gw.stream_root, "sA") == [0]
+    # close, then further frames are refused
+    code, got = _http(gw.url + "/v1/stream/sA/close", {"n_chunks": 1})
+    assert code == 200 and got["closed"] is True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _http(gw.url + "/v1/stream/sA/chunks",
+              ingest.encode_frame(1, chunk), method="POST", raw=True)
+    assert ei.value.code == 409
+    # triggers route reflects published records
+    ingest.append_triggers(gw.stream_root, "sA",
+                           [{"session": "sA", "span": 0, "dm": 1.0,
+                             "sigma": 7.5, "sample": 5,
+                             "time_s": 5e-4, "width": 1}])
+    code, got = _http(gw.url + "/v1/stream/sA/triggers")
+    assert code == 200 and got["closed"] and got["n"] == 1
+    assert got["triggers"][0]["sigma"] == 7.5
+
+
+def test_stream_geometry_conflict_409(stream_gw):
+    gw, _ = stream_gw
+    import urllib.error
+    _http(gw.url + "/v1/stream/sB/open", {"geometry": _stream_geom()})
+    other = _stream_geom()
+    other["nchan"] = 16
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _http(gw.url + "/v1/stream/sB/open", {"geometry": other})
+    assert ei.value.code == 409
+
+
+def test_stream_mutations_need_bearer_token(tmp_path):
+    import urllib.error
+    q = fq.get_ticket_queue(f"spool:{tmp_path / 'spool'}")
+    gw = GatewayServer(queue=q, outdir_base=str(tmp_path / "res"),
+                       stream_root=str(tmp_path / "stream"),
+                       token="sesame").start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http(gw.url + "/v1/stream/sC/open",
+                  {"geometry": _stream_geom()})
+        assert ei.value.code == 401
+        code, _rec = _http(gw.url + "/v1/stream/sC/open",
+                           {"geometry": _stream_geom()},
+                           token="sesame")
+        assert code == 201
+        # reads stay open
+        code, got = _http(gw.url + "/v1/stream/sC/triggers")
+        assert code == 200 and got["n"] == 0
+    finally:
+        gw.stop()
+
+
+def test_stream_routes_404_in_router_mode(tmp_path):
+    import urllib.error
+    member_q = fq.MemoryTicketQueue("m0")
+    member = GatewayServer(queue=member_q,
+                           outdir_base=str(tmp_path / "res")).start()
+    router = GatewayServer(router=federation.FederationRouter(
+        [("m0", member.url)])).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http(router.url + "/v1/stream/sD/open",
+                  {"geometry": _stream_geom()})
+        assert ei.value.code == 404
+    finally:
+        router.stop()
+        member.stop()

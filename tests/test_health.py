@@ -16,10 +16,11 @@ from tpulsar.resilience import faults
 
 
 def _frame(now, events=(), snapshot=None, samples=None,
-           queue_wait=None, fsck=None):
+           queue_wait=None, stream_latency=None, fsck=None):
     return {"now": now, "events": list(events),
             "snapshot": snapshot or {}, "samples": samples or {},
-            "queue_wait": queue_wait or [], "fsck": fsck}
+            "queue_wait": queue_wait or [],
+            "stream_latency": stream_latency or [], "fsck": fsck}
 
 
 def _rule(rid):
@@ -63,6 +64,46 @@ def test_rule_queue_wait_slo_burn_threshold_and_clean():
     assert not v["breached"]
     # no samples at all: no verdict, not a clean bill
     assert alerts.evaluate_rule(rule, _frame(NOW)) is None
+
+
+def test_rule_stream_latency_burn_threshold_and_clean():
+    rule = _rule("stream_latency_burn")
+    assert rule.samples_key == "stream_latency"
+    # 1 of 5 chunks over the 5 s objective => burn 0.2/0.1 = 2.0,
+    # exactly at threshold
+    lats = [(NOW - 10 - i, 6.0 if i == 0 else 0.05)
+            for i in range(5)]
+    v = alerts.evaluate_rule(rule, _frame(NOW, stream_latency=lats))
+    assert v["breached"] and v["value"] == pytest.approx(2.0)
+    # clean stream: every chunk well inside the objective
+    clean = [(NOW - 10 - i, 0.05) for i in range(5)]
+    v = alerts.evaluate_rule(rule, _frame(NOW, stream_latency=clean))
+    assert v is not None and not v["breached"]
+    # burning long window + recovered short window => quiet
+    recovered = ([(NOW - 500 - i, 6.0) for i in range(5)]
+                 + [(NOW - 10 - i, 0.05) for i in range(5)])
+    v = alerts.evaluate_rule(rule,
+                             _frame(NOW, stream_latency=recovered))
+    assert not v["breached"]
+    # no stream traffic: no verdict — and queue_wait samples must
+    # NOT leak into this rule's stream
+    assert alerts.evaluate_rule(rule, _frame(NOW)) is None
+    v = alerts.evaluate_rule(
+        rule, _frame(NOW, queue_wait=[(NOW - 1 - i, 40.0)
+                                      for i in range(5)]))
+    assert v is None
+
+
+def test_stream_latency_samples_extraction():
+    evs = [
+        {"event": "chunk_received", "t": NOW - 2, "latency_s": 0.2},
+        {"event": "chunk_received", "t": NOW - 1, "latency_s": 6.5},
+        {"event": "chunk_gap", "t": NOW - 1, "waited_s": 2.0},
+        {"event": "chunk_received", "t": NOW - 3},       # no latency
+        {"event": "claimed", "t": NOW - 1},
+    ]
+    assert alerts.stream_latency_samples(evs) == [
+        (NOW - 2, 0.2), (NOW - 1, 6.5)]
 
 
 @pytest.mark.parametrize("rid,event,n_fire", [

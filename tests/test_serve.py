@@ -511,3 +511,43 @@ def test_serve_batch_per_beam_failure_isolated(tmp_path, cfg):
     assert statuses == ["done", "failed"]
     failed = next(r for r in recs.values() if r["status"] == "failed")
     assert "poisoned beam" in failed["error"]
+
+
+# ------------------------------------------------------------- stream mode
+
+def test_serve_stream_mode_runs_session_tickets(tmp_path, cfg):
+    import numpy as np
+
+    from tpulsar.stream import STREAM_PROFILE, ingest
+
+    spool = tmp_path / "spool"
+    sroot = str(tmp_path / "stream")
+    geom = dict(STREAM_PROFILE, nchan=16, ndms=8, chunk_len=256)
+    rng = np.random.default_rng(5)
+    ingest.open_session(sroot, "sv", geom)
+    for k in range(4):
+        ingest.append_chunk(
+            sroot, "sv",
+            k, rng.normal(0, 1, (16, 256)).astype(np.float32),
+            t_ingest=time.time())
+    ingest.close_session(sroot, "sv", 4)
+
+    server = _server(spool, cfg, worker_id="ws", stream=True,
+                     poll_s=0.02)
+    server.queue.submit("sv-t", [], str(tmp_path / "out"),
+                        kind="stream", session="sv",
+                        stream_root=sroot)
+    # a beam ticket on the same spool is refused, not searched
+    server.queue.submit("beam-t", ["/data/x.fits"],
+                        str(tmp_path / "out2"))
+    assert server.serve(once=True) == 0
+    res = server.queue.read_result("sv-t")
+    assert res["status"] == "done"
+    assert res["chunks"] == 4 and res["gaps"] == 0
+    assert server.queue.read_result("beam-t")["status"] == "failed"
+    assert server.beams == {"done": 1, "failed": 0, "skipped": 1}
+    from tpulsar.obs import journal
+    names = [e["event"] for e in journal.read_events(
+        server.jroot, ticket="sv-t")]
+    assert names.count("chunk_received") == 4
+    assert "stream_closed" in names

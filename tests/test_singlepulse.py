@@ -103,3 +103,27 @@ def test_detrend_env_override(monkeypatch):
     monkeypatch.delenv("TPULSAR_SP_DETREND")
     assert sp.detrend_estimator("median_sub4") == "median_sub4"
     assert sp.detrend_estimator(None) == "median"
+
+
+def test_detrend_tail_uses_own_length():
+    """A tail shorter than detrend_block must be baselined from its
+    OWN samples (regression: the old edge-pad reused the last full
+    block's baseline, inflating tail sigmas across level drifts)."""
+    rng = np.random.default_rng(7)
+    blk = 1000
+    T = 3 * blk + 137          # non-divisible length -> 137-sample tail
+    series = rng.standard_normal((2, T)).astype(np.float32)
+    series[:, 3 * blk:] += 50.0   # tail level steps far off the blocks
+    out = np.asarray(sp.detrend_normalize(jnp.asarray(series),
+                                          detrend_block=blk))
+    # numpy oracle of the fixed behavior
+    body = series[:, :3 * blk].reshape(2, 3, blk)
+    baseline = np.repeat(np.median(body, axis=-1), blk, axis=-1)
+    tail_med = np.median(series[:, 3 * blk:], axis=-1)
+    baseline = np.concatenate(
+        [baseline, np.repeat(tail_med[:, None], 137, axis=-1)], axis=-1)
+    det = series - baseline
+    oracle = det / np.maximum(det.std(axis=-1, keepdims=True), 1e-9)
+    np.testing.assert_allclose(out, oracle, rtol=1e-5, atol=1e-5)
+    # the step must NOT read as a pulse: tail stays near zero mean
+    assert abs(np.asarray(out)[:, 3 * blk:].mean()) < 0.5

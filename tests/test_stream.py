@@ -1,0 +1,377 @@
+"""Streaming plane tests: chunked-vs-batch bit-identity, ingest
+framing, trigger parity, carry-state resume, and the stream worker's
+exactly-once session protocol."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpulsar.constants import dispersion_delay_s
+from tpulsar.stream import STREAM_PROFILE, ingest
+from tpulsar.stream import dedisp_state as dds
+from tpulsar.stream import trigger as trg
+from tpulsar.stream.dedisp_state import StreamDedisp
+from tpulsar.stream.trigger import SpanTrigger, trigger_digest
+
+
+def _geom(**over):
+    g = dict(STREAM_PROFILE)
+    g.update(over)
+    return g
+
+
+def _series(geom, n_chunks, seed=0, pulse_dm=12.0, pulse_t=2000,
+            amp=8.0):
+    """Noise + one dispersed pulse at pulse_dm, chunk-aligned total."""
+    rng = np.random.default_rng(seed)
+    T = n_chunks * geom["chunk_len"]
+    data = rng.normal(0, 1, (geom["nchan"], T)).astype(np.float32)
+    freqs, _ = dds.geometry_freqs_dms(geom)
+    sh = np.round(dispersion_delay_s(pulse_dm, freqs, float(freqs[-1]))
+                  / geom["dt"]).astype(int)
+    for c in range(geom["nchan"]):
+        s = pulse_t + sh[c]
+        if s + 3 <= T:
+            data[c, s:s + 3] += amp
+    return data
+
+
+def _stream_all(geom, data, backend):
+    sd = StreamDedisp(geom, backend=backend)
+    cl = geom["chunk_len"]
+    blocks = []
+    for k in range(data.shape[1] // cl):
+        blocks += sd.append(data[:, k * cl:(k + 1) * cl])
+    blocks += sd.flush()
+    return np.concatenate(blocks, axis=1), sd
+
+
+# --------------------------------------------------------------- parity
+
+def test_pad_bucket_matches_kernel():
+    from tpulsar.kernels import dedisperse as dd
+    for m in (0, 1, 100, 255, 256, 257, 1000, 5000):
+        assert dds.pad_bucket(m) == dd._pad_bucket(m)
+
+
+def test_shift_table_matches_kernel():
+    from tpulsar.kernels import dedisperse as dd
+    geom = _geom()
+    freqs, dms = dds.geometry_freqs_dms(geom)
+    np.testing.assert_array_equal(
+        dds.shift_table(geom),
+        dd.stream_shift_table(freqs, dms, geom["dt"]))
+
+
+@pytest.mark.parametrize("chunk_len", [
+    997,     # prime
+    1024,    # power of two
+    4096,    # > max channel delay (maxshift ~183 at this geometry)
+    128,     # < max channel delay: many chunks per emission window
+])
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_chunked_bit_identical_to_batch(chunk_len, backend):
+    """THE tentpole invariant: the chunked run is bit-identical (no
+    tolerance) to the batch kernel on the concatenated series, for
+    chunk lengths on every side of the carry size."""
+    geom = _geom(chunk_len=chunk_len, nchan=32, ndms=16)
+    n_chunks = max(3, (4096 // chunk_len) + 2)
+    data = _series(geom, n_chunks, seed=chunk_len)
+    stream, sd = _stream_all(geom, data, backend)
+    if backend == "jax":
+        from tpulsar.kernels import dedisperse as dd
+        batch = np.asarray(dd.dedisperse_stream_batch(data, sd.shifts))
+    else:
+        pad = dds.pad_bucket(sd.maxshift)
+        ext = np.concatenate(
+            [data, np.broadcast_to(data[:, -1:],
+                                   (data.shape[0], pad))], axis=1)
+        batch = dds._window_scan_numpy(ext, sd.shifts, data.shape[1])
+    assert stream.shape == batch.shape
+    assert np.array_equal(stream, batch)     # bitwise, not allclose
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_jax_and_numpy_backends_agree(backend):
+    """Both backends produce the identical series (same fold order,
+    same f32 adds) — the chaos storm's jax-free worker is exact."""
+    geom = _geom(nchan=32, ndms=16)
+    data = _series(geom, 4, seed=5)
+    ref, _ = _stream_all(geom, data, "numpy")
+    out, _ = _stream_all(geom, data, backend)
+    assert np.array_equal(ref, out)
+
+
+@pytest.mark.parametrize("chunk_len", [997, 1024, 128])
+def test_trigger_set_chunk_len_invariant(chunk_len):
+    """Trigger parity: the streamed trigger set equals the batch SP
+    stage applied over the same spans of the batch-dedispersed
+    series, for any chunk length — and the injected pulse is found."""
+    geom = _geom(chunk_len=chunk_len, nchan=32, ndms=16,
+                 span_chunks=max(1, 4096 // chunk_len))
+    n_chunks = max(6, (8192 // chunk_len))
+    data = _series(geom, n_chunks, seed=11, pulse_dm=12.0,
+                   pulse_t=1500)
+    stream, sd = _stream_all(geom, data, "numpy")
+
+    # streamed trigger records
+    tg = SpanTrigger(geom, session="p", backend="numpy")
+    recs = []
+    sd2 = StreamDedisp(geom, backend="numpy")
+    cl = geom["chunk_len"]
+    for k in range(n_chunks):
+        for blk in sd2.append(data[:, k * cl:(k + 1) * cl]):
+            for _, r in tg.feed(blk):
+                recs += r
+    for blk in sd2.flush():
+        for _, r in tg.feed(blk):
+            recs += r
+    for _, r in tg.flush():
+        recs += r
+
+    # batch equivalent: batch series, same span partition
+    span_len = geom["span_chunks"] * cl
+    brecs = []
+    for i, s0 in enumerate(range(0, stream.shape[1], span_len)):
+        span = stream[:, s0:s0 + span_len]
+        _, dms = dds.geometry_freqs_dms(geom)
+        ev = trg.search_span(span, dms, geom["dt"],
+                             trg.DEFAULT_THRESHOLD, "numpy")
+        brecs += trg.events_to_records(ev, "p", i, s0, geom["dt"])
+
+    assert trigger_digest(recs) == trigger_digest(brecs)
+    hits = [r for r in recs
+            if abs(r["dm"] - 12.0) < 2.5 and abs(r["sample"] - 1500) < 64]
+    assert hits, f"injected pulse not triggered ({len(recs)} triggers)"
+
+
+def test_carry_state_roundtrip_mid_session():
+    """Kill/resume at an arbitrary chunk boundary: restoring the
+    carry npz continues to the identical series + trigger set."""
+    geom = _geom(nchan=32, ndms=16)
+    data = _series(geom, 6, seed=21)
+    cl = geom["chunk_len"]
+    ref, _ = _stream_all(geom, data, "numpy")
+
+    sd = StreamDedisp(geom, backend="numpy")
+    blocks = []
+    for k in range(3):
+        blocks += sd.append(data[:, k * cl:(k + 1) * cl])
+    blob = sd.state_bytes()
+
+    sd2 = StreamDedisp(geom, backend="numpy")
+    sd2.restore(blob)
+    assert sd2.emitted == sd.emitted
+    for k in range(3, 6):
+        blocks += sd2.append(data[:, k * cl:(k + 1) * cl])
+    blocks += sd2.flush()
+    assert np.array_equal(np.concatenate(blocks, axis=1), ref)
+
+
+# --------------------------------------------------------------- ingest
+
+def test_frame_roundtrip_and_corruption(tmp_path):
+    root = str(tmp_path)
+    geom = _geom()
+    ingest.open_session(root, "s1", geom)
+    chunk = np.arange(geom["nchan"] * geom["chunk_len"],
+                      dtype=np.float32).reshape(geom["nchan"], -1)
+    ingest.append_chunk(root, "s1", 0, chunk, t_ingest=1.5)
+    header, arr = ingest.read_chunk(root, "s1", 0)
+    assert header["seq"] == 0 and header["t_ingest"] == 1.5
+    np.testing.assert_array_equal(arr, chunk)
+    assert ingest.landed_seqs(root, "s1") == [0]
+    # flip one payload byte -> verified read must refuse
+    p = ingest.frame_path(root, "s1", 0)
+    blob = bytearray(open(p, "rb").read())
+    blob[-1] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(ingest.StreamError):
+        ingest.read_chunk(root, "s1", 0)
+
+
+def test_session_fingerprint_discipline(tmp_path):
+    root = str(tmp_path)
+    geom = _geom()
+    m1 = ingest.open_session(root, "s2", geom)
+    m2 = ingest.open_session(root, "s2", dict(geom))   # idempotent
+    assert m1["fingerprint"] == m2["fingerprint"]
+    with pytest.raises(ingest.StreamError):
+        ingest.open_session(root, "s2", _geom(nchan=128))
+    ingest.close_session(root, "s2", 0)
+    assert ingest.read_manifest(root, "s2")["closed"] is True
+
+
+def test_triggers_jsonl_roundtrip(tmp_path):
+    root = str(tmp_path)
+    ingest.open_session(root, "s3", _geom())
+    recs = [{"session": "s3", "span": 0, "dm": 1.0, "sigma": 7.0,
+             "sample": 10, "time_s": 0.001, "width": 3}]
+    ingest.append_triggers(root, "s3", recs)
+    ingest.append_triggers(root, "s3", [])      # no-op
+    got = ingest.read_triggers(root, "s3")
+    assert got == recs
+    # torn tail line tolerated
+    with open(ingest.triggers_path(root, "s3"), "ab") as f:
+        f.write(b'{"torn":')
+    assert ingest.read_triggers(root, "s3") == recs
+
+
+# --------------------------------------------------------------- worker
+
+def _run_worker(spool, wid, env_extra=None, timeout=120):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "tpulsar.stream.worker",
+         "--spool", spool, "--worker-id", wid, "--once",
+         "--backend", "numpy"],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _feed_session(sroot, session, geom, data, skip=()):
+    ingest.open_session(sroot, session, geom)
+    cl = geom["chunk_len"]
+    n = data.shape[1] // cl
+    for k in range(n):
+        if k in skip:
+            continue
+        ingest.append_chunk(sroot, session, k,
+                            data[:, k * cl:(k + 1) * cl],
+                            t_ingest=time.time())
+    ingest.close_session(sroot, session, n)
+    return n
+
+
+def test_worker_session_end_to_end(tmp_path):
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    from tpulsar.obs import journal
+    spool = str(tmp_path / "spool")
+    sroot = str(tmp_path / "stream")
+    outdir = str(tmp_path / "out")
+    os.makedirs(spool); os.makedirs(outdir)
+    geom = _geom(nchan=32, ndms=16)
+    data = _series(geom, 5, seed=31)
+    n = _feed_session(sroot, "sA", geom, data, skip={2})
+
+    q = get_ticket_queue(f"spool:{spool}")
+    q.submit("st-0", [], outdir, kind="stream", session="sA",
+             stream_root=sroot, slo_s=30.0)
+    r = _run_worker(spool, "w0")
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = q.read_result("st-0")
+    assert res["status"] == "done"
+    assert res["n_chunks"] == n and res["chunks"] == n - 1
+    assert res["gaps"] == 1
+    assert res["emitted_samples"] == data.shape[1]
+    evs = journal.read_events(q.journal_root or spool, ticket="st-0")
+    names = [e["event"] for e in evs]
+    assert names.count("chunk_received") == n - 1
+    assert names.count("chunk_gap") == 1
+    assert names.count("stream_open") == 1
+    assert names.count("stream_closed") == 1
+    gap = next(e for e in evs if e["event"] == "chunk_gap")
+    assert gap["seq"] == 2
+    for e in evs:
+        if e["event"] == "chunk_received":
+            assert e["latency_s"] <= e["slo_s"]
+    # checkpoint cleaned after the durable result
+    assert not os.path.isdir(os.path.join(outdir, ".checkpoint"))
+
+
+def test_worker_sigkill_resume_identical_to_control(tmp_path):
+    """A worker SIGKILLed mid-session resumes from the chunk-boundary
+    checkpoint, replays at most the unacknowledged chunk, and the
+    final trigger digest equals an uninterrupted control run's."""
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    from tpulsar.obs import journal
+    geom = _geom(nchan=32, ndms=16, span_chunks=2)
+    data = _series(geom, 8, seed=41, pulse_dm=10.0, pulse_t=1200,
+                   amp=9.0)
+
+    cl = geom["chunk_len"]
+
+    def feed(sroot, seqs, close_at=None):
+        for k in seqs:
+            ingest.append_chunk(sroot, "sK", k,
+                                data[:, k * cl:(k + 1) * cl],
+                                t_ingest=time.time())
+        if close_at is not None:
+            ingest.close_session(sroot, "sK", close_at)
+
+    def run(tag, kill=False):
+        spool = str(tmp_path / f"spool-{tag}")
+        sroot = str(tmp_path / f"stream-{tag}")
+        outdir = str(tmp_path / f"out-{tag}")
+        os.makedirs(spool); os.makedirs(outdir)
+        ingest.open_session(sroot, "sK", geom)
+        q = get_ticket_queue(f"spool:{spool}")
+        q.submit("st-k", [], outdir, kind="stream", session="sK",
+                 stream_root=sroot, slo_s=60.0)
+        if kill:
+            # only the first half lands pre-kill and the session stays
+            # open, so the first worker CANNOT finish — race-free
+            feed(sroot, range(4))
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpulsar.stream.worker",
+                 "--spool", spool, "--worker-id", "wk", "--once",
+                 "--backend", "numpy"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            deadline = time.time() + 60
+            jroot = q.journal_root or spool
+            while time.time() < deadline:
+                acked = [e for e in journal.read_events(
+                    jroot, ticket="st-k")
+                    if e["event"] == "chunk_received"]
+                if len(acked) >= 3:
+                    break
+                time.sleep(0.05)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+            assert q.read_result("st-k") is None
+            feed(sroot, range(4, 8), close_at=8)
+            # heal the orphaned claim so the restart can re-claim it
+            # (the restarted worker's boot recovery would also do it)
+            q.requeue_stale_claims(5)
+        else:
+            feed(sroot, range(8), close_at=8)
+        r = _run_worker(spool, f"w-{tag}2")
+        assert r.returncode == 0, r.stderr[-2000:]
+        res = q.read_result("st-k")
+        assert res and res["status"] == "done", res
+        return res, journal.read_events(q.journal_root or spool,
+                                        ticket="st-k")
+
+    control, _ = run("ctl")
+    resumed, evs = run("chaos", kill=True)
+    assert resumed["trigger_digest"] == control["trigger_digest"]
+    assert resumed["chunks"] == control["chunks"]
+    # exactly-once: every seq acknowledged exactly once in the journal
+    seqs = [e["seq"] for e in evs if e["event"] == "chunk_received"]
+    assert sorted(seqs) == list(range(control["n_chunks"]))
+    opens = [e for e in evs if e["event"] == "stream_open"]
+    assert any(e.get("resumed") for e in opens), \
+        "second worker did not resume from the checkpoint"
+    # the resumed worker reprocessed no acknowledged chunk beyond the
+    # at-most-one in flight between journal append and checkpoint
+    assert resumed["replayed"] <= 1
+
+
+def test_worker_rejects_non_stream_ticket(tmp_path):
+    from tpulsar.frontdoor.queue import get_ticket_queue
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    q = get_ticket_queue(f"spool:{spool}")
+    q.submit("plain-0", [], str(tmp_path / "o"))
+    r = _run_worker(spool, "w0")
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = q.read_result("plain-0")
+    assert res["status"] == "failed"

@@ -89,6 +89,10 @@ DEFAULT_KEYS = (
     ("doctor.detection_latency_s", "lower"),
     ("dataplane.stagein_mb_per_s", "higher"),
     ("dataplane.candidates_query_ms", "lower"),
+    # stream.parity_ok is a bool — lookup() excludes it, so CI
+    # asserts it directly instead of gating it with a tolerance
+    ("stream.chunk_latency_p95_s", "lower"),
+    ("stream.chunks_per_sec", "higher"),
 )
 
 

@@ -376,6 +376,8 @@ def gate_groups(ctx: GateContext, config: int = 0,
         groups += _config_groups(ctx, config)
     else:
         groups += _headline_groups(ctx, fast=fast)
+        groups.append(("stream (STREAM_PROFILE):",
+                       _stream_instances(ctx)))
     if ctx.nbeams > 1:
         groups += _beam_batch_groups(ctx)
     return groups
@@ -487,6 +489,39 @@ def _rfi_instances(ctx: GateContext) -> list[Instance]:
                  (blk, _sds((ctx.nblocks, NCHAN), jnp.bool_),
                   _sds((NCHAN,), jnp.float32)),
                  dict(block_len=2048)),
+    ]
+
+
+def _stream_instances(ctx: GateContext) -> list[Instance]:
+    """The streaming plane's static signatures (stream/dedisp_state,
+    stream/trigger at STREAM_PROFILE geometry): ONE emission-window
+    scan per session plus the span-shaped SP pair.  Gated here so a
+    warm serve worker compiles nothing at stream-session start —
+    the per-chunk latency SLO has no room for a first-chunk lowering.
+    Scale-independent: the stream geometry is fixed by the profile,
+    not the gate's ``--scale``."""
+    import jax.numpy as jnp
+
+    from tpulsar.kernels import singlepulse as sp_k
+    from tpulsar.stream import STREAM_PROFILE
+    from tpulsar.stream import dedisp_state as dds
+
+    g = STREAM_PROFILE
+    shifts = dds.shift_table(g)
+    width = int(g["chunk_len"]) + dds.pad_bucket(
+        int(shifts.max(initial=0)))
+    span = int(g["span_chunks"]) * int(g["chunk_len"])
+    win = _sds((int(g["nchan"]), width), jnp.float32)
+    sers = _sds((int(g["ndms"]), span), jnp.float32)
+    return [
+        Instance("dedisperse.dedisperse_window_scan",
+                 "stream_window_scan",
+                 (win, _sds(shifts.shape, jnp.int32)),
+                 dict(out_len=int(g["chunk_len"]))),
+        Instance("singlepulse.normalize_series", "stream_sp_normalize",
+                 (sers,), dict(estimator=sp_k.detrend_estimator())),
+        Instance("singlepulse.boxcar_search", "stream_sp_boxcars",
+                 (sers,), {}),
     ]
 
 

@@ -161,6 +161,14 @@ INVARIANTS = {
         "every indexed ticket's candidate rows are byte-identical "
         "to a fresh parse of its outdir, and every done beam with "
         "candidates is indexed",
+    "no_lost_chunk":
+        "every closed stream session acknowledges each seq in "
+        "[0, n_chunks) exactly once — as a chunk_received or a "
+        "zero-filled chunk_gap, never both, never neither",
+    "trigger_latency_bounded":
+        "every acknowledged stream chunk was searched within the "
+        "session's journaled per-chunk latency SLO (ingest-to-"
+        "searched, kills and resumes included)",
 }
 
 #: events that RELEASE a claim (close an inflight interval) — drawn
@@ -722,6 +730,77 @@ def _dataplane_sweep(root: str,
     return out
 
 
+def _stream_sweep(per_ticket: dict[str, list[dict]]) -> list[dict]:
+    """The streaming plane's two contracts, judged per session chain.
+
+    no_lost_chunk arms itself on any chain with a ``stream_closed``
+    event: a drained session must account for every seq in
+    [0, n_chunks) exactly once — acknowledged as a ``chunk_received``
+    or declared a zero-filled ``chunk_gap``, never both, never a
+    duplicate, never a seq outside the window.  A kill between the
+    journal append and the checkpoint may REPLAY a chunk (the worker
+    journals ``replayed``, not a second ack), so double-acks are
+    real exactly-once violations, not kill-window noise.
+
+    trigger_latency_bounded is judged on every chain with stream
+    acks, closed or not: each ``chunk_received`` carries the
+    ingest-to-searched ``latency_s`` and the session's ``slo_s`` —
+    the bounded-latency promise the trigger mode exists for, with
+    kills, takeovers, and resumes inside the budget."""
+    out: list[dict] = []
+    for tid, evs in sorted(per_ticket.items()):
+        recv: dict[int, int] = {}
+        gaps: dict[int, int] = {}
+        closed_n: int | None = None
+        for ev in evs:
+            name = ev.get("event")
+            if name == "chunk_received":
+                seq = int(ev.get("seq", -1))
+                recv[seq] = recv.get(seq, 0) + 1
+                lat, slo = ev.get("latency_s"), ev.get("slo_s")
+                if isinstance(lat, (int, float)) \
+                        and not isinstance(lat, bool) \
+                        and isinstance(slo, (int, float)) \
+                        and not isinstance(slo, bool) and lat > slo:
+                    out.append(_v(
+                        "trigger_latency_bounded", tid,
+                        f"chunk {seq} searched {lat:.3f} s after "
+                        f"ingest (SLO {slo:.1f} s)"))
+            elif name == "chunk_gap":
+                seq = int(ev.get("seq", -1))
+                gaps[seq] = gaps.get(seq, 0) + 1
+            elif name == "stream_closed":
+                closed_n = int(ev.get("n_chunks") or 0)
+        if closed_n is None:
+            continue        # never drained: nothing to account for
+        want = set(range(closed_n))
+        have = set(recv) | set(gaps)
+        for seq in sorted(want - have):
+            out.append(_v(
+                "no_lost_chunk", tid,
+                f"seq {seq} never acknowledged (no chunk_received, "
+                f"no chunk_gap) in a closed {closed_n}-chunk "
+                f"session"))
+        for seq in sorted(have - want):
+            out.append(_v("no_lost_chunk", tid,
+                          f"acknowledged seq {seq} outside "
+                          f"[0, {closed_n})"))
+        for seq in sorted(set(recv) & set(gaps)):
+            out.append(_v("no_lost_chunk", tid,
+                          f"seq {seq} both received and declared a "
+                          f"gap"))
+        for seq, n in sorted(recv.items()):
+            if n > 1:
+                out.append(_v("no_lost_chunk", tid,
+                              f"seq {seq} acknowledged {n}x "
+                              f"(chunk_received is exactly-once)"))
+        for seq, n in sorted(gaps.items()):
+            if n > 1:
+                out.append(_v("no_lost_chunk", tid,
+                              f"seq {seq} declared a gap {n}x"))
+    return out
+
+
 def _sidefile_sweep(q) -> list[dict]:
     # the backend's own accounting of transaction transients: the
     # spool reports surviving .tmp/.claiming/.takeover side-files,
@@ -834,6 +913,7 @@ def verify(spool: str, *, tenants: dict | None = None,
         violations.extend(_checkpoint_litter_sweep(per_ticket))
     violations.extend(_capacity_check(root))
     violations.extend(_dataplane_sweep(root, done_recs))
+    violations.extend(_stream_sweep(per_ticket))
 
     by_inv = {name: 0 for name in INVARIANTS}
     for v in violations:

@@ -50,6 +50,26 @@ def beam_payload(name: str, seed: int, i: int,
     return (block * reps)[:size]
 
 
+def stream_chunk_payload(name: str, seed: int, i: int, seq: int,
+                         nchan: int, chunk_len: int):
+    """One streaming session chunk — like :func:`beam_payload`, a
+    pure function of (scenario, seed, session index, seq), so a
+    killed-and-resumed session and the timeline-stripped control run
+    dedisperse byte-identical samples and must publish identical
+    trigger digests.  Every few chunks carry a bright broadband
+    pulse so the storm's trigger plane has something real to find."""
+    import hashlib
+
+    import numpy as np
+    block = hashlib.sha256(
+        f"{name}:{seed}:session{i}:chunk{seq}".encode()).digest()
+    rng = np.random.default_rng(int.from_bytes(block[:8], "little"))
+    arr = rng.standard_normal((nchan, chunk_len)).astype(np.float32)
+    if seq % 4 == 1:
+        arr[:, chunk_len // 3] += 6.0
+    return arr
+
+
 class ChaosRunner:
     def __init__(self, sc: scenario_mod.Scenario, spool: str, *,
                  queue_url: str = "",
@@ -82,6 +102,9 @@ class ChaosRunner:
         #: flap_capacity bursts) — continues past the steady
         #: workload's ids so every ticket id and outdir stays unique
         self._beam_seq = sc.workload.beams
+        #: stream feeder threads (worker_kind=stream): one per
+        #: session, landing chunk frames behind the submitted ticket
+        self._feeders: list[threading.Thread] = []
 
     # ------------------------------------------------------------- fleet
 
@@ -96,6 +119,12 @@ class ChaosRunner:
                     "--beam-s", str(self.sc.beam_s),
                     "--max-attempts", str(self.sc.max_attempts),
                     *batch, *self.worker_extra_args]
+        if self.sc.worker_kind == "stream":
+            return [sys.executable, "-m", "tpulsar.stream.worker",
+                    "--spool", self.spool, "--worker-id", worker_id,
+                    "--queue", self.queue_url,
+                    "--max-attempts", str(self.sc.max_attempts),
+                    *self.worker_extra_args]
         argv = [sys.executable, "-m", "tpulsar.cli"]
         cfgpath = os.environ.get("TPULSAR_CONFIG")
         if cfgpath:
@@ -251,8 +280,80 @@ class ChaosRunner:
 
     # ---------------------------------------------------------- workload
 
+    @property
+    def stream_root(self) -> str:
+        return os.path.join(scenario_mod.chaos_dir(self.spool),
+                            "stream")
+
+    def _stream_geometry(self) -> dict:
+        wl = self.sc.workload
+        return {"nchan": wl.stream_nchan,
+                "chunk_len": wl.stream_chunk_len,
+                "dt": 1e-4, "f_lo_mhz": 1300.0, "f_hi_mhz": 1500.0,
+                "ndms": wl.stream_ndms, "dm_max": 30.0,
+                "span_chunks": 2}
+
+    def _submit_stream(self, i: int, t_rel: float) -> None:
+        """One streaming session: open it through the real ingest
+        module, submit its stream ticket, and start a feeder thread
+        that lands chunks behind the claiming worker's back."""
+        from tpulsar.stream import ingest
+        wl = self.sc.workload
+        session = f"{self.sc.name}-s{i:03d}"
+        tid = f"{self.sc.name}-{i:03d}"
+        outdir = os.path.join(scenario_mod.chaos_dir(self.spool),
+                              "out", f"beam{i:03d}")
+        try:
+            ingest.open_session(self.stream_root, session,
+                                self._stream_geometry())
+            self.q.submit(tid, [], outdir, job_id=i, kind="stream",
+                          session=session,
+                          stream_root=self.stream_root,
+                          slo_s=wl.stream_slo_s)
+            self.tickets.append(tid)
+        except (OSError, ingest.StreamError) as e:
+            self._journal_action(t_rel, "submit_refused",
+                                 detail=str(e)[:120], beam=i)
+            return
+        th = threading.Thread(target=self._feed_session,
+                              args=(i, session),
+                              name=f"chaos-feed-{session}",
+                              daemon=True)
+        self._feeders.append(th)
+        th.start()
+
+    def _feed_session(self, i: int, session: str) -> None:
+        """Land the session's chunks at the workload cadence —
+        skipping the declared drop seqs, which the worker must
+        zero-fill as gaps — then close it.  Runs on the conductor,
+        whose faults layer is never armed: the ``stream.ingest``
+        fault point is under test on the WORKER's read path."""
+        from tpulsar.stream import ingest
+        wl = self.sc.workload
+        drop = {int(s) for s in wl.stream_drop_seqs}
+        for seq in range(wl.stream_chunks):
+            if seq not in drop:
+                chunk = stream_chunk_payload(
+                    self.sc.name, self.sc.seed, i, seq,
+                    wl.stream_nchan, wl.stream_chunk_len)
+                try:
+                    ingest.append_chunk(self.stream_root, session,
+                                        seq, chunk)
+                except OSError as e:
+                    self.log.warning("feed %s seq %d failed: %s",
+                                     session, seq, e)
+            self.sleeper(wl.stream_interval_s)
+        try:
+            ingest.close_session(self.stream_root, session,
+                                 wl.stream_chunks)
+        except (OSError, ingest.StreamError) as e:
+            self.log.warning("close %s failed: %s", session, e)
+
     def _submit(self, i: int, t_rel: float) -> None:
         wl = self.sc.workload
+        if self.sc.worker_kind == "stream":
+            self._submit_stream(i, t_rel)
+            return
         datafiles = list(wl.datafiles or ["chaos://synthetic"])
         outdir = os.path.join(scenario_mod.chaos_dir(self.spool),
                               "out", f"beam{i:03d}")
@@ -384,6 +485,12 @@ class ChaosRunner:
                     self._submit(item, t_rel)
                 else:
                     self._do_action(item, t_rel)
+            # stream sessions cannot reach a terminal result until
+            # their feeders close them — wait those out first (the
+            # run duration still bounds the whole storm)
+            for th in self._feeders:
+                th.join(timeout=max(
+                    0.0, t0 + sc.duration_s - time.time()))
             # ---- quiesce: every submitted beam terminal
             deadline = min(t0 + sc.duration_s,
                            time.time() + sc.quiesce_timeout_s)

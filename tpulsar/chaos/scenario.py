@@ -66,7 +66,7 @@ ACTIONS = ("kill_worker", "stop_worker", "cont_worker",
            "restart_gateway", "pause_janitor", "set_faults",
            "surge_submit", "flap_capacity")
 KILL_SIGNALS = ("KILL", "TERM")
-WORKER_KINDS = ("stub", "serve")
+WORKER_KINDS = ("stub", "serve", "stream")
 SUBMIT_VIAS = ("spool", "gateway")
 
 
@@ -116,6 +116,28 @@ class Workload:
     #: store so kill-mid-beam scenarios exercise pass-level resume
     passes: int = 0
     pass_s: float = 0.05
+    #: > 0 turns each "beam" into a STREAMING SESSION
+    #: (worker_kind=stream): the conductor opens a session under
+    #: <chaos>/stream, submits its stream ticket, then a feeder
+    #: thread lands `stream_chunks` framed chunks at
+    #: `stream_interval_s` cadence through the real ingest module —
+    #: skipping every seq in `stream_drop_seqs` (a declared gap the
+    #: worker must zero-fill, never splice) — and closes the
+    #: session.  Chunk payloads are a pure function of
+    #: (scenario, seed, session, seq), so a storm run and its
+    #: timeline-stripped control run must produce identical
+    #: trigger digests.
+    stream_chunks: int = 0
+    stream_chunk_len: int = 256
+    stream_nchan: int = 16
+    stream_ndms: int = 8
+    stream_interval_s: float = 0.2
+    stream_drop_seqs: list = dataclasses.field(default_factory=list)
+    #: per-chunk ingest-to-searched latency objective journaled on
+    #: every chunk_received — the trigger_latency_bounded invariant
+    #: judges against THIS number, so it must absorb a worker kill
+    #: plus controller restart plus session resume
+    stream_slo_s: float = 30.0
 
 
 @dataclasses.dataclass
@@ -280,6 +302,37 @@ def from_dict(doc: dict) -> Scenario:
     if sc.worker_kind == "serve" and wl.datafiles is None:
         raise ValueError("worker_kind=serve needs workload.datafiles "
                          "(real beams for real workers)")
+    if (sc.worker_kind == "stream") != (wl.stream_chunks > 0):
+        raise ValueError("worker_kind=stream and workload."
+                         "stream_chunks > 0 come together (both or "
+                         "neither)")
+    if sc.worker_kind == "stream":
+        if wl.via != "spool":
+            raise ValueError("stream workloads need via=spool (the "
+                             "conductor feeds frames through the "
+                             "ingest module directly)")
+        if sc.batch > 1:
+            raise ValueError("stream workloads need batch=1 (the "
+                             "stream worker claims one session "
+                             "ticket at a time)")
+        if wl.passes:
+            raise ValueError("workload.passes is a stub-beam knob — "
+                             "not valid with worker_kind=stream")
+        if wl.stream_chunk_len <= 0 or wl.stream_nchan <= 0 \
+                or wl.stream_ndms <= 0:
+            raise ValueError("stream geometry fields (stream_chunk_"
+                             "len, stream_nchan, stream_ndms) must "
+                             "be positive")
+        if wl.stream_interval_s < 0 or wl.stream_slo_s <= 0:
+            raise ValueError("stream_interval_s must be >= 0 and "
+                             "stream_slo_s positive")
+        bad = [s for s in wl.stream_drop_seqs
+               if not isinstance(s, int) or isinstance(s, bool)
+               or s < 0 or s >= wl.stream_chunks]
+        if bad:
+            raise ValueError(f"stream_drop_seqs entries must be "
+                             f"ints in [0, stream_chunks); got "
+                             f"{bad}")
     if sc.tenants:
         # validate the tenant table exactly as the claim path will
         from tpulsar.frontdoor.tenancy import TenantPolicy

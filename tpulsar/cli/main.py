@@ -299,9 +299,11 @@ def cmd_serve(args):
         heartbeat_interval_s=cfg.jobpooler.serve_heartbeat_interval_s,
         prefetch_depth=args.prefetch_depth,
         batch_size=args.batch,
-        batch_linger_s=args.batch_linger)
+        batch_linger_s=args.batch_linger,
+        stream=args.stream)
     server.install_signal_handlers()
     print(f"serve: spool {server.spool} "
+          + ("mode stream " if args.stream else "")
           + (f"queue {server.queue.url} "
              if server.queue.backend != "spool" else "")
           + (f"worker {args.worker_id} " if args.worker_id else "")
@@ -1577,6 +1579,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bounded wait (s) a partial batch lingers "
                          "for late-arriving compatible tickets "
                          "before dispatching partial")
+    sp.add_argument("--stream", action="store_true",
+                    help="streaming search mode: claim stream "
+                         "session tickets (gateway POST /v1/stream/"
+                         "<s>/open) and run chunked ingest -> "
+                         "incremental dedispersion -> bounded-"
+                         "latency single-pulse triggers on the "
+                         "warmed backend; beams are refused")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(

@@ -191,6 +191,20 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
     _k("TPULSAR_STAGE_TRACE", "enum(1)", "off",
        "1 prints a flushed begin/end line per search stage to "
        "stderr (hang localization)"),
+    _k("TPULSAR_STREAM_CHUNK_DEADLINE_S", "float (seconds)", "30.0",
+       "streaming per-chunk ingest->trigger latency SLO: the "
+       "default a stream ticket inherits when it names no slo_s; "
+       "breaches are journaled on chunk_received and judged by the "
+       "trigger_latency_bounded chaos invariant"),
+    _k("TPULSAR_STREAM_IDLE_TIMEOUT_S", "float (seconds)", "60.0",
+       "session idle timeout: a stream worker abandons a session "
+       "(failed result, releasing the ticket) when neither a new "
+       "chunk frame nor the close marker lands within this window"),
+    _k("TPULSAR_STREAM_RING_CHUNKS", "int (chunks)", "4",
+       "trigger span depth: completed chunks accumulated per "
+       "single-pulse search span (the stream ticket's span_chunks "
+       "beats it); larger rings amortize the boxcar ladder, "
+       "smaller rings tighten trigger latency"),
     _k("TPULSAR_TRACE", "enum(1)", "off",
        "1 enables the per-beam span tracer (writes "
        "<basenm>_trace.json Chrome-trace output)"),

@@ -37,12 +37,26 @@ API (all JSON):
     GET /v1/capacity              admission headroom: >0 accepting,
                                   0 backpressure, -1 load-shed (the
                                   federation router's poll target)
+    POST /v1/stream/<s>/open      open a streaming-ingest session and
+                                  enqueue its stream ticket
+        {"geometry": {...}, "outdir"?: str, "slo_s"?: float}
+        -> 201 (200 on idempotent re-open) {"session", "ticket",
+                "fingerprint", "triggers_url"}; 409 on a geometry
+        fingerprint mismatch
+    POST /v1/stream/<s>/chunks    land one encoded frame (raw body =
+                                  ingest.encode_frame bytes; sha256
+                                  re-verified before the rename —
+                                  400 refuses a corrupt upload whole)
+    POST /v1/stream/<s>/close     {"n_chunks": N} mark the session
+                                  closed at N submitted frames
+    GET /v1/stream/<s>/triggers   published trigger records so far
     GET /healthz                  liveness
     GET /metrics                  this gateway's registry (Prometheus
                                   text)
 
 Authn: when a shared secret is configured (``TPULSAR_GATEWAY_TOKEN``
-or ``token=``), every MUTATING route (beam POST, blob PUT) requires
+or ``token=``), every MUTATING route (beam POST, blob PUT, the
+stream open/chunks/close POSTs) requires
 ``Authorization: Bearer <token>`` and answers 401 without it; reads
 stay open (the journal/results are already the operator's to serve).
 
@@ -109,6 +123,7 @@ class GatewayServer:
                  query_limit: int = 200,
                  retry_jitter_seed: int = 0, logger=None,
                  blob_root: str | None = None,
+                 stream_root: str | None = None,
                  token: str | None = None):
         if (queue is None) == (router is None):
             raise ValueError(
@@ -130,6 +145,15 @@ class GatewayServer:
                     getattr(queue, "journal_root", "") or "")
             if root:
                 self.blob_store = blobstore_mod.BlobStore(root)
+        #: the streaming-ingest landing root: an explicit stream_root
+        #: beats the <spool>/stream convention; None in router mode
+        #: (chunk frames are host-local — a session sticks to the
+        #: member that opened it)
+        self.stream_root = None
+        if router is None:
+            base = getattr(queue, "journal_root", "") or ""
+            self.stream_root = stream_root if stream_root is not None \
+                else (os.path.join(base, "stream") if base else None)
         self.policy = policy or tenancy.TenantPolicy()
         self.outdir_base = outdir_base
         self.max_age_s = max_age_s
@@ -522,6 +546,115 @@ class GatewayServer:
             raise GatewayError(500, f"blob store read failed: {e}")
         return fh, size
 
+    # --------------------------------------------------------- stream routes
+
+    def _require_stream(self):
+        from tpulsar.stream import ingest
+        if self.router is not None:
+            raise GatewayError(
+                404, "this is a federation router: stream sessions "
+                     "are host-local — open the session on a member "
+                     "gateway and keep its chunks there")
+        if not self.stream_root:
+            raise GatewayError(
+                404, "this gateway mounts no stream root")
+        return ingest
+
+    def handle_stream_open(self, session: str,
+                           payload: dict) -> tuple[int, dict]:
+        """Open (or idempotently re-open) an ingest session AND
+        enqueue its stream ticket — one claimable unit of session
+        work riding the ordinary exactly-once ticket machinery."""
+        ingest = self._require_stream()
+        geometry = payload.get("geometry")
+        if not isinstance(geometry, dict) or not geometry:
+            raise GatewayError(
+                400, "geometry must be a non-empty JSON object")
+        with self._admit_lock:
+            known = ingest.read_manifest(self.stream_root, session)
+            try:
+                man = ingest.open_session(self.stream_root, session,
+                                          geometry)
+            except ingest.StreamError as e:
+                raise GatewayError(409, str(e))
+            except (ValueError, KeyError) as e:
+                raise GatewayError(400, f"bad geometry: {e}")
+            ticket_id = f"stream-{session}"
+            if known is None:
+                outdir = payload.get("outdir") or (
+                    os.path.join(self.outdir_base, ticket_id)
+                    if self.outdir_base else "")
+                if not outdir:
+                    raise GatewayError(
+                        400, "no outdir in the request and the "
+                             "gateway has no --outdir-base to "
+                             "derive one")
+                trace_id = uuid.uuid4().hex[:16]
+                self.queue.record_event("received", ticket=ticket_id,
+                                        trace_id=trace_id)
+                self.queue.submit(
+                    ticket_id, [], outdir, trace_id=trace_id,
+                    kind="stream", session=session,
+                    stream_root=self.stream_root,
+                    submitted_via="gateway",
+                    **({"slo_s": float(payload["slo_s"])}
+                       if payload.get("slo_s") else {}))
+        return 201 if known is None else 200, {
+            "session": session, "ticket": ticket_id,
+            "fingerprint": man["fingerprint"],
+            "triggers_url": f"/v1/stream/{session}/triggers"}
+
+    def handle_stream_chunk(self, session: str, body,
+                            length: int) -> tuple[int, dict]:
+        """Land one encoded frame; the payload sha256 is re-verified
+        before the rename, so a corrupt upload is refused whole."""
+        ingest = self._require_stream()
+        if length <= 0:
+            raise GatewayError(400, "empty frame body")
+        man = ingest.read_manifest(self.stream_root, session)
+        if man is None:
+            raise GatewayError(
+                404, f"unknown stream session {session!r} — POST "
+                     f"/v1/stream/{session}/open first")
+        if man.get("closed"):
+            raise GatewayError(
+                409, f"session {session!r} is closed")
+        try:
+            header = ingest.append_frame(self.stream_root, session,
+                                         body.read(length))
+        except ingest.StreamError as e:
+            raise GatewayError(400, f"bad frame: {e}")
+        return 201, {"session": session, "seq": header["seq"],
+                     "sha256": header["sha256"]}
+
+    def handle_stream_close(self, session: str,
+                            payload: dict) -> tuple[int, dict]:
+        ingest = self._require_stream()
+        try:
+            n_chunks = int(payload["n_chunks"])
+        except (KeyError, TypeError, ValueError):
+            raise GatewayError(
+                400, "n_chunks (total frames submitted, dropped "
+                     "seqs included) is required")
+        try:
+            man = ingest.close_session(self.stream_root, session,
+                                       n_chunks)
+        except ingest.StreamError as e:
+            raise GatewayError(404, str(e))
+        return 200, {"session": session, "closed": True,
+                     "n_chunks": man["n_chunks"]}
+
+    def handle_stream_triggers(self, session: str) -> tuple[int, dict]:
+        ingest = self._require_stream()
+        man = ingest.read_manifest(self.stream_root, session)
+        if man is None:
+            raise GatewayError(
+                404, f"unknown stream session {session!r}")
+        recs = ingest.read_triggers(self.stream_root, session)
+        return 200, {"session": session,
+                     "closed": bool(man.get("closed")),
+                     "n": len(recs), "triggers": recs}
+
     def handle_capacity(self) -> tuple[int, dict]:
         if self.router is not None:
             states = self.router.capacities()
@@ -634,6 +767,10 @@ def _make_handler(gw: GatewayServer):
 
         def do_POST(self):
             path = urllib.parse.urlparse(self.path).path
+            parts = [p for p in path.split("/") if p]
+            if len(parts) == 4 and parts[:2] == ["v1", "stream"]:
+                self._stream_post(parts[2], parts[3])
+                return
             if path != "/v1/beams":
                 self._dispatch("other", lambda: (_ for _ in ()).throw(
                     GatewayError(404, f"no POST route {path!r}")))
@@ -654,6 +791,56 @@ def _make_handler(gw: GatewayServer):
                 return gw.handle_submit(payload)
 
             self._dispatch("submit", run)
+
+        def _stream_post(self, session: str, action: str) -> None:
+            """POST /v1/stream/<session>/{open,chunks,close} — every
+            one a mutating route behind the bearer gate.  ``chunks``
+            bodies are raw frame bytes; open/close are JSON."""
+            if action == "chunks":
+                try:
+                    length = int(self.headers.get("Content-Length",
+                                                  ""))
+                except ValueError:
+                    self._dispatch(
+                        "stream_chunk",
+                        lambda: (_ for _ in ()).throw(GatewayError(
+                            411, "Content-Length required for "
+                                 "frame POST")))
+                    return
+
+                def run():
+                    gw.check_auth(self.headers.get("Authorization",
+                                                   ""))
+                    return gw.handle_stream_chunk(session, self.rfile,
+                                                  length)
+
+                self._dispatch("stream_chunk", run)
+                return
+            if action not in ("open", "close"):
+                self._dispatch("other", lambda: (_ for _ in ()).throw(
+                    GatewayError(
+                        404, f"no stream action {action!r}")))
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(
+                    self.rfile.read(length).decode() or "{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._dispatch(
+                    f"stream_{action}",
+                    lambda: (_ for _ in ()).throw(
+                        GatewayError(400, f"bad JSON body: {e}")))
+                return
+
+            def run():
+                gw.check_auth(self.headers.get("Authorization", ""))
+                if action == "open":
+                    return gw.handle_stream_open(session, payload)
+                return gw.handle_stream_close(session, payload)
+
+            self._dispatch(f"stream_{action}", run)
 
         def do_PUT(self):
             path = urllib.parse.urlparse(self.path).path
@@ -713,6 +900,11 @@ def _make_handler(gw: GatewayServer):
                                lambda: gw.handle_result(parts[2]))
             elif len(parts) == 3 and parts[:2] == ["v1", "blobs"]:
                 self._blob_get(parts[2])
+            elif len(parts) == 4 and parts[:2] == ["v1", "stream"] \
+                    and parts[3] == "triggers":
+                self._dispatch(
+                    "stream_triggers",
+                    lambda: gw.handle_stream_triggers(parts[2]))
             else:
                 self._dispatch("other", lambda: (_ for _ in ()).throw(
                     GatewayError(404, f"no route {path!r}")))

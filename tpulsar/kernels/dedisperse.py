@@ -505,3 +505,55 @@ def max_shift_samples(freqs_mhz: np.ndarray, max_dm: float, dt: float) -> int:
     are contaminated by edge clamping and must be ignored."""
     f = np.asarray(freqs_mhz, dtype=np.float64)
     return int(np.ceil(KDM * max_dm * (f.min() ** -2 - f.max() ** -2) / dt))
+
+
+# ----------------------------------------------------------- streaming entry
+#
+# The streaming plane (tpulsar/stream/) dedisperses chunk-at-a-time
+# against carried channel state.  It reuses dedisperse_window_scan —
+# the SAME jitted program as the batch time-shard path — at one static
+# (nchan, stream_window_width) signature per session geometry, so a
+# warm worker compiles nothing at session start and every emitted
+# sample is the bit-identical fold-left channel sum the batch kernel
+# produces (same program, same scan order, same f32 adds).
+
+def stream_shift_table(freqs_mhz, dms, dt: float) -> np.ndarray:
+    """(ndms, nchan) int32 per-channel shifts for DIRECT streaming
+    dedispersion (no subband approximation — a stream session's DM
+    list is small enough that stage 1 would buy nothing), delays
+    relative to the highest frequency like everything else here."""
+    freqs_mhz = np.asarray(freqs_mhz, dtype=np.float64)
+    band_ref = float(freqs_mhz[-1])
+    return np.stack([
+        shift_samples(float(dm), freqs_mhz, band_ref, dt)
+        for dm in np.atleast_1d(np.asarray(dms, dtype=np.float64))
+    ]).astype(np.int32)
+
+
+def stream_window_width(chunk_len: int, maxshift: int) -> int:
+    """Static width of the streaming emission window: chunk_len output
+    samples plus the power-of-two shift bucket (columns past
+    maxshift + chunk_len are never read — they exist only to keep the
+    compile signature stable across session geometries)."""
+    return chunk_len + _pad_bucket(maxshift)
+
+
+def dedisperse_stream_step(window: jnp.ndarray, shifts: jnp.ndarray,
+                           chunk_len: int) -> jnp.ndarray:
+    """One streaming emission: (nchan, W) window -> (ndms, chunk_len).
+    Thin alias of the registered dedisperse_window_scan program so the
+    stream plane and the AOT gate name the same compiled signature."""
+    return dedisperse_window_scan(window, shifts, chunk_len)
+
+
+def dedisperse_stream_batch(data, shifts) -> jnp.ndarray:
+    """Batch reference for the streaming plane: dedisperse the whole
+    (nchan, T) block in one call with the same edge clamp the chunked
+    path realizes at session close.  Used by parity tests and
+    ``bench --stream`` — a chunked run must match this bit-for-bit."""
+    data = jnp.asarray(data, jnp.float32)
+    shifts_np = np.asarray(shifts)
+    pad = _pad_bucket(int(shifts_np.max(initial=0)))
+    ext = _edge_pad(data, pad)
+    return dedisperse_window_scan(ext, jnp.asarray(shifts_np),
+                                  data.shape[1])

@@ -35,6 +35,21 @@ SP_EVENT_DTYPE = np.dtype([("dm", "f8"), ("sigma", "f8"),
                            ("downfact", "i4")])
 
 
+def _baseline_stat(x: jnp.ndarray, estimator: str) -> jnp.ndarray:
+    """Per-block baseline statistic over the last axis — every block
+    (including a short tail) is normalized by ITS OWN sample count."""
+    if estimator == "median":
+        return jnp.median(x, axis=-1)
+    if estimator == "median_sub4":
+        return jnp.median(x[..., ::4], axis=-1)
+    if estimator == "clipped_mean":
+        mu = x.mean(axis=-1, keepdims=True)
+        sd = jnp.maximum(x.std(axis=-1, keepdims=True), 1e-9)
+        w = (jnp.abs(x - mu) <= 3.0 * sd).astype(x.dtype)
+        return (x * w).sum(-1) / jnp.maximum(w.sum(-1), 1.0)
+    raise ValueError(f"unknown SP detrend estimator {estimator!r}")
+
+
 def detrend_normalize(series: jnp.ndarray, detrend_block: int = 1000,
                       estimator: str = "median"):
     """The detrend/normalize BODY (traceable, not itself jitted).
@@ -50,21 +65,18 @@ def detrend_normalize(series: jnp.ndarray, detrend_block: int = 1000,
     nblk = max(1, T // detrend_block)
     usable = nblk * detrend_block
     blocks = series[:, :usable].reshape(ndms, nblk, detrend_block)
-    if estimator == "median":
-        med = jnp.median(blocks, axis=-1)
-    elif estimator == "median_sub4":
-        med = jnp.median(blocks[..., ::4], axis=-1)
-    elif estimator == "clipped_mean":
-        mu = blocks.mean(axis=-1, keepdims=True)
-        sd = jnp.maximum(blocks.std(axis=-1, keepdims=True), 1e-9)
-        w = (jnp.abs(blocks - mu) <= 3.0 * sd).astype(blocks.dtype)
-        med = (blocks * w).sum(-1) / jnp.maximum(w.sum(-1), 1.0)
-    else:
-        raise ValueError(f"unknown SP detrend estimator {estimator!r}")
-    # Broadcast block baselines back out (tail reuses the last
-    # block's).
+    med = _baseline_stat(blocks, estimator)
     baseline = jnp.repeat(med, detrend_block, axis=-1)
-    baseline = jnp.pad(baseline, ((0, 0), (0, T - usable)), mode="edge")
+    if T > usable:
+        # A tail shorter than detrend_block gets a baseline estimated
+        # from its own samples (its own length as the denominator) —
+        # reusing the last full block's baseline inflates tail sigmas
+        # whenever the local level drifts across the block boundary.
+        tail_med = _baseline_stat(series[:, usable:], estimator)
+        baseline = jnp.concatenate(
+            [baseline,
+             jnp.repeat(tail_med[:, None], T - usable, axis=-1)],
+            axis=-1)
     detrended = series - baseline
     std = jnp.maximum(jnp.std(detrended, axis=-1, keepdims=True), 1e-9)
     return detrended / std

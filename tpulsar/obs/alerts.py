@@ -79,6 +79,10 @@ class Rule:
     short_window_s: float = 0.0
     objective_s: float = 0.0
     budget: float = 0.1
+    #: burn_rate: which frame sample stream to judge — "queue_wait"
+    #: (beam admission latency) or "stream_latency" (per-chunk
+    #: ingest->trigger latency from chunk_received events)
+    samples_key: str = "queue_wait"
 
 
 def validate_rule(rule: Rule) -> Rule:
@@ -124,6 +128,10 @@ def validate_rule(rule: Rule) -> Rule:
         if not 0 < rule.budget < 1:
             raise bad(f"budget must sit in (0, 1) "
                       f"(got {rule.budget!r})")
+        if not rule.samples_key or not isinstance(rule.samples_key,
+                                                  str):
+            raise bad(f"samples_key must be a non-empty string "
+                      f"(got {rule.samples_key!r})")
     return rule
 
 
@@ -194,6 +202,14 @@ def builtin_rules() -> tuple[Rule, ...]:
              doc="queue-wait SLO error budget burning >= 2x in both "
                  "the 10 min and 2 min windows (SLO: <= 10% of "
                  "beams wait > 30 s for their first claim)"),
+        Rule(id="stream_latency_burn", severity="page",
+             kind="burn_rate", window_s=600.0, short_window_s=120.0,
+             objective_s=5.0, budget=0.1, threshold=2.0,
+             samples_key="stream_latency",
+             doc="streaming trigger-latency SLO error budget burning "
+                 ">= 2x in both the 10 min and 2 min windows (SLO: "
+                 "<= 10% of acknowledged chunks take > 5 s from "
+                 "ingest to trigger publication)"),
         Rule(id="takeover_rate", severity="warn", kind="event_count",
              events=("takeover",), window_s=300.0, threshold=1,
              doc="crash-shaped takeovers: a worker died holding a "
@@ -265,7 +281,8 @@ def builtin_rules() -> tuple[Rule, ...]:
 
 #: the alerts any worker-disrupting injection may legitimately raise
 _DISRUPTION = ("worker_flap", "takeover_rate", "quarantine",
-               "queue_wait_slo_burn", "fleet_saturated",
+               "queue_wait_slo_burn", "stream_latency_burn",
+               "fleet_saturated",
                "checkpoint_sick")
 
 ALLOWED_ALERTS: dict[str, tuple[str, ...]] = {
@@ -282,6 +299,9 @@ ALLOWED_ALERTS: dict[str, tuple[str, ...]] = {
     "fault:spool.io": _DISRUPTION + ("fsck_findings",),
     "fault:checkpoint.write": _DISRUPTION,
     "fault:checkpoint.load": _DISRUPTION,
+    #: injected ingest-read failures cost the stream worker retries
+    #: (latency), so the latency burn alert is earned, never false
+    "fault:stream.ingest": _DISRUPTION,
     "fault:accel.row_dispatch": ("accel_breaker_pinned",),
     "fault:accel.chunk": ("accel_breaker_pinned",),
 }
@@ -367,6 +387,22 @@ def queue_wait_samples(events: list[dict]) -> list[tuple]:
     return out
 
 
+def stream_latency_samples(events: list[dict]) -> list[tuple]:
+    """``(t, latency_s)`` per acknowledged stream chunk — the
+    stream_latency_burn rule's sample stream, straight from the
+    ``chunk_received`` events the stream worker journals (latency =
+    ingest receipt to trigger publication for that chunk)."""
+    out = []
+    for e in events:
+        if e.get("event") != "chunk_received":
+            continue
+        lat = e.get("latency_s")
+        if isinstance(lat, (int, float)) and not isinstance(lat, bool):
+            out.append((e.get("t", 0.0), float(lat)))
+    out.sort()
+    return out
+
+
 def burn_rate(samples: list[tuple], now: float, window_s: float,
               objective_s: float, budget: float,
               min_count: int) -> tuple | None:
@@ -410,7 +446,7 @@ def evaluate_rule(rule: Rule, frame: dict) -> dict | None:
         value = hist[-1][1] - base
         extra["current"] = hist[-1][1]
     elif rule.kind == "burn_rate":
-        samples = frame.get("queue_wait") or []
+        samples = frame.get(rule.samples_key) or []
         long = burn_rate(samples, now, rule.window_s,
                          rule.objective_s, rule.budget,
                          rule.min_count)

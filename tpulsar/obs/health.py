@@ -300,6 +300,8 @@ class HealthDetector:
                  "snapshot": snap, "samples": self._samples,
                  "queue_wait": alerts.queue_wait_samples(
                      self._events),
+                 "stream_latency": alerts.stream_latency_samples(
+                     self._events),
                  "fsck": self._fsck_findings}
         for rule in self.rules:
             verdict = alerts.evaluate_rule(rule, frame)

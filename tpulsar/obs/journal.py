@@ -162,6 +162,21 @@ EVENTS = {
                    "threshold, window_s) — self-contained evidence",
     "alert_resolved": "a firing alert rule's signal dropped back "
                       "under its threshold (rule, severity, value)",
+    "stream_open": "a stream worker opened a session ticket "
+                   "(session, fingerprint, resumed flag, ack seq "
+                   "when resuming from carry state)",
+    "chunk_received": "one chunk acknowledged exactly once: "
+                      "dedispersed + span-searched + published "
+                      "(seq, latency_s ingest->trigger, slo_s, "
+                      "proc_s) — the trigger_latency_bounded and "
+                      "no_lost_chunk evidence",
+    "chunk_gap": "a missing seq was declared a gap and zero-filled, "
+                 "never silently spliced (seq, waited_s)",
+    "trigger": "a completed span published single-pulse trigger "
+               "records (span, n, top_sigma, digest)",
+    "stream_closed": "the session drained: every seq in [0, "
+                     "n_chunks) acknowledged or gapped (n_chunks, "
+                     "chunks, gaps, triggers, digest)",
 }
 
 #: the one terminal event name: a ticket is finished exactly when its

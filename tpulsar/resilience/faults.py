@@ -129,6 +129,12 @@ FAULT_POINTS = (
     #                         errno mode fails the transfer (contained
     #                         as a per-ticket stagein_failed result),
     #                         delay mode models a congested data plane
+    "stream.ingest",        # stream/ingest.py chunk-frame append and
+    #                         verified read: a failure on the read
+    #                         path is retried by the stream worker
+    #                         (costs latency, never data — the frame
+    #                         stays on disk); delay mode models a
+    #                         congested ingest volume
 )
 
 MODES = ("unimplemented", "hang", "delay", "poison")

@@ -73,6 +73,7 @@ class SearchServer:
                  claim_policy=None,
                  batch_size: int = 1,
                  batch_linger_s: float = 2.0,
+                 stream: bool = False,
                  beam_fn=None, batch_fn=None, logger=None):
         if cfg is None:
             from tpulsar.config import settings
@@ -146,6 +147,12 @@ class SearchServer:
                 workdir_base=cfg.processing.base_working_directory,
                 cfg=cfg, depth=prefetch_depth, poll_s=poll_s,
                 logger=self.log, journal=self._journal)
+        #: stream mode (``serve --stream``): the loop claims stream
+        #: session tickets instead of beams and runs them through the
+        #: streaming plane (tpulsar/stream/worker.py) on the WARMED
+        #: jax backend — the boot gate has already compiled the
+        #: stream-profile programs, so session start compiles nothing
+        self.stream = bool(stream)
         self._drain = threading.Event()
         self._stopped = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -277,6 +284,8 @@ class SearchServer:
         self._hb_thread.start()
         self.boot()
         self.blackbox.arm()
+        if self.stream:
+            return self._serve_stream(once)
         self.pipeline.start()
         try:
             while not self.draining:
@@ -301,7 +310,61 @@ class SearchServer:
             self._shutdown()
         return 0
 
-    def _shutdown(self) -> None:
+    def _serve_stream(self, once: bool) -> int:
+        """The stream-mode loop: claim session tickets, run each to
+        its terminal result through the streaming plane's
+        exactly-once machinery (tpulsar/stream/worker.py).  A drain
+        mid-session checkpoints the carry and requeues the claim —
+        the next worker resumes without reprocessing an acknowledged
+        chunk."""
+        from tpulsar.stream import worker as stream_worker
+
+        def beat(status: str = "running") -> None:
+            try:
+                self._heartbeat(status)
+            except OSError:
+                pass
+
+        try:
+            while not self.draining:
+                beat()
+                try:
+                    rec = self.queue.claim_next(
+                        self.worker_id, policy=self.claim_policy,
+                        worker_class=self.worker_class)
+                except OSError:
+                    time.sleep(self.poll_s)
+                    continue
+                if rec is None:
+                    if once and self.queue.pending_count() == 0 \
+                            and self.queue.claimed_count() == 0:
+                        break
+                    time.sleep(self.poll_s)
+                    continue
+                self.blackbox.note("claim",
+                                   ticket=rec.get("ticket", "?"))
+                if (rec.get("kind") or "") != "stream":
+                    self.queue.write_result(
+                        rec.get("ticket", "?"), "failed", rc=1,
+                        error="a stream server claims only stream "
+                              "tickets (serve without --stream for "
+                              "beams)", worker=self.worker_id)
+                    self.beams["skipped"] += 1
+                    continue
+                status = stream_worker.process_stream_ticket(
+                    self.queue, rec, jroot=self.jroot,
+                    worker_id=self.worker_id, backend="jax",
+                    box=self.blackbox,
+                    poll_s=min(self.poll_s, 0.05), beat=beat,
+                    should_drain=lambda: self.draining)
+                if status:
+                    self.beams["done" if status == "done"
+                               else "failed"] += 1
+        finally:
+            self._shutdown(pipeline=False)
+        return 0
+
+    def _shutdown(self, pipeline: bool = True) -> None:
         t0 = time.time()
         # a drain that reaches here is the clean exit path: the
         # atexit dump must not leave wreckage for a healthy shutdown
@@ -313,8 +376,9 @@ class SearchServer:
         # the handoff queue (and any it was mid-stage on) hold claims
         # this worker must give back — then requeue every claim this
         # pid still owns, attempt-neutral (a drain is not a crash; the
-        # returned beams are not suspects)
-        leftovers = self.pipeline.stop()
+        # returned beams are not suspects).  Stream mode never started
+        # the pipeline, but its session claims requeue the same way.
+        leftovers = self.pipeline.stop() if pipeline else []
         try:
             requeued = self.queue.requeue_own_claims()
         except OSError as e:

@@ -1,0 +1,26 @@
+"""The streaming plane: chunked ingest + incremental dedispersion +
+bounded-latency single-pulse triggers.
+
+Everything else in tpulsar is batch-a-whole-beam; this package is the
+real-time second data path.  Chunk frames arrive through a session
+spool (or the gateway's ``/v1/stream/<session>/chunks`` route), are
+dedispersed against carried per-channel state (stream/dedisp_state.py
+— bit-identical to the batch kernel on the concatenated series), and
+completed spans are searched for single pulses with a per-chunk
+latency SLO (stream/trigger.py).  stream/worker.py ties the plane to
+the TicketQueue's exactly-once machinery and the checkpoint store so
+a SIGKILLed session resumes without reprocessing acknowledged chunks.
+
+Import discipline: ingest and worker are jax-free (the chaos storm
+runs them on the numpy backend); only dedisp_state/trigger touch the
+kernels, and only lazily.
+"""
+
+#: default stream profile — the session geometry the AOT gate warms
+#: and ``bench --stream`` measures, so a warm worker compiles nothing
+#: at session start on this profile.  dm_max is chosen so the maximum
+#: channel delay stays inside one 256-sample pad bucket (the static
+#: window width is chunk_len + 256 for every DM list under it).
+STREAM_PROFILE = {"nchan": 64, "chunk_len": 1024, "ndms": 32,
+                  "span_chunks": 4, "f_lo_mhz": 1300.0,
+                  "f_hi_mhz": 1500.0, "dt": 1e-4, "dm_max": 30.0}

@@ -1,0 +1,195 @@
+"""Incremental dedispersion state: the carry buffer between chunks.
+
+The streaming plane's core invariant: a chunked run is BIT-IDENTICAL
+to the batch kernel on the concatenated series.  The batch path
+computes, for every DM d and output sample t,
+
+    out[d, t] = sum_c ext[c, t + shift[d, c]]
+
+where ext is the channel block edge-clamped past its last sample and
+the sum folds channels in ascending order (a lax.scan of f32 adds).
+This module reproduces exactly those terms in exactly that order, one
+chunk at a time:
+
+  * a per-channel CARRY BUFFER holds the trailing ``maxshift``
+    samples every not-yet-emittable output still needs;
+  * when ``chunk_len + maxshift`` samples are buffered, one emission
+    window is assembled and dedispersed with the SAME jitted program
+    as the batch path (kernels/dedisperse.dedisperse_window_scan) at
+    one static ``(nchan, chunk_len + pad_bucket)`` signature — a warm
+    worker compiles nothing at session start;
+  * at session close the remaining samples are flushed with the batch
+    kernel's edge clamp (the last REAL sample replicated), so the
+    final ``maxshift`` output samples match the batch block too.
+
+Same program, same fold order, same f32 adds => bit-identity, not
+approximate parity.  The numpy backend (chaos CI runs jax-free)
+performs the identical per-element fold, so its chunked-vs-batch
+behavior is deterministic as well.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from tpulsar.constants import dispersion_delay_s
+
+
+def pad_bucket(maxshift: int) -> int:
+    """Power-of-two pad bucket (>=256, 0 for zero shift) — mirrors
+    kernels/dedisperse._pad_bucket, restated here so the jax-free
+    chaos worker sizes the same windows without importing the kernel
+    module (tests pin the two implementations equal)."""
+    if maxshift <= 0:
+        return 0
+    p = 256
+    while p < maxshift:
+        p *= 2
+    return p
+
+
+def geometry_freqs_dms(geom: dict) -> tuple[np.ndarray, np.ndarray]:
+    """THE session geometry -> (freqs_mhz ascending, dms) derivation,
+    shared by the worker, the parity tests, the AOT gate, and
+    ``bench --stream`` — everything that must agree on shapes."""
+    freqs = np.linspace(float(geom["f_lo_mhz"]), float(geom["f_hi_mhz"]),
+                        int(geom["nchan"]))
+    dms = np.linspace(0.0, float(geom["dm_max"]), int(geom["ndms"]))
+    return freqs, dms
+
+
+def shift_table(geom: dict) -> np.ndarray:
+    """(ndms, nchan) int32 per-channel shifts, jax-free — the same
+    values kernels/dedisperse.stream_shift_table produces (both round
+    constants.dispersion_delay_s against the highest frequency)."""
+    freqs, dms = geometry_freqs_dms(geom)
+    ref = float(freqs[-1])
+    dt = float(geom["dt"])
+    return np.stack([
+        np.round(dispersion_delay_s(dm, freqs, ref) / dt)
+        for dm in dms]).astype(np.int32)
+
+
+def resolve_backend(backend: str = "auto") -> str:
+    if backend == "auto":
+        try:
+            import jax  # noqa: F401
+            return "jax"
+        except Exception:
+            return "numpy"
+    if backend not in ("jax", "numpy"):
+        raise ValueError(f"unknown stream backend {backend!r}")
+    return backend
+
+
+def _window_scan_numpy(window: np.ndarray, shifts: np.ndarray,
+                       out_len: int) -> np.ndarray:
+    """Fold-left channel accumulation, per-element order identical to
+    the jitted scan: acc starts at zeros, channel c adds its shifted
+    slice for every DM before channel c+1 contributes."""
+    ndms = shifts.shape[0]
+    acc = np.zeros((ndms, out_len), np.float32)
+    cols = np.arange(out_len)
+    for c in range(window.shape[0]):
+        acc += window[c][shifts[:, c][:, None] + cols[None, :]]
+    return acc
+
+
+class StreamDedisp:
+    """Carry-state incremental dedispersion for one session."""
+
+    def __init__(self, geom: dict, backend: str = "auto"):
+        self.geom = dict(geom)
+        self.nchan = int(geom["nchan"])
+        self.chunk_len = int(geom["chunk_len"])
+        self.shifts = shift_table(geom)
+        self.maxshift = int(self.shifts.max(initial=0))
+        self.pad = pad_bucket(self.maxshift)
+        #: static emission window width — the one compile signature
+        self.window_width = self.chunk_len + self.pad
+        self.backend = resolve_backend(backend)
+        self.buf = np.zeros((self.nchan, 0), np.float32)
+        self.emitted = 0          # output samples emitted so far
+        self._shifts_dev = None   # device copy, built lazily once
+
+    # ------------------------------------------------------- emission
+    def _scan(self, window: np.ndarray) -> np.ndarray:
+        if self.backend == "jax":
+            import jax.numpy as jnp
+
+            from tpulsar.kernels import dedisperse as dd
+            if self._shifts_dev is None:
+                self._shifts_dev = jnp.asarray(self.shifts)
+            out = dd.dedisperse_stream_step(
+                jnp.asarray(window), self._shifts_dev, self.chunk_len)
+            return np.asarray(out)
+        return _window_scan_numpy(window, self.shifts, self.chunk_len)
+
+    def _emit_window(self, cols: np.ndarray) -> np.ndarray:
+        """Assemble the static-width window (real columns first, the
+        never-read pad tail zeroed) and run the one program."""
+        window = np.zeros((self.nchan, self.window_width), np.float32)
+        window[:, :cols.shape[1]] = cols
+        return self._scan(window)
+
+    def append(self, chunk: np.ndarray) -> list[np.ndarray]:
+        """Feed one (nchan, chunk_len) chunk; returns the (ndms,
+        chunk_len) output blocks that became complete (possibly
+        empty — early chunks only fill the carry buffer)."""
+        chunk = np.asarray(chunk, np.float32)
+        if chunk.shape != (self.nchan, self.chunk_len):
+            raise ValueError(f"chunk shape {chunk.shape} != "
+                             f"({self.nchan}, {self.chunk_len})")
+        self.buf = np.concatenate([self.buf, chunk], axis=1)
+        out = []
+        need = self.chunk_len + self.maxshift
+        while self.buf.shape[1] >= need:
+            out.append(self._emit_window(self.buf[:, :need]))
+            self.buf = self.buf[:, self.chunk_len:]
+            self.emitted += self.chunk_len
+        return out
+
+    def flush(self) -> list[np.ndarray]:
+        """Session close: emit the remaining buffered samples with the
+        batch kernel's edge clamp (last REAL sample replicated)."""
+        out = []
+        r = self.buf.shape[1]
+        if r == 0:
+            return out
+        last = self.buf[:, -1:]
+        need = self.chunk_len + self.maxshift
+        while r > 0:
+            cols = self.buf[:, :min(r, need)]
+            if cols.shape[1] < need:
+                cols = np.concatenate(
+                    [cols, np.broadcast_to(
+                        last, (self.nchan, need - cols.shape[1]))],
+                    axis=1)
+            block = self._emit_window(cols)
+            take = min(self.chunk_len, r)
+            out.append(np.ascontiguousarray(block[:, :take]))
+            self.buf = self.buf[:, take:]
+            self.emitted += take
+            r -= take
+        return out
+
+    # ---------------------------------------------------- carry state
+    def state_bytes(self) -> bytes:
+        """The resumable carry: buffer + emitted counter, npz-packed
+        (checkpointed at chunk boundaries by the stream worker)."""
+        buf = io.BytesIO()
+        np.savez_compressed(buf, carry=self.buf,
+                            emitted=np.int64(self.emitted))
+        return buf.getvalue()
+
+    def restore(self, blob: bytes) -> None:
+        with np.load(io.BytesIO(blob)) as z:
+            self.buf = np.ascontiguousarray(
+                z["carry"].astype(np.float32))
+            self.emitted = int(z["emitted"])
+        if self.buf.shape[0] != self.nchan:
+            raise ValueError(
+                f"carry state nchan {self.buf.shape[0]} != "
+                f"{self.nchan}")
